@@ -1,0 +1,89 @@
+"""paddle_tpu — a TPU-native deep-learning framework.
+
+A ground-up redesign (JAX/XLA/Pallas/pjit-idiomatic) offering the capability
+surface of the PaddlePaddle reference (see SURVEY.md at the repo root): eager
+tensors with tape autograd, a pure-JAX op library fused by XLA, capture/compile
+via jit, hybrid + auto parallelism over jax.sharding meshes, DataLoader, AMP,
+distributed checkpointing, and model libraries.
+
+Top-level namespace mirrors `paddle.*`.
+"""
+from __future__ import annotations
+
+import importlib
+
+__version__ = "0.1.0"
+
+from .core.tensor import Tensor, Parameter
+from .core import dtype as _dtype_mod
+from .core.dtype import (
+    DType, bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    set_default_dtype, get_default_dtype, iinfo, finfo,
+)
+from .core.generator import seed, Generator
+from .core.flags import get_flags, set_flags
+from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad
+from .autograd.tape import backward as _backward
+from .framework import get_default_device, set_device, get_device, device_count, is_compiled_with_tpu
+
+# the op library (also installs Tensor methods/dunders)
+from .ops import *  # noqa: F401,F403
+from . import ops  # noqa: F401
+
+bool = bool_  # paddle.bool
+
+
+_LAZY_SUBMODULES = (
+    "nn", "optimizer", "io", "amp", "jit", "distributed", "vision", "metric",
+    "incubate", "models", "profiler", "autograd", "static", "sparse", "fft",
+    "signal", "linalg", "text", "audio", "hapi", "device", "regularizer",
+    "distribution", "quantization", "geometric", "onnx", "utils", "version",
+    "callbacks", "parallel",
+)
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    if name == "Model":
+        from .hapi import Model
+        return Model
+    if name == "DataParallel":
+        from .distributed.parallel import DataParallel
+        return DataParallel
+    if name == "summary":
+        from .hapi.summary import summary
+        return summary
+    raise AttributeError(f"module 'paddle_tpu' has no attribute {name!r}")
+
+
+def is_grad_enabled_():
+    from .autograd import tape
+    return tape.grad_enabled()
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu has no legacy static-graph mode; use paddle_tpu.jit.to_static "
+        "(program capture compiles to a single XLA module)")
+
+
+def in_dynamic_mode() -> bool:
+    return True
+
+
+def save(obj, path, protocol=4, **configs):
+    from .framework import io as _io
+    return _io.save(obj, path, protocol=protocol, **configs)
+
+
+def load(path, **configs):
+    from .framework import io as _io
+    return _io.load(path, **configs)

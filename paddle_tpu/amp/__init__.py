@@ -1,0 +1,13 @@
+"""AMP (reference: python/paddle/amp/ — auto_cast.py:1014, grad_scaler.py).
+
+TPU-first: the low-precision dtype is bfloat16, which shares float32's
+exponent range — so dynamic loss scaling is unnecessary (GradScaler becomes
+a cheap pass-through by default while keeping full API parity for float16).
+O1 = per-op cast by white/black list at eager dispatch; O2 = cast the model
+to bf16 with fp32 master weights in the optimizer.
+"""
+from .auto_cast import (auto_cast, amp_guard, amp_state, decorate,
+                        white_list as amp_white_list, AMPState)
+from .grad_scaler import GradScaler, AmpScaler
+
+__all__ = ["auto_cast", "decorate", "GradScaler", "AmpScaler"]

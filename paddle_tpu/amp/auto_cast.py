@@ -1,0 +1,142 @@
+"""auto_cast — O1/O2 mixed precision (reference:
+python/paddle/amp/auto_cast.py:1014, op lists in amp_lists.py; eager hook in
+paddle/fluid/eager/amp_auto_cast.h)."""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+
+# ops that benefit from low precision (MXU-bound)
+white_list: Set[str] = {
+    "matmul", "mm", "bmm", "mv", "linear", "einsum", "conv1d", "conv2d",
+    "conv3d", "conv1d_transpose", "conv2d_transpose", "conv3d_transpose",
+    "addmm", "scaled_dot_product_attention_ref", "lstm", "gru", "simple_rnn",
+}
+
+# ops that must stay float32 for numeric health
+black_list: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "expm1", "pow", "square",
+    "cross_entropy", "nll_loss", "binary_cross_entropy", "softmax_with_cross_entropy",
+    "binary_cross_entropy_with_logits", "kl_div", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "cosine_similarity", "norm", "vector_norm", "dist",
+    "logsumexp", "erfinv", "cumprod", "prod", "softplus", "log_softmax",
+    "log_sigmoid", "logit", "rsqrt", "sum", "mean", "std", "var",
+}
+
+
+class AMPState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = dtypes.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = AMPState()
+
+
+def amp_state() -> AMPState:
+    return _state
+
+
+class auto_cast:
+    """Context manager enabling mixed precision for eager ops and traced
+    code alike (the cast happens at op dispatch, which also runs under
+    jit tracing)."""
+
+    def __init__(self, enable: bool = True, custom_white_list=None,
+                 custom_black_list=None, level: str = "O1",
+                 dtype: str = "bfloat16", use_promote: bool = True):
+        assert level in ("O0", "OD", "O1", "O2")
+        self.enable = enable and level in ("O1", "O2")
+        self.level = level
+        self.dtype = dtypes.to_framework_dtype(dtype)
+        self.custom_white = set(custom_white_list or ())
+        self.custom_black = set(custom_black_list or ())
+
+    def __enter__(self):
+        self._prev = (_state.enabled, _state.dtype, _state.level,
+                      _state.custom_white, _state.custom_black)
+        _state.enabled = self.enable
+        _state.dtype = self.dtype
+        _state.level = self.level
+        _state.custom_white = self.custom_white
+        _state.custom_black = self.custom_black
+        return self
+
+    def __exit__(self, *exc):
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = self._prev
+        return False
+
+
+amp_guard = auto_cast
+
+
+def amp_transform_args(op_name: str, flat_tensors):
+    """Called from ops.registry dispatch: returns the cast dtype for this
+    op's floating inputs, or None to leave them alone."""
+    if not _state.enabled:
+        return None
+    in_white = (op_name in white_list or op_name in _state.custom_white) and \
+        op_name not in _state.custom_black
+    in_black = op_name in black_list or op_name in _state.custom_black
+    if in_white:
+        return _state.dtype.np_dtype
+    if in_black:
+        return jnp.float32
+    return None
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False,
+             excluded_layers=None):
+    """O2 decoration: cast model floating params to the AMP dtype
+    (reference: python/paddle/amp/auto_cast.py `decorate`/`amp_decorate`).
+    Master fp32 weights live in the optimizer (multi_precision)."""
+    from ..nn.layer import Layer
+    single_model = isinstance(models, Layer)
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        dt = dtypes.to_jax_dtype(dtype)
+        excluded = []
+        if excluded_layers:
+            ex = excluded_layers if isinstance(excluded_layers, (list, tuple)) \
+                else [excluded_layers]
+            for m in model_list:
+                for l in m.sublayers(include_self=True):
+                    if isinstance(l, tuple(e for e in ex if isinstance(e, type))) \
+                            or l in [e for e in ex if isinstance(e, Layer)]:
+                        excluded.extend(id(p) for p in l.parameters())
+        from ..nn.modules_norm import _BatchNormBase, LayerNorm
+        for m in model_list:
+            for l in m.sublayers(include_self=True):
+                if isinstance(l, (_BatchNormBase, LayerNorm)):
+                    excluded.extend(id(p) for p in l._parameters.values()
+                                    if p is not None)
+            for p in m.parameters():
+                if id(p) in excluded:
+                    continue
+                if jnp.issubdtype(p._data.dtype, jnp.floating):
+                    p._data = p._data.astype(dt)
+        for opt in ([optimizers] if optimizers is not None
+                    and not isinstance(optimizers, (list, tuple))
+                    else (optimizers or [])):
+            opt._multi_precision = True if master_weight is None else master_weight
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers
+
+
+def is_float16_supported(device=None) -> bool:
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True

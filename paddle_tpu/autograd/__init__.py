@@ -1,0 +1,11 @@
+"""Autograd public API (reference: python/paddle/autograd/)."""
+from .tape import (backward, grad, no_grad, enable_grad, set_grad_enabled,
+                   grad_enabled, GradNode)
+from .pylayer import PyLayer, PyLayerContext
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+           "is_grad_enabled", "PyLayer", "PyLayerContext"]
+
+
+def is_grad_enabled() -> bool:
+    return grad_enabled()

@@ -1,0 +1,104 @@
+"""PyLayer: user-defined autograd functions.
+
+TPU-native analogue of the reference's PyLayer (paddle/fluid/eager/pylayer/,
+python/paddle/autograd/py_layer.py): the user writes static forward/backward;
+apply() records one GradNode whose pullback calls the user's backward. The
+user's math is still framework ops, so a PyLayer nested in jitted code traces
+fine in the forward; the custom backward participates only in eager tape
+backward (for jit training the functional path uses jax.custom_vjp —
+see paddle_tpu.incubate.custom_vjp).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from . import tape as _tape
+
+
+def _tensor_cls():
+    from ..core.tensor import Tensor
+    return Tensor
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_non_differentiable(self, *tensors):
+        self.non_differentiable.update(id(t) for t in tensors)
+
+
+class _PyLayerMeta(type):
+    def __call__(cls, *args, **kwargs):
+        raise RuntimeError(
+            f"call {cls.__name__}.apply(...), not the class itself")
+
+
+class PyLayer(metaclass=_PyLayerMeta):
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        Tensor = _tensor_cls()
+        ctx = PyLayerContext()
+        inputs = [a for a in args if isinstance(a, Tensor)] + \
+                 [v for v in kwargs.values() if isinstance(v, Tensor)]
+        diff_inputs = [t for t in inputs
+                       if (not t.stop_gradient or t._node is not None)]
+
+        with _tape.no_grad():
+            out = cls.forward(ctx, *args, **kwargs)
+
+        single = isinstance(out, Tensor)
+        outs = [out] if single else list(out)
+        need_grad = _tape.grad_enabled() and bool(diff_inputs)
+        if need_grad:
+            out_avals = [(o._data.shape, o._data.dtype) for o in outs]
+            import jax.tree_util as jtu
+            _, treedef = jtu.tree_flatten([0] * len(outs))
+
+            def vjp_fn(cotangents):
+                Tensor = _tensor_cls()
+                grads = [Tensor(g, stop_gradient=True) for g in cotangents]
+                with _tape.no_grad():
+                    in_grads = cls.backward(ctx, *grads)
+                if isinstance(in_grads, Tensor) or in_grads is None:
+                    in_grads = (in_grads,)
+                result = []
+                gi = iter(in_grads)
+                for t in diff_inputs:
+                    g = next(gi, None)
+                    if g is None:
+                        import jax.numpy as jnp
+                        result.append(jnp.zeros(t._data.shape, t._data.dtype))
+                    else:
+                        result.append(g._data if isinstance(g, Tensor) else g)
+                return tuple(result)
+
+            node = _tape.GradNode(f"pylayer:{cls.__name__}", vjp_fn,
+                                  diff_inputs, out_avals, treedef)
+            for i, o in enumerate(outs):
+                if id(o) not in ctx.non_differentiable:
+                    o._node = node
+                    o._out_index = i
+                    o.stop_gradient = False
+        return out if single else type(out)(outs) if isinstance(out, (list, tuple)) else outs
+
+
+def once_differentiable(fn):
+    return fn

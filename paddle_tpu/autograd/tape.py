@@ -1,0 +1,245 @@
+"""Eager autograd engine: a real tape over per-op JAX VJPs.
+
+TPU-native redesign of the reference's eager autograd
+(paddle/fluid/eager/grad_node_info.h:197 `GradNodeBase`,
+paddle/fluid/eager/backward.cc:105,439 `RunBackward`): instead of generated
+C++ grad nodes per op, every differentiable eager op records one `GradNode`
+holding the `jax.vjp` pullback of its pure-JAX forward function. Backward is
+a reverse-topological sweep (nodes carry a monotonic sequence id, so sorting
+by id descending is a valid topological order of the DAG).
+
+This gives full eager semantics the functional substrate lacks on its own:
+``stop_gradient``, ``retain_graph``, gradient accumulation into ``.grad``,
+tensor hooks, and ``PyLayer`` — while the math inside every node is still
+pure JAX, so the same ops trace cleanly under ``jax.jit``/``jax.grad``.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.grad_enabled = True
+
+
+_state = _TapeState()
+_seq = itertools.count()
+
+
+def grad_enabled() -> bool:
+    return _state.grad_enabled
+
+
+def set_grad_enabled(mode: bool) -> bool:
+    prev = _state.grad_enabled
+    _state.grad_enabled = bool(mode)
+    return prev
+
+
+class no_grad:
+    """Context manager / decorator disabling gradient recording."""
+
+    def __enter__(self):
+        self._prev = set_grad_enabled(False)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+    def __call__(self, fn):
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = set_grad_enabled(True)
+        return self
+
+    def __exit__(self, *exc):
+        set_grad_enabled(self._prev)
+        return False
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    vjp_fn: pullback taking the output-cotangent pytree and returning a tuple
+    of cotangents, one per differentiable input tensor.
+    """
+
+    __slots__ = ("op_name", "vjp_fn", "inputs", "out_avals", "out_treedef",
+                 "id", "__weakref__")
+
+    def __init__(self, op_name: str, vjp_fn: Callable, inputs: Sequence,
+                 out_avals: List, out_treedef):
+        self.op_name = op_name
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # Tensors (strong refs keep graph alive)
+        self.out_avals = out_avals  # [(shape, dtype)] per flat output leaf
+        self.out_treedef = out_treedef
+        self.id = next(_seq)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+
+
+def _accumulate(slot, idx, value):
+    cur = slot[idx]
+    slot[idx] = value if cur is None else cur + value
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+    """Run backward from output tensor(s), accumulating into leaf ``.grad``.
+
+    Mirrors the reference's ``egr::Backward`` semantics
+    (paddle/fluid/eager/backward.cc:439): default cotangent of ones for
+    scalar outputs, accumulation into leaves, optional graph retention.
+    """
+    from ..core.tensor import Tensor  # local import to avoid cycle
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    # Seed cotangents.
+    pending = {}  # node -> list[Optional[array]] per output leaf
+    roots = []
+    for t, g in zip(tensors, grad_tensors):
+        if t.stop_gradient and t._node is None:
+            continue
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "grad can be implicitly created only for scalar outputs; "
+                    f"got shape {t.shape}")
+            g_arr = jnp.ones(t._data.shape, t._data.dtype)
+        else:
+            g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        node = t._node
+        if node is None:
+            _leaf_accumulate(t, g_arr)
+            continue
+        if node not in pending:
+            pending[node] = [None] * len(node.out_avals)
+        _accumulate(pending[node], t._out_index, g_arr)
+        roots.append(node)
+
+    if not roots:
+        return
+
+    # Collect reachable subgraph.
+    seen = set()
+    stack = list(roots)
+    nodes = []
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        nodes.append(n)
+        for inp in n.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+    nodes.sort(key=lambda n: n.id, reverse=True)
+
+    for node in nodes:
+        cots = pending.get(node)
+        if cots is None or all(c is None for c in cots):
+            continue
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"trying to backward through op '{node.op_name}' a second "
+                "time; set retain_graph=True if you need to")
+        # Fill missing output cotangents with zeros.
+        full = [c if c is not None else jnp.zeros(shape, dtype)
+                for c, (shape, dtype) in zip(cots, node.out_avals)]
+        cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, full)
+        in_grads = node.vjp_fn(cot_tree)
+        for inp, g in zip(node.inputs, in_grads):
+            g = inp._apply_grad_hooks(g)
+            child = inp._node
+            if child is None:
+                _leaf_accumulate(inp, g)
+            else:
+                if child not in pending:
+                    pending[child] = [None] * len(child.out_avals)
+                _accumulate(pending[child], inp._out_index, g)
+                if inp._retain_grads:
+                    _leaf_accumulate(inp, g)
+        if not retain_graph:
+            node.release()
+        pending.pop(node, None)
+
+
+def _leaf_accumulate(t, g_arr):
+    from ..core.tensor import Tensor
+
+    if t.stop_gradient and not t._retain_grads:
+        return
+    if g_arr.dtype != t._data.dtype:
+        g_arr = g_arr.astype(t._data.dtype)
+    if t._grad is None:
+        t._grad = Tensor(g_arr, stop_gradient=True)
+    else:
+        t._grad = Tensor(t._grad._data + g_arr, stop_gradient=True)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph: bool = False, only_inputs: bool = True,
+         allow_unused: bool = False):
+    """Functional gradient API (reference: python/paddle/autograd, `GeneralGrad`
+    in paddle/fluid/eager/backward.cc). Returns grads of outputs w.r.t. inputs
+    without polluting ``.grad`` of other leaves.
+    """
+    from ..core.tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (higher-order eager grad) is not supported yet; "
+            "use paddle_tpu.incubate.functional jax transforms instead")
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if retain_graph is None:
+        retain_graph = False
+
+    # Temporarily stash and clear .grad on the inputs, run backward with
+    # retain_grads forced on inputs, then restore.
+    saved = [(t, t._grad, t._retain_grads, t.stop_gradient) for t in inputs]
+    try:
+        for t in inputs:
+            t._grad = None
+            t._retain_grads = True
+        backward(outputs, grad_outputs, retain_graph=retain_graph)
+        results = []
+        for t in inputs:
+            if t._grad is None and not allow_unused:
+                raise RuntimeError(
+                    "one of the input tensors received no gradient; pass "
+                    "allow_unused=True to get None instead")
+            results.append(t._grad)
+        return results
+    finally:
+        for t, g, rg, sg in saved:
+            t._grad = g
+            t._retain_grads = rg
+            t.stop_gradient = sg

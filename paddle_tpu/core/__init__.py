@@ -1,0 +1,2 @@
+from . import dtype, errors, flags, generator
+from .tensor import Tensor, Parameter

@@ -1,0 +1,162 @@
+"""Data types for the TPU-native framework.
+
+Mirrors the reference's dtype surface (paddle/phi/common/data_type.h and
+python `paddle.float32`-style module attributes) on top of numpy/jax dtypes.
+TPU-first: bfloat16 is a first-class dtype; float64 is supported but
+discouraged (XLA emulates it slowly on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+
+class DType:
+    """A framework dtype. Wraps a numpy dtype; compares equal to strings,
+    numpy dtypes, and other DType instances."""
+
+    __slots__ = ("name", "np_dtype")
+
+    _registry: dict = {}
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+        DType._registry[name] = self
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.name == other.name
+        if isinstance(other, str):
+            return self.name == other or _ALIASES.get(other) == self.name
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __ne__(self, other):
+        r = self.__eq__(other)
+        return NotImplemented if r is NotImplemented else not r
+
+    def __hash__(self):
+        return hash(self.name)
+
+    @property
+    def itemsize(self) -> int:
+        return self.np_dtype.itemsize
+
+    def is_floating(self) -> bool:
+        return self.name in ("float16", "bfloat16", "float32", "float64",
+                             "float8_e4m3fn", "float8_e5m2")
+
+    def is_complex(self) -> bool:
+        return self.name in ("complex64", "complex128")
+
+    def is_integer(self) -> bool:
+        return self.name in ("int8", "int16", "int32", "int64", "uint8",
+                             "uint16", "uint32", "uint64")
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", ml_dtypes.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", ml_dtypes.float8_e5m2)
+uint16 = DType("uint16", np.uint16)
+uint32 = DType("uint32", np.uint32)
+uint64 = DType("uint64", np.uint64)
+
+_ALIASES = {"float": "float32", "double": "float64", "half": "float16",
+            "int": "int32", "long": "int64", "bool_": "bool"}
+
+_BY_NP = {d.np_dtype: d for d in DType._registry.values()}
+# np.bool_ and bool both map
+_BY_NP[np.dtype(bool)] = bool_
+
+
+def to_framework_dtype(d) -> DType:
+    """Convert any dtype-like (str, np.dtype, jnp dtype, DType) to DType."""
+    if d is None:
+        return None
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        name = _ALIASES.get(d, d)
+        try:
+            return DType._registry[name]
+        except KeyError:
+            raise ValueError(f"unknown dtype: {d!r}") from None
+    npd = np.dtype(d)
+    try:
+        return _BY_NP[npd]
+    except KeyError:
+        raise ValueError(f"unsupported dtype: {d!r}") from None
+
+
+def to_jax_dtype(d):
+    """Convert dtype-like to a numpy dtype usable by jax.numpy.
+
+    TPU-first canonicalization: 64-bit ints/floats are stored as 32-bit
+    (JAX's default x64-disabled world; the TPU has no fast int64/float64
+    path). The API accepts 'int64'/'float64' everywhere for reference parity
+    but computes in 32-bit, like jax itself.
+    """
+    if d is None:
+        return None
+    npd = to_framework_dtype(d).np_dtype
+    import jax
+    if not jax.config.jax_enable_x64:
+        npd = _X64_NARROW.get(npd, npd)
+    return npd
+
+
+_X64_NARROW = {
+    np.dtype(np.int64): np.dtype(np.int32),
+    np.dtype(np.uint64): np.dtype(np.uint32),
+    np.dtype(np.float64): np.dtype(np.float32),
+    np.dtype(np.complex128): np.dtype(np.complex64),
+}
+
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = to_framework_dtype(d)
+    if not d.is_floating():
+        raise TypeError(f"default dtype must be floating, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype() -> str:
+    return _default_dtype.name
+
+
+def default_float_dtype() -> DType:
+    return _default_dtype
+
+
+def promote_types(a: DType, b: DType) -> DType:
+    return to_framework_dtype(jnp.promote_types(a.np_dtype, b.np_dtype))
+
+
+def iinfo(d):
+    return np.iinfo(to_jax_dtype(d))
+
+
+def finfo(d):
+    return ml_dtypes.finfo(to_jax_dtype(d))

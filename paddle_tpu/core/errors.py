@@ -1,0 +1,63 @@
+"""Typed error hierarchy + enforce helpers.
+
+TPU-native analogue of the reference's PADDLE_ENFORCE machinery
+(paddle/phi/core/enforce.h, paddle/common/errors.h): typed exceptions plus
+``enforce_*`` check helpers that raise with useful context.
+"""
+from __future__ import annotations
+
+
+class FrameworkError(Exception):
+    pass
+
+
+class InvalidArgumentError(FrameworkError, ValueError):
+    pass
+
+
+class NotFoundError(FrameworkError, KeyError):
+    pass
+
+
+class OutOfRangeError(FrameworkError, IndexError):
+    pass
+
+
+class AlreadyExistsError(FrameworkError):
+    pass
+
+
+class PermissionDeniedError(FrameworkError):
+    pass
+
+
+class UnimplementedError(FrameworkError, NotImplementedError):
+    pass
+
+
+class UnavailableError(FrameworkError, RuntimeError):
+    pass
+
+
+class FatalError(FrameworkError, RuntimeError):
+    pass
+
+
+class PreconditionNotMetError(FrameworkError, RuntimeError):
+    pass
+
+
+def enforce(cond, msg: str, exc=InvalidArgumentError):
+    if not cond:
+        raise exc(msg)
+
+
+def enforce_eq(a, b, msg: str = "", exc=InvalidArgumentError):
+    if a != b:
+        raise exc(f"expected {a!r} == {b!r}. {msg}")
+
+
+def enforce_shape_rank(shape, rank: int, name: str = "input"):
+    if len(shape) != rank:
+        raise InvalidArgumentError(
+            f"{name} expected rank {rank}, got rank {len(shape)} (shape {list(shape)})")

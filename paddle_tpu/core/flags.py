@@ -1,0 +1,87 @@
+"""Global runtime flag registry.
+
+TPU-native analogue of the reference's exported-flag machinery
+(paddle/common/flags.h:337-362 `GetExportedFlagInfoMap`,
+PHI_DEFINE_EXPORTED_* macros): a process-wide registry of typed flags,
+overridable from the environment as ``FLAGS_<name>`` and from Python via
+``get_flags``/``set_flags`` (python/paddle/base/framework.py:132,157 in the
+reference).
+"""
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterable, Optional
+
+
+@dataclass
+class FlagInfo:
+    name: str
+    default: Any
+    type: type
+    help: str
+    value: Any
+
+
+_FLAGS: Dict[str, FlagInfo] = {}
+_LOCK = threading.Lock()
+
+
+def _parse(tp: type, raw: str):
+    if tp is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return tp(raw)
+
+
+def define_flag(name: str, default, help: str = "", type: Optional[type] = None):
+    """Register a flag. Environment variable FLAGS_<name> overrides default."""
+    tp = type or (bool if isinstance(default, bool) else default.__class__)
+    value = default
+    env = os.environ.get("FLAGS_" + name)
+    if env is not None:
+        value = _parse(tp, env)
+    with _LOCK:
+        _FLAGS[name] = FlagInfo(name, default, tp, help, value)
+    return value
+
+
+def get_flags(flags) -> Dict[str, Any]:
+    """Return {name: value} for a flag name or list of names."""
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f[6:] if f.startswith("FLAGS_") else f
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag: {f}")
+        out[f] = _FLAGS[key].value
+    return out
+
+
+def set_flags(flags: Dict[str, Any]):
+    """Set flag values from a {name: value} dict."""
+    for name, v in flags.items():
+        key = name[6:] if name.startswith("FLAGS_") else name
+        if key not in _FLAGS:
+            raise ValueError(f"unknown flag: {name}")
+        info = _FLAGS[key]
+        info.value = _parse(info.type, v) if isinstance(v, str) and info.type is not str else info.type(v)
+
+
+def get_flag(name: str):
+    return _FLAGS[name].value
+
+
+def all_flags() -> Iterable[FlagInfo]:
+    return list(_FLAGS.values())
+
+
+# Core flags (subset mirroring the reference's most-used ones).
+define_flag("check_nan_inf", False, "check op outputs for NaN/Inf after each eager op")
+define_flag("default_device", "", "preferred device: 'tpu', 'cpu', or '' for auto")
+define_flag("eager_log_ops", False, "log every eager op dispatch (debugging)")
+define_flag("amp_dtype", "bfloat16", "low-precision dtype used by amp.auto_cast on TPU")
+define_flag("allocator_strategy", "xla", "memory management is delegated to XLA on TPU")
+define_flag("jit_static_shapes", True, "pad/bucket dynamic batch shapes in jit capture")
+define_flag("use_pallas_kernels", True, "use Pallas kernels for hot ops (flash attention etc.) on TPU")

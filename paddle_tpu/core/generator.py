@@ -1,0 +1,57 @@
+"""Seeded RNG state.
+
+TPU-native analogue of `phi::Generator` (paddle/phi/core/generator.h): the
+reference keeps a per-device Philox state; JAX's threefry keys are already
+counter-based, so the global generator holds one key and splits it per draw.
+Inside jit-captured code, ops take explicit keys instead (functional style);
+this stateful generator serves the eager API surface.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        with getattr(self, "_lock", threading.Lock()):
+            self._seed = int(seed)
+            self._key = jax.random.PRNGKey(int(seed))
+        return self
+
+    def seed(self):
+        return self._seed
+
+    def next_key(self):
+        with self._lock:
+            self._key, sub = jax.random.split(self._key)
+            return sub
+
+    def get_state(self):
+        return np.asarray(self._key)
+
+    def set_state(self, state):
+        import jax.numpy as jnp
+        self._key = jnp.asarray(state, dtype=jnp.uint32)
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(value: int) -> Generator:
+    """Set the global RNG seed (paddle.seed)."""
+    return _default_generator.manual_seed(value)
+
+
+def next_key():
+    return _default_generator.next_key()

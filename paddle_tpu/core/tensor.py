@@ -1,0 +1,394 @@
+"""The Tensor facade over jax.Array.
+
+TPU-native redesign of the reference's `paddle::Tensor`
+(paddle/phi/api/include/tensor.h:82) + `AutogradMeta`
+(paddle/fluid/eager/autograd_meta.h): one Python object wrapping an immutable
+`jax.Array` plus autograd metadata (tape node link, ``.grad``, hooks,
+``stop_gradient``). All math lives in pure-JAX op functions (paddle_tpu.ops);
+in-place APIs rebind ``_data`` functionally.
+
+Tensor is a registered JAX pytree, so user functions over Tensors can be
+passed straight to jax.jit / shard_map; the autograd metadata is dropped at
+the trace boundary (matching the reference, where DenseTensor crossing into a
+static program loses its eager grad node).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from . import dtype as dtypes
+from ..autograd import tape as _tape
+
+
+class Tensor:
+    __slots__ = ("_data", "stop_gradient", "_grad", "_node", "_out_index",
+                 "_grad_hooks", "_retain_grads", "name", "persistable",
+                 "__weakref__")
+
+    def __init__(self, data, stop_gradient: bool = True, name: str = ""):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            data = _np_to_jax(data)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self._grad = None
+        self._node = None
+        self._out_index = 0
+        self._grad_hooks = None
+        self._retain_grads = False
+        self.name = name
+        self.persistable = False
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def data(self):
+        return self._data
+
+    @data.setter
+    def data(self, value):
+        self._data = value._data if isinstance(value, Tensor) else value
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    # paddle: Tensor.size is numel (an int), not a method
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape)) if self._data.shape else 1
+
+    @property
+    def dtype(self) -> dtypes.DType:
+        return dtypes.to_framework_dtype(self._data.dtype)
+
+    @property
+    def place(self):
+        try:
+            devs = getattr(self._data, "devices", None)
+            if devs is None:
+                return "traced"
+            return str(next(iter(self._data.devices())))
+        except Exception:
+            return "traced"
+
+    @property
+    def T(self) -> "Tensor":
+        from .. import ops
+        return ops.transpose(self, list(range(self.ndim))[::-1])
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    @property
+    def grad(self) -> Optional["Tensor"]:
+        return self._grad
+
+    @grad.setter
+    def grad(self, value):
+        if value is not None and not isinstance(value, Tensor):
+            value = Tensor(value)
+        self._grad = value
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self, *args):
+        return self._data.item(*args)
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def astype(self, dt) -> "Tensor":
+        from .. import ops
+        return ops.cast(self, dt)
+
+    cast = astype
+
+    def to(self, *args, **kwargs) -> "Tensor":
+        """to(dtype) / to(device) / to(device, dtype). Device moves use
+        jax.device_put; 'cpu'/'tpu' strings accepted."""
+        out = self
+        for a in list(args) + list(kwargs.values()):
+            if isinstance(a, (str, dtypes.DType)) and _is_dtype_like(a):
+                out = out.astype(a)
+            elif isinstance(a, str):
+                dev = _resolve_device(a)
+                out = Tensor(jax.device_put(out._data, dev),
+                             stop_gradient=out.stop_gradient)
+        return out
+
+    def cpu(self):
+        return self.to("cpu")
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._data, stop_gradient=True, name=self.name)
+
+    def clone(self) -> "Tensor":
+        from .. import ops
+        return ops.assign(self)
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return self.dtype.itemsize
+
+    def dim(self) -> int:
+        return self.ndim
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _tape.backward(self, grad_tensor, retain_graph=retain_graph)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self._grad is not None:
+            self._grad = Tensor(jnp.zeros_like(self._data))
+        else:
+            self._grad = None
+
+    def retain_grads(self):
+        self._retain_grads = True
+
+    def register_hook(self, hook: Callable):
+        if self._grad_hooks is None:
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+
+        class _Handle:
+            def __init__(self, hooks, h):
+                self._hooks, self._h = hooks, h
+
+            def remove(self):
+                if self._h in self._hooks:
+                    self._hooks.remove(self._h)
+
+        return _Handle(self._grad_hooks, hook)
+
+    def _apply_grad_hooks(self, g_arr):
+        if not self._grad_hooks:
+            return g_arr
+        g = Tensor(g_arr, stop_gradient=True)
+        for hook in self._grad_hooks:
+            out = hook(g)
+            if out is not None:
+                g = out if isinstance(out, Tensor) else Tensor(out)
+        return g._data
+
+    # -- in-place-style APIs (functional rebind) ----------------------------
+    def set_value(self, value):
+        arr = value._data if isinstance(value, Tensor) else _np_to_jax(value)
+        self._data = arr.astype(self._data.dtype) if arr.dtype != self._data.dtype else arr
+
+    def copy_(self, other):
+        self.set_value(other)
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        return self
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        return self
+
+    def scale_(self, scale):
+        self._data = self._data * scale
+        return self
+
+    def add_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data + o
+        return self
+
+    def subtract_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data - o
+        return self
+
+    def multiply_(self, other):
+        o = other._data if isinstance(other, Tensor) else other
+        self._data = self._data * o
+        return self
+
+    def clip_(self, min=None, max=None):
+        self._data = jnp.clip(self._data, min, max)
+        return self
+
+    # -- indexing -----------------------------------------------------------
+    def __getitem__(self, idx):
+        from ..ops import registry
+        idx = _unwrap_index(idx)
+        return registry.call_op("getitem", lambda x: x[idx], (self,), {})
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        v = value._data if isinstance(value, Tensor) else value
+        self._data = self._data.at[idx].set(v)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- python protocol ----------------------------------------------------
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._data)!r})")
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError(
+                "The truth value of a Tensor with more than one element is "
+                "ambiguous. Use .any() or .all()")
+        return bool(self._data)
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __index__(self):
+        return int(self._data)
+
+    def __hash__(self):
+        return id(self)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __format__(self, spec):
+        if self.size == 1:
+            return format(self.item(), spec)
+        return repr(self)
+
+    # Arithmetic dunders are installed by paddle_tpu.ops at import time.
+
+
+class Parameter(Tensor):
+    """A trainable Tensor (reference: paddle Parameter / EagerParamBase)."""
+
+    __slots__ = ("trainable", "optimize_attr", "regularizer", "is_distributed")
+
+    def __init__(self, data, trainable: bool = True, name: str = ""):
+        super().__init__(data, stop_gradient=not trainable, name=name)
+        self.trainable = trainable
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.is_distributed = False
+        self.persistable = True
+
+    @property
+    def requires_grad(self):
+        return not self.stop_gradient
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# -- helpers ---------------------------------------------------------------
+
+def _np_to_jax(data):
+    """Convert python/numpy data to a jax array with paddle-style defaults:
+    python floats -> default float dtype (float32), ints -> int64."""
+    if isinstance(data, (bool, int, float, complex)) or (
+            isinstance(data, (list, tuple)) or isinstance(data, np.ndarray)):
+        arr = np.asarray(data)
+        if arr.dtype == np.float64 and not isinstance(data, np.ndarray):
+            arr = arr.astype(dtypes.default_float_dtype().np_dtype)
+        return jnp.asarray(arr)
+    return jnp.asarray(data)
+
+
+def _is_dtype_like(a) -> bool:
+    if isinstance(a, dtypes.DType):
+        return True
+    try:
+        dtypes.to_framework_dtype(a)
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+def _resolve_device(name: str):
+    name = name.lower().split(":")[0]
+    for d in jax.devices():
+        if d.platform in (name, {"gpu": "cuda"}.get(name, name)):
+            return d
+    for d in jax.local_devices(backend="cpu"):
+        return d
+    raise ValueError(f"no device matching {name!r}")
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(i._data if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+# -- pytree registration ---------------------------------------------------
+
+def _tensor_flatten(t: Tensor):
+    return (t._data,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    t = Tensor.__new__(Tensor)
+    t._data = children[0]
+    t.stop_gradient, t.name = aux
+    t._grad = None
+    t._node = None
+    t._out_index = 0
+    t._grad_hooks = None
+    t._retain_grads = False
+    t.persistable = False
+    return t
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+def _param_flatten(p: Parameter):
+    return (p._data,), (p.stop_gradient, p.name)
+
+
+def _param_unflatten(aux, children):
+    p = Parameter.__new__(Parameter)
+    p._data = children[0]
+    p.stop_gradient, p.name = aux
+    p._grad = None
+    p._node = None
+    p._out_index = 0
+    p._grad_hooks = None
+    p._retain_grads = False
+    p.persistable = True
+    p.trainable = not p.stop_gradient
+    p.optimize_attr = {"learning_rate": 1.0}
+    p.regularizer = None
+    p.is_distributed = False
+    return p
+
+
+jax.tree_util.register_pytree_node(Parameter, _param_flatten, _param_unflatten)

@@ -1,0 +1,65 @@
+"""Device/runtime plumbing (reference: python/paddle/device/,
+python/paddle/framework/). On TPU, device management is jax's: one process
+sees its local TPU chips; placement is explicit via device_put/shardings."""
+from __future__ import annotations
+
+import jax
+
+from ..core.flags import get_flag
+
+_current_device = None
+
+
+def _auto_device():
+    devs = jax.devices()
+    pref = get_flag("default_device")
+    if pref:
+        for d in devs:
+            if d.platform == pref:
+                return d
+    return devs[0]
+
+
+def get_default_device():
+    global _current_device
+    if _current_device is None:
+        _current_device = _auto_device()
+    return _current_device
+
+
+def set_device(device: str):
+    """paddle.device.set_device — accepts 'tpu', 'tpu:0', 'cpu', 'gpu:0'."""
+    global _current_device
+    name = device.lower()
+    plat, _, idx = name.partition(":")
+    plat = {"gpu": "cuda", "xpu": "tpu"}.get(plat, plat)
+    idx = int(idx) if idx else 0
+    cands = [d for d in jax.devices() if d.platform == plat] or \
+            ([d for d in jax.local_devices(backend="cpu")] if plat == "cpu" else [])
+    if not cands:
+        # tolerate 'tpu' requests on CPU-only test rigs: fall back
+        cands = jax.devices()
+    _current_device = cands[min(idx, len(cands) - 1)]
+    return _current_device
+
+
+def get_device() -> str:
+    d = get_default_device()
+    return f"{d.platform}:{getattr(d, 'id', 0)}"
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def is_compiled_with_tpu() -> bool:
+    return any(d.platform == "tpu" for d in jax.devices())
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_cinn() -> bool:
+    # XLA plays CINN's role; report True for API parity of capability checks
+    return True

@@ -1,0 +1,14 @@
+"""paddle_tpu.io — data pipeline (reference: python/paddle/io/).
+
+TPU-native design: workers produce host numpy batches; the loader overlaps
+host collation with device compute via a background prefetch thread and
+`jax.device_put` (double buffering). Under SPMD the distributed sampler
+shards indices per data-parallel rank, matching the reference's
+DistributedBatchSampler (python/paddle/io/dataloader/batch_sampler.py).
+"""
+from .dataset import (Dataset, IterableDataset, TensorDataset, ComposeDataset,
+                      ChainDataset, Subset, random_split, ConcatDataset)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler, SubsetRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info

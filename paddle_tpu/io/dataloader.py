@@ -1,0 +1,159 @@
+"""DataLoader (reference: python/paddle/io/reader.py:262,
+io/dataloader/dataloader_iter.py + worker.py).
+
+TPU-native redesign: instead of the reference's multiprocess workers +
+shared-memory LoDTensor queues, worker threads (or a multiprocess pool for
+CPU-heavy transforms) collate numpy batches and a prefetch thread pipelines
+them; arrays stay on host until the training loop (or the jitted step's
+device_put) pulls them — on TPU the h2d copy overlaps with the previous
+step's compute thanks to XLA's async dispatch.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+_worker_info = threading.local()
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def default_collate_fn(batch: List[Any]):
+    """Stack samples into batch arrays (reference:
+    python/paddle/io/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([s._data for s in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, float, np.number)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(default_collate_fn(list(items))
+                            for items in zip(*batch))
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list: bool = True, batch_sampler=None, batch_size=1,
+                 shuffle: bool = False, drop_last: bool = False,
+                 collate_fn: Optional[Callable] = None, num_workers: int = 0,
+                 use_buffer_reader: bool = True, prefetch_factor: int = 2,
+                 use_shared_memory: bool = True, timeout: int = 0,
+                 worker_init_fn: Optional[Callable] = None,
+                 persistent_workers: bool = False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = max(0, num_workers)
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self._iterable = isinstance(dataset, IterableDataset)
+        if self._iterable:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(dataset, shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def __iter__(self):
+        if self._iterable:
+            return self._iter_iterable()
+        if self.num_workers == 0:
+            return self._iter_single()
+        return self._iter_threaded()
+
+    def _iter_iterable(self):
+        it = iter(self.dataset)
+        while True:
+            batch = list(itertools.islice(it, self.batch_size))
+            if not batch:
+                return
+            if len(batch) < self.batch_size and self.drop_last:
+                return
+            yield self.collate_fn(batch)
+
+    def _iter_single(self):
+        for indices in self.batch_sampler:
+            yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_threaded(self):
+        """Prefetching pipeline: worker threads collate; a bounded queue
+        gives `prefetch_factor * num_workers` batches in flight."""
+        out_q: "queue.Queue" = queue.Queue(
+            maxsize=self.prefetch_factor * self.num_workers)
+        idx_q: "queue.Queue" = queue.Queue()
+        n_batches = 0
+        for i, indices in enumerate(self.batch_sampler):
+            idx_q.put((i, indices))
+            n_batches += 1
+        stop = threading.Event()
+
+        def worker(wid):
+            _worker_info.info = WorkerInfo(wid, self.num_workers, self.dataset)
+            if self.worker_init_fn:
+                self.worker_init_fn(wid)
+            while not stop.is_set():
+                try:
+                    i, indices = idx_q.get_nowait()
+                except queue.Empty:
+                    return
+                try:
+                    batch = self.collate_fn(
+                        [self.dataset[j] for j in indices])
+                    out_q.put((i, batch))
+                except Exception as e:  # surface worker errors
+                    out_q.put((i, e))
+
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+                   for w in range(self.num_workers)]
+        for t in threads:
+            t.start()
+        try:
+            # reorder into sequential batch order
+            pending = {}
+            next_idx = 0
+            received = 0
+            while received < n_batches:
+                i, batch = out_q.get()
+                received += 1
+                pending[i] = batch
+                while next_idx in pending:
+                    b = pending.pop(next_idx)
+                    next_idx += 1
+                    if isinstance(b, Exception):
+                        raise b
+                    yield b
+        finally:
+            stop.set()

@@ -1,0 +1,35 @@
+"""paddle_tpu.nn — neural network layers (reference: python/paddle/nn/)."""
+from . import functional
+from . import initializer
+from .layer import Layer, LayerList, ParameterList, Sequential
+from .initializer import ParamAttr
+from .modules_basic import (
+    Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout, Flatten,
+    Identity, Upsample, PixelShuffle, Pad1D, Pad2D, Pad3D, Bilinear,
+    CosineSimilarity, ReLU, ReLU6, GELU, SiLU, Swish, LeakyReLU, ELU, SELU,
+    CELU, Hardshrink, Softshrink, Tanhshrink, Hardtanh, Hardsigmoid,
+    Hardswish, Mish, Softplus, Softmax, LogSoftmax, Sigmoid, LogSigmoid,
+    Tanh, Softsign, Maxout, GLU, PReLU,
+)
+from .modules_conv import (
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose,
+    Conv3DTranspose, MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D,
+    AvgPool3D, AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+)
+from .modules_norm import (
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm,
+)
+from .modules_loss import (
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    SmoothL1Loss, KLDivLoss, MarginRankingLoss, CTCLoss,
+)
+from .modules_transformer import (
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .modules_rnn import (
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, LSTM, GRU, SimpleRNN,
+)
+from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue

@@ -1,0 +1,63 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py).
+
+ClipGradByGlobalNorm matches the reference's hybrid-parallel-aware semantics
+at the optimizer level: the global norm is over all grads the optimizer sees;
+under SPMD sharding, jnp reductions over sharded grads are already global
+(XLA inserts the cross-device psum), so no per-group allreduce code is
+needed — that's the TPU-native replacement for
+HybridParallelClipGrad (python/paddle/distributed/fleet/meta_optimizers/
+dygraph_optimizer/hybrid_parallel_optimizer.py).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _apply(self, params_grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        return self._apply(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = -max if min is None else min
+
+    def _apply(self, params_grads):
+        return [(p, None if g is None else jnp.clip(g, self.min, self.max))
+                for p, g in params_grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = clip_norm
+
+    def _apply(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g)))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((p, g * scale))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def _apply(self, params_grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for _, g in params_grads if g is not None]
+        if not sq:
+            return params_grads
+        global_norm = jnp.sqrt(sum(sq))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [(p, None if g is None else (g * scale).astype(g.dtype))
+                for p, g in params_grads]

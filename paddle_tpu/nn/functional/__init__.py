@@ -1,0 +1,28 @@
+"""paddle_tpu.nn.functional — functional ops namespace
+(reference: python/paddle/nn/functional/__init__.py)."""
+from .activation import (relu, relu6, gelu, silu, swish, leaky_relu, elu,
+                         selu, celu, prelu, hardshrink, softshrink,
+                         tanhshrink, hardtanh, hardsigmoid, hardswish, mish,
+                         softplus, softmax, log_softmax, maxout, glu, swiglu,
+                         rrelu)
+from ...ops.math import sigmoid, log_sigmoid, softsign, tanh
+from .common import (linear, embedding, dropout, dropout2d, dropout3d,
+                     alpha_dropout, cosine_similarity, normalize,
+                     scaled_dot_product_attention, flash_attention,
+                     label_smooth, interpolate, upsample, pixel_shuffle,
+                     pixel_unshuffle, channel_shuffle, bilinear)
+from .conv import (conv1d, conv2d, conv3d, conv1d_transpose, conv2d_transpose,
+                   conv3d_transpose, max_pool1d, max_pool2d, max_pool3d,
+                   avg_pool1d, avg_pool2d, avg_pool3d, adaptive_avg_pool1d,
+                   adaptive_avg_pool2d, adaptive_max_pool2d)
+from .norm import (layer_norm, rms_norm, batch_norm, group_norm,
+                   instance_norm, local_response_norm)
+from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
+                   mse_loss, l1_loss, smooth_l1_loss, binary_cross_entropy,
+                   binary_cross_entropy_with_logits, kl_div,
+                   hinge_embedding_loss, margin_ranking_loss,
+                   cosine_embedding_loss, triplet_margin_loss,
+                   square_error_cost, sigmoid_focal_loss, ctc_loss)
+from ...ops.creation import one_hot
+from ...ops.manipulation import pad, unfold
+from ...ops.random import gumbel_softmax

@@ -1,0 +1,155 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+All pure jax.nn/jnp — XLA fuses them into adjacent matmul epilogues on TPU,
+replacing the reference's fused bias-act CUDA kernels."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op
+
+
+@register_op(name="relu")
+def relu(x, name=None):
+    return jax.nn.relu(x)
+
+
+@register_op(name="relu6")
+def relu6(x, name=None):
+    return jax.nn.relu6(x)
+
+
+@register_op(name="gelu")
+def gelu(x, approximate=False, name=None):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@register_op(name="silu")
+def silu(x, name=None):
+    return jax.nn.silu(x)
+
+
+def swish(x, name=None):
+    return silu(x)
+
+
+@register_op(name="leaky_relu")
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+@register_op(name="elu")
+def elu(x, alpha=1.0, name=None):
+    return jax.nn.elu(x, alpha)
+
+
+@register_op(name="selu")
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+@register_op(name="celu")
+def celu(x, alpha=1.0, name=None):
+    return jax.nn.celu(x, alpha)
+
+
+@register_op(name="prelu")
+def prelu(x, weight, data_format="NCHW", name=None):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+        shape = [1] * x.ndim
+        shape[ch_axis] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+@register_op(name="hardshrink")
+def hardshrink(x, threshold=0.5, name=None):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+@register_op(name="softshrink")
+def softshrink(x, threshold=0.5, name=None):
+    return jnp.where(x > threshold, x - threshold,
+                     jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+@register_op(name="tanhshrink")
+def tanhshrink(x, name=None):
+    return x - jnp.tanh(x)
+
+
+@register_op(name="hardtanh")
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op(name="hardsigmoid")
+def hardsigmoid(x, slope=1.0 / 6, offset=0.5, name=None):
+    return jnp.clip(x * slope + offset, 0.0, 1.0)
+
+
+@register_op(name="hardswish")
+def hardswish(x, name=None):
+    return x * jnp.clip(x / 6.0 + 0.5, 0.0, 1.0)
+
+
+@register_op(name="mish")
+def mish(x, name=None):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+@register_op(name="softplus")
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    scaled = x * beta
+    return jnp.where(scaled > threshold, x, jax.nn.softplus(scaled) / beta)
+
+
+@register_op(name="softmax")
+def softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtypes
+    if dtype is not None:
+        x = x.astype(dtypes.to_jax_dtype(dtype))
+    return jax.nn.softmax(x, axis=axis)
+
+
+@register_op(name="log_softmax")
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ...core import dtype as dtypes
+    if dtype is not None:
+        x = x.astype(dtypes.to_jax_dtype(dtype))
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@register_op(name="maxout")
+def maxout(x, groups, axis=1, name=None):
+    c = x.shape[axis]
+    assert c % groups == 0
+    new_shape = list(x.shape)
+    new_shape[axis] = c // groups
+    new_shape.insert(axis + 1, groups)
+    return jnp.max(x.reshape(new_shape), axis=axis + 1)
+
+
+@register_op(name="glu")
+def glu(x, axis=-1, name=None):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+@register_op(name="swiglu")
+def swiglu(x, y=None, name=None):
+    """Fused swiglu (reference: python/paddle/incubate/nn/functional/swiglu.py,
+    fused kernel paddle/phi/kernels/fusion/gpu/). XLA fuses the silu*mul."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+@register_op(name="rrelu")
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    # in eval mode rrelu is leaky_relu with mean slope (eager training mode
+    # randomness handled by dropout-style key plumbing if needed)
+    slope = (lower + upper) / 2.0
+    return jnp.where(x >= 0, x, slope * x)

@@ -1,0 +1,238 @@
+"""Common functionals: linear, embedding, dropout, attention, similarity
+(reference: python/paddle/nn/functional/common.py, input.py,
+flash_attention.py:242)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core import generator as gen
+from ...core.tensor import Tensor
+from ...ops.registry import register_op, call_op
+
+
+@register_op(name="linear")
+def linear(x, weight, bias=None, name=None):
+    # paddle weight layout: (in_features, out_features)
+    out = jnp.matmul(x, weight)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op(name="embedding")
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    out = jnp.take(weight, x, axis=0)
+    if padding_idx is not None:
+        mask = (x != padding_idx)[..., None]
+        out = out * mask.astype(out.dtype)
+    return out
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None, key=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    k = key if key is not None else gen.next_key()
+
+    def fn(arr):
+        shape = list(arr.shape)
+        if axis is not None:
+            axes = [axis] if isinstance(axis, int) else list(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, arr / (1.0 - p), 0.0).astype(arr.dtype)
+        return jnp.where(keep, arr, 0.0).astype(arr.dtype)
+
+    return call_op("dropout", fn, (x,), {})
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None, key=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training, key=key)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None, key=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training, key=key)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None, key=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    k = key if key is not None else gen.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def fn(arr):
+        keep = jax.random.bernoulli(k, 1.0 - p, arr.shape)
+        a = (1.0 / np.sqrt((1 - p) * (1 + p * alpha_p ** 2))).astype(np.float32)
+        b = -a * alpha_p * p
+        return (jnp.where(keep, arr, alpha_p) * a + b).astype(arr.dtype)
+
+    return call_op("alpha_dropout", fn, (x,), {})
+
+
+@register_op(name="cosine_similarity")
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+@register_op(name="normalize")
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    norm = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(norm, epsilon)
+
+
+@register_op(name="scaled_dot_product_attention_ref")
+def _sdpa_reference(query, key, value, attn_mask=None, dropout_p=0.0,
+                    is_causal=False, scale=None):
+    """Reference attention math in pure XLA (inputs (B, S, H, D) — the
+    reference flash_attention layout, python/paddle/nn/functional/flash_attention.py:976).
+    The Pallas flash kernel (paddle_tpu/ops/pallas/flash_attention.py) is the
+    fast path; this is the fallback + correctness oracle."""
+    b, sq, h, d = query.shape
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    q = jnp.swapaxes(query, 1, 2)  # (B, H, S, D)
+    k = jnp.swapaxes(key, 1, 2)
+    v = jnp.swapaxes(value, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if is_causal:
+        sk = k.shape[2]
+        causal = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(causal, logits, jnp.finfo(logits.dtype).min)
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits, jnp.finfo(logits.dtype).min)
+        else:
+            logits = logits + attn_mask
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(query.dtype)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    return jnp.swapaxes(out, 1, 2)  # (B, S, H, D)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    return _sdpa_reference(query, key, value, attn_mask=attn_mask,
+                           dropout_p=dropout_p, is_causal=is_causal)
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity
+    (reference python/paddle/nn/functional/flash_attention.py:242).
+    Dispatches to the Pallas TPU kernel when available, else XLA fallback."""
+    from ...core.flags import get_flag
+    out = None
+    if get_flag("use_pallas_kernels"):
+        try:
+            from ...ops.pallas import flash_attention as fa
+            out = fa.flash_attention(query, key, value, causal=causal)
+        except Exception:
+            out = None
+    if out is None:
+        out = _sdpa_reference(query, key, value, is_causal=causal)
+    return (out, None) if return_softmax is not None else out
+
+
+def linear_compress(*a, **k):
+    raise NotImplementedError
+
+
+@register_op(name="label_smooth")
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    n = label.shape[-1]
+    if prior_dist is not None:
+        return (1 - epsilon) * label + epsilon * prior_dist
+    return (1 - epsilon) * label + epsilon / n
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def fn(arr):
+        nd = arr.ndim
+        ch_first = data_format[1] == "C"
+        spatial_axes = list(range(2, nd)) if ch_first else list(range(1, nd - 1))
+        in_sizes = [arr.shape[a] for a in spatial_axes]
+        if size is not None:
+            out_sizes = [int(s) for s in (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else \
+                [scale_factor] * len(in_sizes)
+            out_sizes = [int(s * f) for s, f in zip(in_sizes, sf)]
+        new_shape = list(arr.shape)
+        for a, s in zip(spatial_axes, out_sizes):
+            new_shape[a] = s
+        method = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+                  "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if method == "nearest":
+            return jax.image.resize(arr, new_shape, method="nearest")
+        # jax.image.resize linear matches align_corners=False (half-pixel)
+        return jax.image.resize(arr, new_shape, method=method)
+
+    return call_op("interpolate", fn, (x,), {})
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+@register_op(name="pixel_shuffle")
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c // (r * r), r, r, h, w)
+        x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+        return x.reshape(n, c // (r * r), h * r, w * r)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, r, r, c // (r * r))
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h * r, w * r, c // (r * r))
+
+
+@register_op(name="pixel_unshuffle")
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, c, h // r, r, w // r, r)
+        x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
+        return x.reshape(n, c * r * r, h // r, w // r)
+    raise NotImplementedError("NHWC pixel_unshuffle")
+
+
+@register_op(name="channel_shuffle")
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NCHW":
+        n, c, h, w = x.shape
+        x = x.reshape(n, groups, c // groups, h, w)
+        x = jnp.swapaxes(x, 1, 2)
+        return x.reshape(n, c, h, w)
+    n, h, w, c = x.shape
+    x = x.reshape(n, h, w, groups, c // groups)
+    x = jnp.swapaxes(x, 3, 4)
+    return x.reshape(n, h, w, c)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def fn(a, b, w, bi=None):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return call_op("bilinear", fn, args, {})

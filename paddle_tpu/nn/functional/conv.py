@@ -1,0 +1,301 @@
+"""Convolution & pooling functionals (reference:
+python/paddle/nn/functional/conv.py, pooling.py; CUDA kernels in
+paddle/phi/kernels/gpudnn/conv_*). On TPU convs lower to XLA
+ConvGeneralDilated which maps onto the MXU directly — no cuDNN-style
+algorithm search needed (XLA picks the layout)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op, call_op
+
+
+def _pair(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+def _conv_padding(padding, spatial, strides=None, dilations=None, ksizes=None):
+    """Normalize paddle padding spec -> lax padding (list of (lo, hi))."""
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * spatial
+    padding = list(padding)
+    if len(padding) == spatial:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * spatial:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(spatial)]
+    raise ValueError(f"bad padding spec: {padding}")
+
+
+def _dim_numbers(spatial, channel_last):
+    if spatial == 1:
+        return ("NWC", "WIO" , "NWC") if channel_last else ("NCW", "OIW", "NCW")
+    if spatial == 2:
+        return ("NHWC", "HWIO", "NHWC") if channel_last else ("NCHW", "OIHW", "NCHW")
+    return ("NDHWC", "DHWIO", "NDHWC") if channel_last else ("NCDHW", "OIDHW", "NCDHW")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, spatial,
+          data_format):
+    channel_last = data_format[-1] == "C"
+    dn = _dim_numbers(spatial, channel_last)
+    # weight layout from the reference is (out_c, in_c/groups, *k)
+    rhs = weight
+    if channel_last:
+        # lax wants kernel in the dn spec layout; ours is OI*; convert
+        perm = tuple(range(2, 2 + spatial)) + (1, 0)
+        rhs = jnp.transpose(weight, perm)
+    out = jax.lax.conv_general_dilated(
+        x, rhs,
+        window_strides=_pair(stride, spatial),
+        padding=_conv_padding(padding, spatial),
+        rhs_dilation=_pair(dilation, spatial),
+        dimension_numbers=dn,
+        feature_group_count=groups,
+    )
+    if bias is not None:
+        if channel_last:
+            out = out + bias
+        else:
+            out = out + bias.reshape((1, -1) + (1,) * spatial)
+    return out
+
+
+@register_op(name="conv1d")
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 "NWC" if data_format == "NLC" else "NCW")
+
+
+@register_op(name="conv2d")
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format)
+
+
+@register_op(name="conv3d")
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format)
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, spatial, data_format):
+    channel_last = data_format[-1] == "C"
+    dn = _dim_numbers(spatial, channel_last)
+    strides = _pair(stride, spatial)
+    dils = _pair(dilation, spatial)
+    pad = _conv_padding(padding, spatial)
+    # reference weight layout for transpose: (in_c, out_c/groups, *k)
+    if groups != 1:
+        # grouped transpose: split and run per group (rare path)
+        xs = jnp.split(x, groups, axis=-1 if channel_last else 1)
+        ws = jnp.split(weight, groups, axis=0)
+        outs = [_conv_transpose(xi, wi, None, stride, padding, output_padding,
+                                dilation, 1, spatial, data_format)
+                for xi, wi in zip(xs, ws)]
+        out = jnp.concatenate(outs, axis=-1 if channel_last else 1)
+    else:
+        k = weight.shape[2:]
+        if isinstance(pad, str):
+            lax_pad = pad
+        else:
+            # convert forward-conv padding to transpose padding
+            lax_pad = [(dils[i] * (k[i] - 1) - pad[i][0],
+                        dils[i] * (k[i] - 1) - pad[i][1])
+                       for i in range(spatial)]
+        rhs = jnp.swapaxes(weight, 0, 1)  # -> (out_c, in_c, *k)
+        rhs = jnp.flip(rhs, axis=tuple(range(2, 2 + spatial)))
+        if channel_last:
+            perm = tuple(range(2, 2 + spatial)) + (1, 0)
+            rhs = jnp.transpose(rhs, perm)
+        out = jax.lax.conv_general_dilated(
+            x, rhs, window_strides=(1,) * spatial, padding=lax_pad,
+            lhs_dilation=strides, rhs_dilation=dils, dimension_numbers=dn)
+    opad = _pair(output_padding, spatial) if output_padding else (0,) * spatial
+    if any(opad):
+        pads = [(0, 0)] * out.ndim
+        for i, p in enumerate(opad):
+            ax = (1 + i) if channel_last else (2 + i)
+            pads[ax] = (0, p)
+        out = jnp.pad(out, pads)
+    if bias is not None:
+        out = out + (bias if channel_last
+                     else bias.reshape((1, -1) + (1,) * spatial))
+    return out
+
+
+@register_op(name="conv1d_transpose")
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1,
+                           "NWC" if data_format == "NLC" else "NCW")
+
+
+@register_op(name="conv2d_transpose")
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format)
+
+
+@register_op(name="conv3d_transpose")
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1, output_size=None,
+                     data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format)
+
+
+# -- pooling ----------------------------------------------------------------
+
+def _pool(x, ksize, stride, padding, spatial, data_format, reducer, init,
+          ceil_mode=False, count_include_pad=True, average=False):
+    channel_last = data_format[-1] == "C"
+    k = _pair(ksize, spatial)
+    s = _pair(stride if stride is not None else ksize, spatial)
+    pad = _conv_padding(padding, spatial)
+    if channel_last:
+        dims = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+    else:
+        dims = (1, 1) + k
+        strides = (1, 1) + s
+    if isinstance(pad, str):
+        padding_cfg = pad
+    elif channel_last:
+        padding_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+    else:
+        padding_cfg = [(0, 0), (0, 0)] + list(pad)
+    if init == -jnp.inf:
+        init_val = (jnp.finfo(x.dtype).min
+                    if jnp.issubdtype(x.dtype, jnp.floating)
+                    else jnp.iinfo(x.dtype).min)
+    else:
+        init_val = jnp.asarray(init, x.dtype)
+    out = jax.lax.reduce_window(x, init_val, reducer, dims, strides,
+                                padding_cfg)
+    if average:
+        padded = (not isinstance(pad, str)) and any(p[0] or p[1] for p in pad)
+        if not padded or count_include_pad:
+            out = out / np.prod(k)
+        else:
+            ones = jnp.ones_like(x)
+            counts = jax.lax.reduce_window(ones, jnp.asarray(0.0, x.dtype),
+                                           jax.lax.add, dims, strides,
+                                           padding_cfg)
+            out = out / counts
+    return out
+
+
+@register_op(name="max_pool2d")
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format,
+                 jax.lax.max, -jnp.inf)
+
+
+@register_op(name="avg_pool2d")
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format,
+                 jax.lax.add, 0.0, average=True,
+                 count_include_pad=not exclusive)
+
+
+@register_op(name="max_pool1d")
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW",
+                 jax.lax.max, -jnp.inf)
+
+
+@register_op(name="avg_pool1d")
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "NCW",
+                 jax.lax.add, 0.0, average=True,
+                 count_include_pad=not exclusive)
+
+
+@register_op(name="max_pool3d")
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format,
+                 jax.lax.max, -jnp.inf)
+
+
+@register_op(name="avg_pool3d")
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format,
+                 jax.lax.add, 0.0, average=True,
+                 count_include_pad=not exclusive)
+
+
+@register_op(name="adaptive_avg_pool2d")
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out = _pair(output_size, 2)
+    channel_last = data_format[-1] == "C"
+    h_ax, w_ax = (1, 2) if channel_last else (2, 3)
+    h, w = x.shape[h_ax], x.shape[w_ax]
+    if h % out[0] == 0 and w % out[1] == 0:
+        k = (h // out[0], w // out[1])
+        return _pool(x, k, k, 0, 2, data_format, jax.lax.add, 0.0, average=True)
+    # general case via resize-style mean over bins
+    return _adaptive_pool_general(x, out, h_ax, w_ax, jnp.mean)
+
+
+@register_op(name="adaptive_max_pool2d")
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _pair(output_size, 2)
+    h, w = x.shape[2], x.shape[3]
+    if h % out[0] == 0 and w % out[1] == 0:
+        k = (h // out[0], w // out[1])
+        return _pool(x, k, k, 0, 2, "NCHW", jax.lax.max, -jnp.inf)
+    return _adaptive_pool_general(x, out, 2, 3, jnp.max)
+
+
+@register_op(name="adaptive_avg_pool1d")
+def adaptive_avg_pool1d(x, output_size, name=None):
+    out = int(output_size)
+    l = x.shape[2]
+    if l % out == 0:
+        k = l // out
+        return _pool(x, k, k, 0, 1, "NCW", jax.lax.add, 0.0, average=True)
+    starts = [(i * l) // out for i in range(out)]
+    ends = [-(-((i + 1) * l) // out) for i in range(out)]
+    cols = [jnp.mean(x[:, :, s:e], axis=2) for s, e in zip(starts, ends)]
+    return jnp.stack(cols, axis=2)
+
+
+def _adaptive_pool_general(x, out, h_ax, w_ax, reduce_fn):
+    h, w = x.shape[h_ax], x.shape[w_ax]
+    rows = []
+    for i in range(out[0]):
+        hs, he = (i * h) // out[0], -(-((i + 1) * h) // out[0])
+        cols = []
+        for j in range(out[1]):
+            ws, we = (j * w) // out[1], -(-((j + 1) * w) // out[1])
+            sl = [slice(None)] * x.ndim
+            sl[h_ax] = slice(hs, he)
+            sl[w_ax] = slice(ws, we)
+            cols.append(reduce_fn(x[tuple(sl)], axis=(h_ax, w_ax)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)

@@ -1,0 +1,228 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op, call_op
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op(name="cross_entropy")
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    logits = input
+    if axis != -1 and axis != logits.ndim - 1:
+        logits = jnp.moveaxis(logits, axis, -1)
+        if soft_label:
+            label = jnp.moveaxis(label, axis, -1)
+    n_classes = logits.shape[-1]
+    logp = jax.nn.log_softmax(logits, axis=-1) if use_softmax else jnp.log(
+        jnp.maximum(logits, 1e-30))
+    if soft_label:
+        tgt = label
+        if label_smoothing:
+            tgt = tgt * (1 - label_smoothing) + label_smoothing / n_classes
+        loss = -jnp.sum(tgt * logp, axis=-1)
+        return _reduce(loss, reduction)
+    lbl = label
+    if lbl.ndim == logp.ndim:
+        lbl = jnp.squeeze(lbl, axis=-1)
+    lbl = lbl.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    if label_smoothing:
+        smooth = jnp.mean(logp, axis=-1)
+        picked = (1 - label_smoothing) * picked + label_smoothing * smooth
+    loss = -jnp.where(valid, picked, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0) * valid.astype(logp.dtype)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    elif reduction == "mean":
+        denom = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+        return jnp.sum(loss) / denom
+    return _reduce(loss, reduction)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as _softmax
+    loss = loss.unsqueeze(-1) if loss.ndim < logits.ndim else loss
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@register_op(name="nll_loss")
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    lbl = label.astype(jnp.int32)
+    valid = lbl != ignore_index
+    safe = jnp.where(valid, lbl, 0)
+    picked = jnp.take_along_axis(input, safe[..., None], axis=-1)[..., 0]
+    loss = -jnp.where(valid, picked, 0.0)
+    if weight is not None:
+        w = jnp.take(weight, safe, axis=0) * valid.astype(input.dtype)
+        loss = loss * w
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(w), 1e-12)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(valid.astype(input.dtype)), 1.0)
+    return _reduce(loss, reduction)
+
+
+@register_op(name="mse_loss")
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.square(input - label), reduction)
+
+
+@register_op(name="l1_loss")
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.abs(input - label), reduction)
+
+
+@register_op(name="smooth_l1_loss")
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    diff = jnp.abs(input - label)
+    loss = jnp.where(diff < delta, 0.5 * diff * diff / delta,
+                     diff - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+@register_op(name="binary_cross_entropy")
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    eps = 1e-12
+    loss = -(label * jnp.log(jnp.maximum(input, eps))
+             + (1 - label) * jnp.log(jnp.maximum(1 - input, eps)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@register_op(name="binary_cross_entropy_with_logits")
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    max_val = jnp.maximum(-logit, 0.0)
+    if pos_weight is not None:
+        log_w = (pos_weight - 1.0) * label + 1.0
+        loss = (1 - label) * logit + log_w * (
+            jnp.log1p(jnp.exp(-jnp.abs(logit))) + max_val)
+    else:
+        loss = (1 - label) * logit + max_val + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+    if weight is not None:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
+@register_op(name="kl_div")
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    if log_target:
+        loss = jnp.exp(label) * (label - input)
+    else:
+        loss = jnp.where(label > 0, label * (jnp.log(jnp.maximum(label, 1e-30))
+                                             - input), 0.0)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / input.shape[0]
+    return _reduce(loss, reduction)
+
+
+@register_op(name="hinge_embedding_loss")
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    loss = jnp.where(label == 1.0, input, jnp.maximum(0.0, margin - input))
+    return _reduce(loss, reduction)
+
+
+@register_op(name="margin_ranking_loss")
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    loss = jnp.maximum(0.0, -label * (input - other) + margin)
+    return _reduce(loss, reduction)
+
+
+@register_op(name="cosine_embedding_loss")
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    cos = jnp.sum(input1 * input2, axis=-1) / jnp.maximum(
+        jnp.linalg.norm(input1, axis=-1) * jnp.linalg.norm(input2, axis=-1),
+        1e-12)
+    loss = jnp.where(label == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+@register_op(name="triplet_margin_loss")
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def dist(a, b):
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a - b) + epsilon, p),
+                                 axis=-1), 1.0 / p)
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_neg = jnp.minimum(d_neg, dist(positive, negative))
+    return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+
+
+@register_op(name="square_error_cost")
+def square_error_cost(input, label):
+    return jnp.square(input - label)
+
+
+@register_op(name="sigmoid_focal_loss")
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    p = jax.nn.sigmoid(logit)
+    ce = (1 - label) * logit + jnp.maximum(-logit, 0.0) + jnp.log1p(
+        jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    loss = ce * jnp.power(1 - p_t, gamma)
+    if alpha >= 0:
+        loss = loss * (alpha * label + (1 - alpha) * (1 - label))
+    if normalizer is not None:
+        loss = loss / normalizer
+    return _reduce(loss, reduction)
+
+
+@register_op(name="ctc_loss_stub", also_method=False)
+def _ctc_unimpl(*a, **k):
+    raise NotImplementedError
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via optax (reference: paddle ctc_loss over warpctc,
+    paddle/phi/kernels/gpu/warpctc_kernel.cu)."""
+    import optax
+
+    def fn(lp, lb, il, ll):
+        # optax expects (B, T, C) logits and paddings
+        logits = jnp.transpose(lp, (1, 0, 2)) if lp.ndim == 3 else lp
+        b, t, _ = logits.shape
+        logit_pad = (jnp.arange(t)[None, :] >= il[:, None]).astype(jnp.float32)
+        lab = lb.astype(jnp.int32)
+        lab_pad = (jnp.arange(lab.shape[1])[None, :] >= ll[:, None]).astype(jnp.float32)
+        loss = optax.ctc_loss(logits, logit_pad, lab, lab_pad, blank_id=blank)
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(ll.astype(loss.dtype), 1.0))
+        return _reduce(loss, reduction)
+
+    return call_op("ctc_loss", fn, (log_probs, labels, input_lengths,
+                                    label_lengths), {})

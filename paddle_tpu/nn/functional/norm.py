@@ -1,0 +1,158 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+fused CUDA kernels in paddle/phi/kernels/fusion/gpu/fused_layernorm*). On TPU
+these are jnp reductions + elementwise — XLA fuses them into single kernels,
+which is the CINN/fused-kernel replacement for norm ops."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...ops.registry import register_op
+
+
+@register_op(name="layer_norm")
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    # compute statistics in f32 for bf16 inputs (TPU best practice)
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.mean(jnp.square(xf - mean), axis=axes, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_op(name="rms_norm")
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """Fused RMSNorm parity (reference:
+    python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    out = (xf * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    if weight is not None:
+        out = out * weight
+    return out
+
+
+@register_op(name="batch_norm_infer")
+def _batch_norm_infer(x, running_mean, running_var, weight=None, bias=None,
+                      epsilon=1e-5, data_format="NCHW"):
+    ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    mean = running_mean.reshape(shape)
+    var = running_var.reshape(shape)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out
+
+
+@register_op(name="batch_norm_train")
+def _batch_norm_train(x, weight=None, bias=None, epsilon=1e-5,
+                      data_format="NCHW"):
+    ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+    xf = x.astype(jnp.float32) if x.dtype in (jnp.bfloat16, jnp.float16) else x
+    mean = jnp.mean(xf, axis=axes)
+    var = jnp.var(xf, axis=axes)
+    out = (xf - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.reshape(shape)
+    if bias is not None:
+        out = out + bias.reshape(shape)
+    return out, mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    """Stateful batch_norm: in training mode returns batch-normalized output
+    and updates running stats in-place on the Tensor buffers (eager
+    semantics; the functional/jit path threads them explicitly)."""
+    from ...core.tensor import Tensor
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _batch_norm_infer(x, running_mean, running_var, weight, bias,
+                                 epsilon=epsilon, data_format=data_format)
+    out, mean, var = _batch_norm_train(x, weight, bias, epsilon=epsilon,
+                                       data_format=data_format)
+    if isinstance(running_mean, Tensor):
+        # rebind running stats (under jit these become traced values that the
+        # TrainStep state-lifting captures as outputs)
+        m = momentum
+        mean_a = mean._data if isinstance(mean, Tensor) else mean
+        var_a = var._data if isinstance(var, Tensor) else var
+        running_mean._data = running_mean._data * m + (1 - m) * mean_a
+        running_var._data = running_var._data * m + (1 - m) * var_a
+    return out
+
+
+@register_op(name="group_norm")
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5,
+               data_format="NCHW", name=None):
+    ch_axis = 1 if data_format[1] == "C" else x.ndim - 1
+    if ch_axis != 1:
+        x = jnp.moveaxis(x, ch_axis, 1)
+    n, c = x.shape[0], x.shape[1]
+    spatial = x.shape[2:]
+    g = num_groups
+    xg = x.reshape((n, g, c // g) + spatial)
+    axes = tuple(range(2, xg.ndim))
+    xf = xg.astype(jnp.float32) if xg.dtype in (jnp.bfloat16, jnp.float16) else xg
+    mean = jnp.mean(xf, axis=axes, keepdims=True)
+    var = jnp.var(xf, axis=axes, keepdims=True)
+    out = ((xf - mean) * jax.lax.rsqrt(var + epsilon)).astype(x.dtype)
+    out = out.reshape((n, c) + spatial)
+    if weight is not None:
+        out = out * weight.reshape((1, c) + (1,) * len(spatial))
+    if bias is not None:
+        out = out + bias.reshape((1, c) + (1,) * len(spatial))
+    if ch_axis != 1:
+        out = jnp.moveaxis(out, 1, ch_axis)
+    return out
+
+
+@register_op(name="instance_norm")
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + eps)
+    c = x.shape[1]
+    if weight is not None:
+        out = out * weight.reshape((1, c) + (1,) * (x.ndim - 2))
+    if bias is not None:
+        out = out + bias.reshape((1, c) + (1,) * (x.ndim - 2))
+    return out
+
+
+@register_op(name="local_response_norm")
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    sq = jnp.square(x)
+    half = size // 2
+    c = x.shape[1]
+    pads = [(0, 0)] * x.ndim
+    pads[1] = (half, size - half - 1)
+    sq = jnp.pad(sq, pads)
+    acc = jnp.zeros_like(x)
+    for i in range(size):
+        acc = acc + jax.lax.dynamic_slice_in_dim(sq, i, c, axis=1)
+    return x / jnp.power(k + alpha * acc, beta)

@@ -1,0 +1,355 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native design: the time loop is `jax.lax.scan`, so the whole sequence
+compiles to one fused XLA while-loop instead of a per-step Python loop (the
+reference's cuDNN RNN kernels play this role on GPU)."""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..ops.registry import call_op
+from . import initializer as I
+from .layer import Layer, LayerList
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0):
+        from ..ops import creation
+        batch = batch_ref.shape[0]
+        return creation.full([batch, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((hidden_size,), attr=bias_ih_attr,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((hidden_size,), attr=bias_hh_attr,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            pre = x @ wi.T + bi + h @ wh.T + bh
+            return jnp.tanh(pre) if self.activation == "tanh" else \
+                jnp.maximum(pre, 0)
+
+        out = call_op("simple_rnn_cell", fn,
+                      (inputs, states, self.weight_ih, self.weight_hh,
+                       self.bias_ih, self.bias_hh), {})
+        return out, out
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((4 * hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((4 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_ih_attr,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((4 * hidden_size,),
+                                             attr=bias_hh_attr,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+        else:
+            h, c = states
+
+        def fn(x, h, c, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + h @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            c2 = f * c + i * g
+            h2 = o * jnp.tanh(c2)
+            return h2, c2
+
+        h2, c2 = call_op("lstm_cell", fn,
+                         (inputs, h, c, self.weight_ih, self.weight_hh,
+                          self.bias_ih, self.bias_hh), {})
+        return h2, (h2, c2)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter((3 * hidden_size, input_size),
+                                               attr=weight_ih_attr,
+                                               default_initializer=u)
+        self.weight_hh = self.create_parameter((3 * hidden_size, hidden_size),
+                                               attr=weight_hh_attr,
+                                               default_initializer=u)
+        self.bias_ih = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_ih_attr,
+                                             default_initializer=u)
+        self.bias_hh = self.create_parameter((3 * hidden_size,),
+                                             attr=bias_hh_attr,
+                                             default_initializer=u)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def fn(x, h, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = h @ wh.T + bh
+            ir, iz, ic = jnp.split(gi, 3, axis=-1)
+            hr, hz, hc = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            c = jnp.tanh(ic + r * hc)
+            return (1 - z) * c + z * h
+
+        h2 = call_op("gru_cell", fn,
+                     (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh), {})
+        return h2, h2
+
+
+class RNN(Layer):
+    """Wraps a cell into a scanned sequence layer."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager loop keeps cell-level tape semantics; for jit the whole
+        # layer traces into XLA while via the functional path
+        from ..ops import manipulation as man
+        x = inputs if self.time_major else man.transpose(inputs, [1, 0, 2])
+        steps = x.shape[0]
+        order = range(steps - 1, -1, -1) if self.is_reverse else range(steps)
+        outs = [None] * steps
+        states = initial_states
+        for t in order:
+            out, states = self.cell(x[t], states)
+            outs[t] = out
+        y = man.stack(outs, axis=0)
+        if not self.time_major:
+            y = man.transpose(y, [1, 0, 2])
+        return y, states
+
+
+def _lstm_layer_scan(x_tbc, h0, c0, wi, wh, bi, bh, reverse=False):
+    """One LSTM direction over (T, B, C) via lax.scan — the compiled path."""
+
+    def step(carry, xt):
+        h, c = carry
+        gates = xt @ wi.T + bi + h @ wh.T + bh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c2 = f * c + i * jnp.tanh(g)
+        h2 = o * jnp.tanh(c2)
+        return (h2, c2), h2
+
+    xs = jnp.flip(x_tbc, 0) if reverse else x_tbc
+    (h, c), ys = jax.lax.scan(step, (h0, c0), xs)
+    if reverse:
+        ys = jnp.flip(ys, 0)
+    return ys, h, c
+
+
+class LSTM(Layer):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirectional else 1
+        self.num_directions = ndir
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self._cells = []
+        for l in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if l == 0 else hidden_size * ndir
+                prefix = f"{l}_{d}"
+                self.add_parameter(f"weight_ih_l{prefix}", self.create_parameter(
+                    (4 * hidden_size, in_sz), default_initializer=u))
+                self.add_parameter(f"weight_hh_l{prefix}", self.create_parameter(
+                    (4 * hidden_size, hidden_size), default_initializer=u))
+                self.add_parameter(f"bias_ih_l{prefix}", self.create_parameter(
+                    (4 * hidden_size,), default_initializer=u))
+                self.add_parameter(f"bias_hh_l{prefix}", self.create_parameter(
+                    (4 * hidden_size,), default_initializer=u))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        def fn(x, *params):
+            xt = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            b = xt.shape[1]
+            ndir = self.num_directions
+            hs, cs = [], []
+            p = list(params)
+            out = xt
+            idx = 0
+            for l in range(self.num_layers):
+                dir_outs = []
+                for d in range(ndir):
+                    wi, wh, bi, bh = p[idx:idx + 4]
+                    idx += 4
+                    h0 = jnp.zeros((b, self.hidden_size), xt.dtype)
+                    c0 = jnp.zeros((b, self.hidden_size), xt.dtype)
+                    ys, h, c = _lstm_layer_scan(out, h0, c0, wi, wh, bi, bh,
+                                                reverse=(d == 1))
+                    dir_outs.append(ys)
+                    hs.append(h)
+                    cs.append(c)
+                out = jnp.concatenate(dir_outs, axis=-1) if ndir == 2 else dir_outs[0]
+            y = out if self.time_major else jnp.swapaxes(out, 0, 1)
+            return y, jnp.stack(hs), jnp.stack(cs)
+
+        params = [self._parameters[n] for n in self._parameters]
+        y, h, c = call_op("lstm", fn, tuple([inputs] + params), {})
+        return y, (h, c)
+
+
+class GRU(Layer):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, name=None,
+                 **kw):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 else hidden_size
+            self.add_parameter(f"weight_ih_l{l}", self.create_parameter(
+                (3 * hidden_size, in_sz), default_initializer=u))
+            self.add_parameter(f"weight_hh_l{l}", self.create_parameter(
+                (3 * hidden_size, hidden_size), default_initializer=u))
+            self.add_parameter(f"bias_ih_l{l}", self.create_parameter(
+                (3 * hidden_size,), default_initializer=u))
+            self.add_parameter(f"bias_hh_l{l}", self.create_parameter(
+                (3 * hidden_size,), default_initializer=u))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        def fn(x, *params):
+            xt = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            b = xt.shape[1]
+            p = list(params)
+            out = xt
+            hs = []
+            for l in range(self.num_layers):
+                wi, wh, bi, bh = p[4 * l:4 * l + 4]
+
+                def step(h, xt_):
+                    gi = xt_ @ wi.T + bi
+                    gh = h @ wh.T + bh
+                    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+                    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+                    r = jax.nn.sigmoid(ir + hr)
+                    z = jax.nn.sigmoid(iz + hz)
+                    cand = jnp.tanh(ic + r * hc)
+                    h2 = (1 - z) * cand + z * h
+                    return h2, h2
+
+                h0 = jnp.zeros((b, self.hidden_size), xt.dtype)
+                h, ys = jax.lax.scan(step, h0, out)
+                out = ys
+                hs.append(h)
+            y = out if self.time_major else jnp.swapaxes(out, 0, 1)
+            return y, jnp.stack(hs)
+
+        params = [self._parameters[n] for n in self._parameters]
+        y, h = call_op("gru", fn, tuple([inputs] + params), {})
+        return y, h
+
+
+class SimpleRNN(Layer):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", name=None, **kw):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        for l in range(num_layers):
+            in_sz = input_size if l == 0 else hidden_size
+            self.add_parameter(f"weight_ih_l{l}", self.create_parameter(
+                (hidden_size, in_sz), default_initializer=u))
+            self.add_parameter(f"weight_hh_l{l}", self.create_parameter(
+                (hidden_size, hidden_size), default_initializer=u))
+            self.add_parameter(f"bias_ih_l{l}", self.create_parameter(
+                (hidden_size,), default_initializer=u))
+            self.add_parameter(f"bias_hh_l{l}", self.create_parameter(
+                (hidden_size,), default_initializer=u))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def fn(x, *params):
+            xt = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            b = xt.shape[1]
+            p = list(params)
+            out = xt
+            hs = []
+            for l in range(self.num_layers):
+                wi, wh, bi, bh = p[4 * l:4 * l + 4]
+
+                def step(h, xt_):
+                    h2 = act(xt_ @ wi.T + bi + h @ wh.T + bh)
+                    return h2, h2
+
+                h0 = jnp.zeros((b, self.hidden_size), xt.dtype)
+                h, ys = jax.lax.scan(step, h0, out)
+                out = ys
+                hs.append(h)
+            y = out if self.time_major else jnp.swapaxes(out, 0, 1)
+            return y, jnp.stack(hs)
+
+        params = [self._parameters[n] for n in self._parameters]
+        y, h = call_op("simple_rnn", fn, tuple([inputs] + params), {})
+        return y, h

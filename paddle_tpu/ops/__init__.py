@@ -1,0 +1,71 @@
+"""paddle_tpu.ops — the op library.
+
+Aggregates all op modules, installs Tensor methods + arithmetic dunders
+(the reference's monkey_patch_tensor step,
+python/paddle/base/dygraph/tensor_patch_methods.py), and exposes the flat
+`_C_ops`-style namespace via the registry.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from . import registry
+from .registry import register_op, call_op, OPS
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .comparison import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+
+from . import creation, math, reduction, manipulation, comparison, linalg, random  # noqa: F401
+
+
+# -- arithmetic dunders ----------------------------------------------------
+
+def _binop(opname, swap=False):
+    def fn(self, other):
+        op = OPS[opname].wrapper
+        return op(other, self) if swap else op(self, other)
+    return fn
+
+
+_DUNDERS = {
+    "__add__": _binop("add"), "__radd__": _binop("add", swap=True),
+    "__sub__": _binop("subtract"), "__rsub__": _binop("subtract", swap=True),
+    "__mul__": _binop("multiply"), "__rmul__": _binop("multiply", swap=True),
+    "__truediv__": _binop("divide"), "__rtruediv__": _binop("divide", swap=True),
+    "__floordiv__": _binop("floor_divide"),
+    "__rfloordiv__": _binop("floor_divide", swap=True),
+    "__mod__": _binop("remainder"), "__rmod__": _binop("remainder", swap=True),
+    "__pow__": _binop("pow"), "__rpow__": _binop("pow", swap=True),
+    "__matmul__": _binop("matmul"), "__rmatmul__": _binop("matmul", swap=True),
+    "__eq__": _binop("equal"), "__ne__": _binop("not_equal"),
+    "__lt__": _binop("less_than"), "__le__": _binop("less_equal"),
+    "__gt__": _binop("greater_than"), "__ge__": _binop("greater_equal"),
+    "__and__": _binop("bitwise_and"), "__or__": _binop("bitwise_or"),
+    "__xor__": _binop("bitwise_xor"),
+    "__neg__": lambda self: OPS["neg"].wrapper(self),
+    "__abs__": lambda self: OPS["abs"].wrapper(self),
+    "__invert__": lambda self: OPS["bitwise_not"].wrapper(self),
+}
+
+
+def _binop_fn(name):
+    return _DUNDERS[name]
+
+
+registry.install_tensor_methods(extra=_DUNDERS)
+
+# extra method aliases matching paddle Tensor methods
+_ALIAS_METHODS = {
+    "mod": OPS["remainder"].wrapper,
+    "floor_mod": OPS["remainder"].wrapper,
+    "unsqueeze_": OPS["unsqueeze"].wrapper,
+}
+for _n, _f in _ALIAS_METHODS.items():
+    if not hasattr(Tensor, _n):
+        setattr(Tensor, _n, _f)

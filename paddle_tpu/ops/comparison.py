@@ -1,0 +1,122 @@
+"""Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .registry import register_op
+
+
+@register_op(differentiable=False)
+def equal(x, y, name=None):
+    return jnp.equal(x, y)
+
+
+@register_op(differentiable=False)
+def not_equal(x, y, name=None):
+    return jnp.not_equal(x, y)
+
+
+@register_op(differentiable=False)
+def greater_than(x, y, name=None):
+    return jnp.greater(x, y)
+
+
+@register_op(differentiable=False)
+def greater_equal(x, y, name=None):
+    return jnp.greater_equal(x, y)
+
+
+@register_op(differentiable=False)
+def less_than(x, y, name=None):
+    return jnp.less(x, y)
+
+
+@register_op(differentiable=False)
+def less_equal(x, y, name=None):
+    return jnp.less_equal(x, y)
+
+
+@register_op(differentiable=False)
+def logical_and(x, y, out=None, name=None):
+    return jnp.logical_and(x, y)
+
+
+@register_op(differentiable=False)
+def logical_or(x, y, out=None, name=None):
+    return jnp.logical_or(x, y)
+
+
+@register_op(differentiable=False)
+def logical_xor(x, y, out=None, name=None):
+    return jnp.logical_xor(x, y)
+
+
+@register_op(differentiable=False)
+def logical_not(x, out=None, name=None):
+    return jnp.logical_not(x)
+
+
+@register_op(differentiable=False)
+def bitwise_and(x, y, out=None, name=None):
+    return jnp.bitwise_and(x, y)
+
+
+@register_op(differentiable=False)
+def bitwise_or(x, y, out=None, name=None):
+    return jnp.bitwise_or(x, y)
+
+
+@register_op(differentiable=False)
+def bitwise_xor(x, y, out=None, name=None):
+    return jnp.bitwise_xor(x, y)
+
+
+@register_op(differentiable=False)
+def bitwise_not(x, out=None, name=None):
+    return jnp.bitwise_not(x)
+
+
+@register_op(differentiable=False)
+def bitwise_left_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return jnp.left_shift(x, y)
+
+
+@register_op(differentiable=False)
+def bitwise_right_shift(x, y, is_arithmetic=True, out=None, name=None):
+    return jnp.right_shift(x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    from .registry import call_op
+    return call_op("allclose",
+                   lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                   (x, y), {})
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    from .registry import call_op
+    return call_op("isclose",
+                   lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan),
+                   (x, y), {})
+
+
+def equal_all(x, y, name=None):
+    from .registry import call_op
+    return call_op("equal_all", lambda a, b: jnp.array_equal(a, b), (x, y), {})
+
+
+def is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def is_empty(x) -> Tensor:
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+@register_op(differentiable=False)
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return jnp.isin(x, test_x, invert=invert)

@@ -1,0 +1,181 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .registry import register_op
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True) -> Tensor:
+    """paddle.to_tensor equivalent."""
+    if isinstance(data, Tensor):
+        arr = data._data
+    else:
+        if isinstance(data, (bool, int, float)) or isinstance(data, (list, tuple)):
+            arr = np.asarray(data)
+            if arr.dtype == np.float64 and dtype is None:
+                arr = arr.astype(dtypes.default_float_dtype().np_dtype)
+            arr = jnp.asarray(arr)
+        else:
+            arr = jnp.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtypes.to_jax_dtype(dtype))
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    dt = dtypes.to_jax_dtype(dtype) or dtypes.default_float_dtype().np_dtype
+    return Tensor(jnp.zeros(_shape_arg(shape), dt))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    dt = dtypes.to_jax_dtype(dtype) or dtypes.default_float_dtype().np_dtype
+    return Tensor(jnp.ones(_shape_arg(shape), dt))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    dt = dtypes.to_jax_dtype(dtype)
+    if dt is None:
+        if isinstance(fill_value, bool):
+            dt = np.bool_
+        elif isinstance(fill_value, int):
+            dt = dtypes.default_float_dtype().np_dtype
+        else:
+            dt = dtypes.default_float_dtype().np_dtype
+    return Tensor(jnp.full(_shape_arg(shape), fill_value, dt))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+@register_op(differentiable=True)
+def zeros_like(x, dtype=None, name=None):
+    return jnp.zeros_like(x, dtype=dtypes.to_jax_dtype(dtype))
+
+
+@register_op(differentiable=True)
+def ones_like(x, dtype=None, name=None):
+    return jnp.ones_like(x, dtype=dtypes.to_jax_dtype(dtype))
+
+
+@register_op(differentiable=False)
+def full_like(x, fill_value, dtype=None, name=None):
+    return jnp.full_like(x, fill_value, dtype=dtypes.to_jax_dtype(dtype))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    for v in (start, end, step):
+        pass
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dt = np.int64
+        else:
+            dt = dtypes.default_float_dtype().np_dtype
+    else:
+        dt = dtypes.to_jax_dtype(dtype)
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    dt = dtypes.to_jax_dtype(dtype) or dtypes.default_float_dtype().np_dtype
+    return Tensor(jnp.linspace(start, stop, num, dtype=dt))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    dt = dtypes.to_jax_dtype(dtype) or dtypes.default_float_dtype().np_dtype
+    return Tensor(jnp.logspace(float(start), float(stop), int(num), base=float(base), dtype=dt))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    dt = dtypes.to_jax_dtype(dtype) or dtypes.default_float_dtype().np_dtype
+    return Tensor(jnp.eye(int(num_rows), None if num_columns is None else int(num_columns), dtype=dt))
+
+
+@register_op(differentiable=True)
+def diag(x, offset=0, padding_value=0, name=None):
+    if x.ndim == 1 and padding_value != 0:
+        d = jnp.diag(x, k=offset)
+        mask = jnp.eye(d.shape[0], d.shape[1], k=offset, dtype=bool)
+        return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+    return jnp.diag(x, k=offset)
+
+
+@register_op(differentiable=True)
+def diagflat(x, offset=0, name=None):
+    return jnp.diagflat(x, k=offset)
+
+
+@register_op(differentiable=True)
+def tril(x, diagonal=0, name=None):
+    return jnp.tril(x, k=diagonal)
+
+
+@register_op(differentiable=True)
+def triu(x, diagonal=0, name=None):
+    return jnp.triu(x, k=diagonal)
+
+
+def meshgrid(*args, name=None):
+    args = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    arrs = [a._data if isinstance(a, Tensor) else jnp.asarray(a) for a in args]
+    return [Tensor(m) for m in jnp.meshgrid(*arrs, indexing="ij")]
+
+
+@register_op(differentiable=True)
+def assign(x, output=None):
+    return jnp.asarray(x)
+
+
+@register_op(differentiable=True)
+def clone(x, name=None):
+    return jnp.asarray(x)
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = col if col is not None else row
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=dtypes.to_jax_dtype(dtype)))
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x_arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.nn.one_hot(x_arr, num_classes, dtype=dtypes.default_float_dtype().np_dtype))
+
+
+def complex(real, imag, name=None) -> Tensor:
+    from .registry import call_op
+    return call_op("complex", lambda r, i: jax.lax.complex(r, i), (real, imag), {})

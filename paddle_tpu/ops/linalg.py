@@ -1,0 +1,226 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py, kernels via
+cuBLAS/cuSOLVER in paddle/phi/kernels/funcs/blas). On TPU: matmul rides the
+MXU; decompositions lower to XLA's linalg custom calls."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .registry import register_op, call_op
+
+
+@register_op()
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    if transpose_x:
+        x = jnp.swapaxes(x, -1, -2) if x.ndim > 1 else x
+    if transpose_y:
+        y = jnp.swapaxes(y, -1, -2) if y.ndim > 1 else y
+    return jnp.matmul(x, y)
+
+
+@register_op()
+def mm(input, mat2, name=None):
+    return jnp.matmul(input, mat2)
+
+
+@register_op()
+def bmm(x, y, name=None):
+    return jnp.matmul(x, y)
+
+
+@register_op()
+def dot(x, y, name=None):
+    return jnp.sum(x * y, axis=-1)
+
+
+@register_op()
+def mv(x, vec, name=None):
+    return jnp.matmul(x, vec)
+
+
+@register_op()
+def t(input, name=None):
+    if input.ndim < 2:
+        return input
+    return jnp.swapaxes(input, -1, -2)
+
+
+def einsum(equation, *operands):
+    return call_op("einsum",
+                   lambda *ops: jnp.einsum(equation, *ops),
+                   operands, {})
+
+
+@register_op()
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    if axis is None:
+        # frobenius over all elements == 2-norm of the flattened vector
+        x = x.reshape(-1)
+        axis = 0
+        p = 2 if p in (None, "fro") else p
+    elif isinstance(axis, (list, tuple)):
+        axis = tuple(axis)
+        p = "fro" if p is None else p
+    else:
+        p = 2 if p is None else p
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@register_op()
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.linalg.norm(x, ord=p, axis=axis, keepdims=keepdim)
+
+
+@register_op()
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    return jnp.linalg.norm(x, ord=p, axis=tuple(axis), keepdims=keepdim)
+
+
+@register_op()
+def dist(x, y, p=2, name=None):
+    return jnp.linalg.norm((x - y).reshape(-1), ord=p)
+
+
+@register_op()
+def cholesky(x, upper=False, name=None):
+    l = jnp.linalg.cholesky(x)
+    return jnp.swapaxes(l, -1, -2).conj() if upper else l
+
+
+@register_op()
+def cholesky_solve(x, y, upper=False, name=None):
+    return jax.scipy.linalg.cho_solve((y, not upper), x)
+
+
+@register_op()
+def inverse(x, name=None):
+    return jnp.linalg.inv(x)
+
+
+@register_op()
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return jnp.linalg.pinv(x, rtol=rcond, hermitian=hermitian)
+
+
+@register_op(differentiable=False)
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return jnp.linalg.matrix_rank(x, rtol=tol)
+
+
+@register_op()
+def matrix_power(x, n, name=None):
+    return jnp.linalg.matrix_power(x, n)
+
+
+@register_op()
+def det(x, name=None):
+    return jnp.linalg.det(x)
+
+
+@register_op()
+def slogdet(x, name=None):
+    sign, logdet = jnp.linalg.slogdet(x)
+    return jnp.stack([sign, logdet])
+
+
+@register_op()
+def qr(x, mode="reduced", name=None):
+    return tuple(jnp.linalg.qr(x, mode=mode))
+
+
+@register_op()
+def svd(x, full_matrices=False, name=None):
+    u, s, vh = jnp.linalg.svd(x, full_matrices=full_matrices)
+    return u, s, jnp.swapaxes(vh, -1, -2).conj()
+
+
+@register_op()
+def svdvals(x, name=None):
+    return jnp.linalg.svd(x, compute_uv=False)
+
+
+@register_op()
+def eig(x, name=None):
+    # XLA has no TPU eig; compute on CPU via callback in eager mode
+    w, v = np.linalg.eig(np.asarray(x))
+    return jnp.asarray(w), jnp.asarray(v)
+
+
+@register_op()
+def eigh(x, UPLO="L", name=None):
+    return tuple(jnp.linalg.eigh(x, symmetrize_input=True))
+
+
+@register_op()
+def eigvals(x, name=None):
+    return jnp.asarray(np.linalg.eigvals(np.asarray(x)))
+
+
+@register_op()
+def eigvalsh(x, UPLO="L", name=None):
+    return jnp.linalg.eigvalsh(x)
+
+
+@register_op()
+def solve(x, y, name=None):
+    return jnp.linalg.solve(x, y)
+
+
+@register_op()
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    return jax.scipy.linalg.solve_triangular(
+        x, y, lower=not upper, trans=1 if transpose else 0,
+        unit_diagonal=unitriangular)
+
+
+@register_op()
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    sol, res, rank, sv = jnp.linalg.lstsq(x, y, rcond=rcond)
+    return sol, res, rank, sv
+
+
+@register_op()
+def lu(x, pivot=True, get_infos=False, name=None):
+    lu_, piv = jax.scipy.linalg.lu_factor(x)
+    if get_infos:
+        return lu_, piv.astype(jnp.int32) + 1, jnp.zeros((), jnp.int32)
+    return lu_, piv.astype(jnp.int32) + 1
+
+
+@register_op()
+def matrix_exp(x, name=None):
+    return jax.scipy.linalg.expm(x)
+
+
+@register_op()
+def multi_dot(x, name=None):
+    return jnp.linalg.multi_dot(list(x))
+
+
+@register_op()
+def corrcoef(x, rowvar=True, name=None):
+    return jnp.corrcoef(x, rowvar=rowvar)
+
+
+@register_op()
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return jnp.cov(x, rowvar=rowvar, ddof=1 if ddof else 0,
+                   fweights=fweights, aweights=aweights)
+
+
+@register_op()
+def histogram(input, bins=100, min=0, max=0, name=None):
+    rng = None if (min == 0 and max == 0) else (min, max)
+    hist, _ = jnp.histogram(input.reshape(-1), bins=bins, range=rng)
+    return hist
+
+
+@register_op()
+def bincount(x, weights=None, minlength=0, name=None):
+    return jnp.bincount(x, weights=weights, minlength=minlength,
+                        length=None)

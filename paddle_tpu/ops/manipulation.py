@@ -1,0 +1,498 @@
+"""Shape/layout manipulation ops (reference: python/paddle/tensor/manipulation.py;
+view kernels in paddle/phi/kernels/stride/). On TPU these are metadata-only or
+single relayout HLOs — XLA handles copy elision, so there is no view/stride
+machinery to replicate."""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.tensor import Tensor
+from .registry import register_op, call_op
+
+
+@register_op()
+def reshape(x, shape, name=None):
+    if isinstance(shape, jax.Array) or isinstance(shape, np.ndarray):
+        shape = [int(s) for s in np.asarray(shape)]
+    shape = tuple(int(s) for s in shape)
+    return jnp.reshape(x, shape)
+
+
+@register_op()
+def transpose(x, perm=None, name=None):
+    return jnp.transpose(x, axes=perm)
+
+
+@register_op()
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        return jnp.squeeze(x)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(a for a in axis if x.shape[a] == 1)
+        return jnp.squeeze(x, axis=axis) if axis else x
+    return jnp.squeeze(x, axis=axis) if x.shape[axis] == 1 else x
+
+
+@register_op()
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, (list, tuple)):
+        for a in sorted(axis):
+            x = jnp.expand_dims(x, a)
+        return x
+    return jnp.expand_dims(x, axis)
+
+
+@register_op()
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    nd = x.ndim
+    if nd == 0:
+        return x.reshape(1)
+    start = start_axis % nd
+    stop = stop_axis % nd
+    new_shape = (x.shape[:start] + (-1,) + x.shape[stop + 1:])
+    return jnp.reshape(x, new_shape)
+
+
+@register_op()
+def concat(x, axis=0, name=None):
+    if isinstance(axis, jax.Array):
+        axis = int(axis)
+    return jnp.concatenate(list(x), axis=axis)
+
+
+@register_op()
+def stack(x, axis=0, name=None):
+    return jnp.stack(list(x), axis=axis)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    axis = int(axis)
+    if isinstance(num_or_sections, int):
+        outs_spec = num_or_sections
+    else:
+        sections = [int(s) for s in num_or_sections]
+        total = arr.shape[axis]
+        if any(s == -1 for s in sections):
+            rest = total - builtins_sum(s for s in sections if s != -1)
+            sections = [rest if s == -1 else s for s in sections]
+        outs_spec = np.cumsum(sections)[:-1].tolist()
+    return call_op("split",
+                   lambda a: tuple(jnp.split(a, outs_spec, axis=axis)),
+                   (x,), {})
+
+
+def builtins_sum(it):
+    import builtins
+    return builtins.sum(it)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    parts = split(x, n, axis)
+    from . import manipulation as m
+    return [squeeze(p, axis=axis) for p in parts]
+
+
+@register_op()
+def tile(x, repeat_times, name=None):
+    return jnp.tile(x, tuple(int(r) for r in repeat_times))
+
+
+@register_op()
+def expand(x, shape, name=None):
+    shape = tuple(int(s) for s in shape)
+    # paddle allows -1 meaning keep dim
+    full = []
+    offset = len(shape) - x.ndim
+    for i, s in enumerate(shape):
+        if s == -1:
+            full.append(x.shape[i - offset])
+        else:
+            full.append(s)
+    return jnp.broadcast_to(x, tuple(full))
+
+
+@register_op()
+def expand_as(x, y, name=None):
+    return jnp.broadcast_to(x, y.shape)
+
+
+@register_op()
+def broadcast_to(x, shape, name=None):
+    return jnp.broadcast_to(x, tuple(int(s) for s in shape))
+
+
+def broadcast_tensors(inputs, name=None):
+    return call_op("broadcast_tensors",
+                   lambda xs: tuple(jnp.broadcast_arrays(*xs)),
+                   (list(inputs),), {})
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+@register_op()
+def flip(x, axis, name=None):
+    return jnp.flip(x, axis=axis if not isinstance(axis, list) else tuple(axis))
+
+
+@register_op()
+def roll(x, shifts, axis=None, name=None):
+    return jnp.roll(x, shifts, axis=tuple(axis) if isinstance(axis, list) else axis)
+
+
+@register_op()
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return jnp.rot90(x, k=k, axes=tuple(axes))
+
+
+@register_op()
+def gather(x, index, axis=0, name=None):
+    index = index.reshape(-1) if index.ndim > 1 else index
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op()
+def gather_nd(x, index, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+@register_op()
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return jnp.take_along_axis(arr, indices, axis=axis)
+
+
+@register_op()
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    values = jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.shape) \
+        if np.ndim(values) == 0 else jnp.asarray(values, arr.dtype)
+    dims = list(range(arr.ndim))
+    # build index grid: along `axis` use `indices`, elsewhere iota
+    grids = []
+    for d in dims:
+        if d == axis:
+            grids.append(indices)
+        else:
+            g = jnp.arange(indices.shape[d]).reshape(
+                [indices.shape[d] if i == d else 1 for i in dims])
+            grids.append(jnp.broadcast_to(g, indices.shape))
+    idx = tuple(grids)
+    at = arr.at[idx]
+    if reduce == "assign":
+        return at.set(values)
+    if reduce in ("add", "sum"):
+        return at.add(values)
+    if reduce in ("mul", "multiply"):
+        return at.multiply(values)
+    if reduce == "amax":
+        return at.max(values)
+    if reduce == "amin":
+        return at.min(values)
+    raise ValueError(f"unknown reduce: {reduce}")
+
+
+@register_op()
+def scatter(x, index, updates, overwrite=True, name=None):
+    if overwrite:
+        return x.at[index].set(updates)
+    # paddle semantics: non-overwrite means zero-then-add for duplicates
+    zeroed = x.at[index].set(jnp.zeros_like(updates))
+    return zeroed.at[index].add(updates)
+
+
+@register_op()
+def scatter_nd_add(x, index, updates, name=None):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    from . import creation
+    z = creation.zeros(shape, dtype=updates.dtype.name if isinstance(updates, Tensor) else None)
+    return scatter_nd_add(z, index, updates)
+
+
+@register_op()
+def index_select(x, index, axis=0, name=None):
+    return jnp.take(x, index, axis=axis)
+
+
+@register_op()
+def index_sample(x, index, name=None):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+@register_op()
+def index_add(x, index, axis, value, name=None):
+    sl = [slice(None)] * x.ndim
+    sl[axis] = index
+    return x.at[tuple(sl)].add(value)
+
+
+@register_op()
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx = tuple(indices)
+    return x.at[idx].add(value) if accumulate else x.at[idx].set(value)
+
+
+@register_op()
+def masked_select(x, mask, name=None):
+    # data-dependent shape: returns compacted values (eager only; inside jit
+    # use masked_fill/where which keep static shapes, the TPU-friendly path)
+    return x[mask]
+
+
+@register_op()
+def masked_fill(x, mask, value, name=None):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+@register_op()
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return jnp.nonzero(condition)
+    return jnp.where(condition, x, y)
+
+
+@register_op(differentiable=False)
+def nonzero(x, as_tuple=False):
+    nz = jnp.nonzero(x)
+    if as_tuple:
+        return tuple(nz)
+    return jnp.stack(nz, axis=1)
+
+
+@register_op(differentiable=False)
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(k)
+    if largest:
+        if axis in (-1, x.ndim - 1):
+            vals, idx = jax.lax.top_k(x, k)
+        else:
+            xm = jnp.moveaxis(x, axis, -1)
+            vals, idx = jax.lax.top_k(xm, k)
+            vals = jnp.moveaxis(vals, -1, axis)
+            idx = jnp.moveaxis(idx, -1, axis)
+    else:
+        xm = jnp.moveaxis(-x, axis, -1)
+        v, idx = jax.lax.top_k(xm, k)
+        vals = jnp.moveaxis(-v, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return vals, idx.astype(jnp.int32)
+
+
+@register_op()
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    out = jnp.sort(x, axis=axis, stable=stable or True)
+    return jnp.flip(out, axis=axis) if descending else out
+
+
+@register_op(differentiable=False)
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    if descending:
+        n = x.shape[axis]
+        idx = jnp.argsort(-x, axis=axis, stable=True)
+        return idx.astype(jnp.int32)
+    return jnp.argsort(x, axis=axis, stable=True).astype(jnp.int32)
+
+
+@register_op(differentiable=False)
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    if sorted_sequence.ndim == 1:
+        out = jnp.searchsorted(sorted_sequence, values, side=side)
+    else:
+        out = jax.vmap(lambda s, v: jnp.searchsorted(s, v, side=side))(
+            sorted_sequence.reshape(-1, sorted_sequence.shape[-1]),
+            values.reshape(-1, values.shape[-1]))
+        out = out.reshape(values.shape)
+    return out.astype(jnp.int32)
+
+
+@register_op(differentiable=False)
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    out = jnp.searchsorted(sorted_sequence, x, side="right" if right else "left")
+    return out.astype(jnp.int32)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # data-dependent shape -> eager/host computation (matches reference note
+    # that dynamic-shape ops fall outside the compiled region on TPU)
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    res = np.unique(arr, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        res = (res,)
+    outs = [Tensor(jnp.asarray(r)) for r in res]
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(x._data if isinstance(x, Tensor) else x)
+    if axis is None:
+        flat = arr.reshape(-1)
+    else:
+        flat = arr
+    mask = np.ones(len(flat), dtype=bool)
+    mask[1:] = flat[1:] != flat[:-1]
+    out = [Tensor(jnp.asarray(flat[mask]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(mask) - 1)))
+    if return_counts:
+        idx = np.nonzero(mask)[0]
+        counts = np.diff(np.append(idx, len(flat)))
+        out.append(Tensor(jnp.asarray(counts)))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+@register_op()
+def cast(x, dtype, name=None):
+    return x.astype(dtypes.to_jax_dtype(dtype))
+
+
+@register_op()
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
+        pad_from_left_axis=True, name=None):
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+    else:
+        # partial spec pads spatial dims from the LAST dim backwards
+        # (paddle/torch convention: [w_lo, w_hi, h_lo, h_hi, ...])
+        k = len(pad) // 2
+        pairs = [(0, 0)] * nd
+        spatial = (list(range(1, nd - 1)) if data_format.endswith("C")
+                   else list(range(2, nd)))  # NHWC vs NCHW layouts
+        for i in range(k):
+            pairs[spatial[-1 - i]] = (pad[2 * i], pad[2 * i + 1])
+    mode_map = {"constant": "constant", "reflect": "reflect",
+                "replicate": "edge", "circular": "wrap"}
+    if mode == "constant":
+        return jnp.pad(x, pairs, mode="constant", constant_values=value)
+    return jnp.pad(x, pairs, mode=mode_map[mode])
+
+
+@register_op()
+def moveaxis(x, source, destination, name=None):
+    return jnp.moveaxis(x, source, destination)
+
+
+@register_op()
+def swapaxes(x, axis0, axis1, name=None):
+    return jnp.swapaxes(x, axis0, axis1)
+
+
+@register_op()
+def as_real(x, name=None):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+@register_op()
+def as_complex(x, name=None):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+@register_op()
+def repeat_interleave(x, repeats, axis=None, name=None):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+@register_op()
+def crop(x, shape=None, offsets=None, name=None):
+    shape = [x.shape[i] if s == -1 else int(s) for i, s in enumerate(shape)]
+    offsets = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
+    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    return x[sl]
+
+
+@register_op()
+def slice(x, axes, starts, ends, name=None):
+    sl = [builtins_slice(None)] * x.ndim
+    for ax, st, en in zip(axes, starts, ends):
+        sl[ax] = builtins_slice(int(st), int(en))
+    return x[tuple(sl)]
+
+
+def builtins_slice(*a):
+    import builtins
+    return builtins.slice(*a)
+
+
+@register_op()
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    sl = [builtins_slice(None)] * x.ndim
+    for ax, st, en, sd in zip(axes, starts, ends, strides):
+        sl[ax] = builtins_slice(int(st), int(en), int(sd))
+    return x[tuple(sl)]
+
+
+@register_op()
+def tensordot(x, y, axes=2, name=None):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+@register_op()
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    # im2col (N, C, H, W) -> (N, C*kh*kw, L)
+    if isinstance(kernel_sizes, int):
+        kernel_sizes = [kernel_sizes, kernel_sizes]
+    if isinstance(strides, int):
+        strides = [strides, strides]
+    if isinstance(paddings, int):
+        paddings = [paddings] * 4
+    elif len(paddings) == 2:
+        paddings = [paddings[0], paddings[1], paddings[0], paddings[1]]
+    if isinstance(dilations, int):
+        dilations = [dilations, dilations]
+    n, c, h, w = x.shape
+    x = jnp.pad(x, ((0, 0), (0, 0), (paddings[0], paddings[2]),
+                    (paddings[1], paddings[3])))
+    kh, kw = kernel_sizes
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=(kh, kw), window_strides=tuple(strides),
+        padding="VALID", rhs_dilation=tuple(dilations),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    l = patches.shape[2] * patches.shape[3]
+    return patches.reshape(n, c * kh * kw, l)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [call_op("atleast_1d", jnp.atleast_1d, (t,), {}) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [call_op("atleast_2d", jnp.atleast_2d, (t,), {}) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [call_op("atleast_3d", jnp.atleast_3d, (t,), {}) for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def fn(inp):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        hi = lo + shard_size
+        in_shard = (inp >= lo) & (inp < hi)
+        return jnp.where(in_shard, inp - lo, ignore_value)
+    return call_op("shard_index", fn, (input,), {})

@@ -1,0 +1,529 @@
+"""Elementwise and binary math ops (reference: python/paddle/tensor/math.py,
+kernels in paddle/phi/kernels/{cpu,gpu}/*elementwise*, activation*).
+
+Each op is one pure-JAX function; XLA fuses chains of these into single
+TPU kernels, which replaces the reference's hand-fused CUDA elementwise
+machinery (paddle/phi/kernels/funcs/elementwise_base.h).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from .registry import register_op
+
+
+def _u(x):
+    """Unwrap possible Tensor (for scalar positions already unwrapped by
+    dispatch this is a no-op)."""
+    return x
+
+
+# -- binary ----------------------------------------------------------------
+
+@register_op()
+def add(x, y, name=None):
+    return jnp.add(x, y)
+
+
+@register_op()
+def subtract(x, y, name=None):
+    return jnp.subtract(x, y)
+
+
+@register_op()
+def multiply(x, y, name=None):
+    return jnp.multiply(x, y)
+
+
+@register_op()
+def divide(x, y, name=None):
+    return jnp.true_divide(x, y)
+
+
+@register_op(differentiable=False)
+def floor_divide(x, y, name=None):
+    return jnp.floor_divide(x, y)
+
+
+@register_op()
+def remainder(x, y, name=None):
+    return jnp.remainder(x, y)
+
+
+mod = remainder
+floor_mod = remainder
+
+
+@register_op()
+def pow(x, y, name=None):
+    return jnp.power(x, y)
+
+
+@register_op()
+def maximum(x, y, name=None):
+    return jnp.maximum(x, y)
+
+
+@register_op()
+def minimum(x, y, name=None):
+    return jnp.minimum(x, y)
+
+
+@register_op()
+def fmax(x, y, name=None):
+    return jnp.fmax(x, y)
+
+
+@register_op()
+def fmin(x, y, name=None):
+    return jnp.fmin(x, y)
+
+
+@register_op()
+def atan2(x, y, name=None):
+    return jnp.arctan2(x, y)
+
+
+@register_op()
+def hypot(x, y, name=None):
+    return jnp.hypot(x, y)
+
+
+@register_op()
+def logaddexp(x, y, name=None):
+    return jnp.logaddexp(x, y)
+
+
+@register_op()
+def heaviside(x, y, name=None):
+    return jnp.heaviside(x, y)
+
+
+@register_op()
+def nextafter(x, y, name=None):
+    return jnp.nextafter(x, y)
+
+
+@register_op()
+def copysign(x, y, name=None):
+    return jnp.copysign(x, y)
+
+
+@register_op(differentiable=False)
+def gcd(x, y, name=None):
+    return jnp.gcd(x, y)
+
+
+@register_op(differentiable=False)
+def lcm(x, y, name=None):
+    return jnp.lcm(x, y)
+
+
+# -- unary -----------------------------------------------------------------
+
+@register_op()
+def abs(x, name=None):
+    return jnp.abs(x)
+
+
+@register_op()
+def neg(x, name=None):
+    return jnp.negative(x)
+
+
+@register_op()
+def exp(x, name=None):
+    return jnp.exp(x)
+
+
+@register_op()
+def expm1(x, name=None):
+    return jnp.expm1(x)
+
+
+@register_op()
+def log(x, name=None):
+    return jnp.log(x)
+
+
+@register_op()
+def log2(x, name=None):
+    return jnp.log2(x)
+
+
+@register_op()
+def log10(x, name=None):
+    return jnp.log10(x)
+
+
+@register_op()
+def log1p(x, name=None):
+    return jnp.log1p(x)
+
+
+@register_op()
+def sqrt(x, name=None):
+    return jnp.sqrt(x)
+
+
+@register_op()
+def rsqrt(x, name=None):
+    return jax.lax.rsqrt(x)
+
+
+@register_op()
+def square(x, name=None):
+    return jnp.square(x)
+
+
+@register_op()
+def reciprocal(x, name=None):
+    return jnp.reciprocal(x)
+
+
+@register_op()
+def sin(x, name=None):
+    return jnp.sin(x)
+
+
+@register_op()
+def cos(x, name=None):
+    return jnp.cos(x)
+
+
+@register_op()
+def tan(x, name=None):
+    return jnp.tan(x)
+
+
+@register_op()
+def asin(x, name=None):
+    return jnp.arcsin(x)
+
+
+@register_op()
+def acos(x, name=None):
+    return jnp.arccos(x)
+
+
+@register_op()
+def atan(x, name=None):
+    return jnp.arctan(x)
+
+
+@register_op()
+def sinh(x, name=None):
+    return jnp.sinh(x)
+
+
+@register_op()
+def cosh(x, name=None):
+    return jnp.cosh(x)
+
+
+@register_op()
+def tanh(x, name=None):
+    return jnp.tanh(x)
+
+
+@register_op()
+def asinh(x, name=None):
+    return jnp.arcsinh(x)
+
+
+@register_op()
+def acosh(x, name=None):
+    return jnp.arccosh(x)
+
+
+@register_op()
+def atanh(x, name=None):
+    return jnp.arctanh(x)
+
+
+@register_op()
+def erf(x, name=None):
+    return jax.scipy.special.erf(x)
+
+
+@register_op()
+def erfinv(x, name=None):
+    return jax.scipy.special.erfinv(x)
+
+
+@register_op()
+def lgamma(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@register_op()
+def digamma(x, name=None):
+    return jax.scipy.special.digamma(x)
+
+
+@register_op()
+def i0(x, name=None):
+    return jax.scipy.special.i0(x)
+
+
+@register_op()
+def i0e(x, name=None):
+    return jax.scipy.special.i0e(x)
+
+
+@register_op()
+def i1(x, name=None):
+    return jax.scipy.special.i1(x)
+
+
+@register_op()
+def i1e(x, name=None):
+    return jax.scipy.special.i1e(x)
+
+
+@register_op(differentiable=False)
+def floor(x, name=None):
+    return jnp.floor(x)
+
+
+@register_op(differentiable=False)
+def ceil(x, name=None):
+    return jnp.ceil(x)
+
+
+@register_op(differentiable=False)
+def round(x, decimals=0, name=None):
+    return jnp.round(x, decimals)
+
+
+@register_op(differentiable=False)
+def trunc(x, name=None):
+    return jnp.trunc(x)
+
+
+@register_op(differentiable=False)
+def frac(x, name=None):
+    return x - jnp.trunc(x)
+
+
+@register_op(differentiable=False)
+def sign(x, name=None):
+    return jnp.sign(x)
+
+
+@register_op(differentiable=False)
+def sgn(x, name=None):
+    return jnp.sign(x)
+
+
+@register_op()
+def clip(x, min=None, max=None, name=None):
+    return jnp.clip(x, min, max)
+
+
+@register_op()
+def logit(x, eps=None, name=None):
+    if eps is not None:
+        x = jnp.clip(x, eps, 1.0 - eps)
+    return jnp.log(x / (1.0 - x))
+
+
+@register_op()
+def logcumsumexp(x, axis=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jax.lax.cumlogsumexp(x, axis=axis)
+
+
+@register_op()
+def deg2rad(x, name=None):
+    return jnp.deg2rad(x)
+
+
+@register_op()
+def rad2deg(x, name=None):
+    return jnp.rad2deg(x)
+
+
+@register_op()
+def angle(x, name=None):
+    return jnp.angle(x)
+
+
+@register_op()
+def conj(x, name=None):
+    return jnp.conj(x)
+
+
+@register_op()
+def real(x, name=None):
+    return jnp.real(x)
+
+
+@register_op()
+def imag(x, name=None):
+    return jnp.imag(x)
+
+
+@register_op()
+def lerp(x, y, weight, name=None):
+    return x + weight * (y - x)
+
+
+@register_op()
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+@register_op()
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    if act == "relu":
+        out = jnp.maximum(out, 0)
+    elif act == "tanh":
+        out = jnp.tanh(out)
+    return out
+
+
+@register_op()
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+@register_op()
+def multiplex(inputs, index, name=None):
+    stacked = jnp.stack(inputs, axis=0)  # (n, batch, ...)
+    idx = index.reshape(-1)
+    return jnp.take_along_axis(
+        stacked, idx[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]
+
+
+@register_op()
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return beta * input + alpha * (x @ y)
+
+
+@register_op()
+def inner(x, y, name=None):
+    return jnp.inner(x, y)
+
+
+@register_op()
+def outer(x, y, name=None):
+    return jnp.outer(x, y)
+
+
+@register_op()
+def kron(x, y, name=None):
+    return jnp.kron(x, y)
+
+
+@register_op()
+def cross(x, y, axis=9, name=None):
+    if axis == 9:
+        axis = next(i for i, s in enumerate(x.shape) if s == 3)
+    return jnp.cross(x, y, axis=axis)
+
+
+@register_op()
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op()
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+@register_op(differentiable=False)
+def isnan(x, name=None):
+    return jnp.isnan(x)
+
+
+@register_op(differentiable=False)
+def isinf(x, name=None):
+    return jnp.isinf(x)
+
+
+@register_op(differentiable=False)
+def isfinite(x, name=None):
+    return jnp.isfinite(x)
+
+
+@register_op(differentiable=False)
+def isneginf(x, name=None):
+    return jnp.isneginf(x)
+
+
+@register_op(differentiable=False)
+def isposinf(x, name=None):
+    return jnp.isposinf(x)
+
+
+@register_op(differentiable=False)
+def isreal(x, name=None):
+    return jnp.isreal(x)
+
+
+@register_op()
+def polygamma(x, n, name=None):
+    return jax.scipy.special.polygamma(n, x)
+
+
+@register_op()
+def log_sigmoid(x, name=None):
+    return jax.nn.log_sigmoid(x)
+
+
+@register_op()
+def sigmoid(x, name=None):
+    return jax.nn.sigmoid(x)
+
+
+@register_op()
+def softsign(x, name=None):
+    return jax.nn.soft_sign(x)
+
+
+@register_op()
+def ldexp(x, y, name=None):
+    return jnp.ldexp(x, y)
+
+
+@register_op()
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    return jnp.diff(x, n=n, axis=axis, prepend=prepend, append=append)
+
+
+@register_op()
+def cummax(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummax(x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    is_new = x >= vals
+    ind = jax.lax.cummax(jnp.where(is_new, idx, 0), axis=axis)
+    return vals, ind.astype(dtypes.to_jax_dtype(dtype))
+
+
+@register_op()
+def cummin(x, axis=None, dtype="int64", name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    vals = jax.lax.cummin(x, axis=axis)
+    n = x.shape[axis]
+    idx = jnp.arange(n).reshape([-1 if i == axis % x.ndim else 1
+                                 for i in range(x.ndim)])
+    idx = jnp.broadcast_to(idx, x.shape)
+    is_new = x <= vals
+    ind = jax.lax.cummax(jnp.where(is_new, idx, 0), axis=axis)
+    return vals, ind.astype(dtypes.to_jax_dtype(dtype))

@@ -1,0 +1,149 @@
+"""Random ops (reference: python/paddle/tensor/random.py).
+
+Eager API draws from the global stateful Generator (core/generator.py); every
+op also accepts an explicit ``key=`` for functional/jit use — the idiomatic
+JAX style that keeps compiled code deterministic and replayable.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import generator as gen
+from ..core.tensor import Tensor
+
+
+def _key(key):
+    return key if key is not None else gen.next_key()
+
+
+def _dt(dtype, default=None):
+    d = dtypes.to_jax_dtype(dtype)
+    return d if d is not None else (default or dtypes.default_float_dtype().np_dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s._data) if isinstance(s, Tensor) else int(s) for s in shape)
+
+
+def rand(shape, dtype=None, name=None, key=None) -> Tensor:
+    return Tensor(jax.random.uniform(_key(key), _shape(shape), _dt(dtype)))
+
+
+def randn(shape, dtype=None, name=None, key=None) -> Tensor:
+    return Tensor(jax.random.normal(_key(key), _shape(shape), _dt(dtype)))
+
+
+def standard_normal(shape, dtype=None, name=None, key=None) -> Tensor:
+    return randn(shape, dtype, key=key)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None, key=None) -> Tensor:
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._data if isinstance(mean, Tensor) else mean
+        s = std._data if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        return Tensor(jax.random.normal(_key(key), shp) * s + m)
+    shp = _shape(shape if shape is not None else [1])
+    return Tensor(jax.random.normal(_key(key), shp,
+                                    dtypes.default_float_dtype().np_dtype) * std + mean)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None, key=None) -> Tensor:
+    if seed:
+        key = jax.random.PRNGKey(seed)
+    return Tensor(jax.random.uniform(_key(key), _shape(shape), _dt(dtype),
+                                     minval=min, maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None, key=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(key), _shape(shape), low, high,
+                                     _dt(dtype, np.int32)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None, key=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    dt = _dt(dtype, np.dtype(x._data.dtype)) if dtype else x._data.dtype
+    return Tensor(jax.random.randint(_key(key), x._data.shape, low, high, dt))
+
+
+def randperm(n, dtype="int64", name=None, key=None) -> Tensor:
+    return Tensor(jax.random.permutation(_key(key), int(n)).astype(_dt(dtype, np.int32)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None, key=None) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    logits = jnp.log(jnp.maximum(arr, 1e-30))
+    k = _key(key)
+    if replacement:
+        if arr.ndim == 1:
+            out = jax.random.categorical(k, logits, shape=(num_samples,))
+        else:
+            out = jax.random.categorical(k, logits[:, None, :], axis=-1,
+                                         shape=(arr.shape[0], num_samples))
+    else:
+        # Gumbel top-k trick for sampling without replacement
+        g = jax.random.gumbel(k, arr.shape)
+        scores = logits + g
+        out = jnp.argsort(-scores, axis=-1)[..., :num_samples]
+    return Tensor(out.astype(jnp.int32))
+
+
+def bernoulli(x, name=None, key=None) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.bernoulli(_key(key), arr).astype(arr.dtype))
+
+
+def poisson(x, name=None, key=None) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jax.random.poisson(_key(key), arr).astype(arr.dtype))
+
+
+def exponential_(x, lam=1.0, name=None, key=None) -> Tensor:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    sample = jax.random.exponential(_key(key), arr.shape).astype(arr.dtype) / lam
+    if isinstance(x, Tensor):
+        x._data = sample
+        return x
+    return Tensor(sample)
+
+
+def rand_like(x, dtype=None, key=None) -> Tensor:
+    dt = _dt(dtype) if dtype else x._data.dtype
+    return Tensor(jax.random.uniform(_key(key), x._data.shape, dt))
+
+
+def randn_like(x, dtype=None, name=None, key=None) -> Tensor:
+    dt = _dt(dtype) if dtype else x._data.dtype
+    return Tensor(jax.random.normal(_key(key), x._data.shape, dt))
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None, key=None):
+    from .registry import call_op
+
+    k = _key(key)
+
+    def fn(logits):
+        g = jax.random.gumbel(k, jnp.shape(logits), logits.dtype)
+        y = jax.nn.softmax((logits + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis) \
+                if hasattr(jnp, "put_along_axis") else \
+                y_hard.at[jnp.indices(y.shape)[0]].set(0)  # fallback below
+            oh = jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis,
+                                dtype=y.dtype)
+            return oh + jax.lax.stop_gradient(-y) + y
+        return y
+
+    return call_op("gumbel_softmax", fn, (x,), {})

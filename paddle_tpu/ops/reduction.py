@@ -1,0 +1,164 @@
+"""Reduction ops (reference: python/paddle/tensor/math.py reductions,
+paddle/phi/kernels/funcs/reduce_function.h). XLA maps these onto the TPU's
+vector unit reduce trees; keepdim handling mirrors the paddle API."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from .registry import register_op
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+@register_op()
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    dt = dtypes.to_jax_dtype(dtype)
+    return jnp.sum(x, axis=_axis(axis), dtype=dt, keepdims=keepdim)
+
+
+@register_op()
+def mean(x, axis=None, keepdim=False, name=None):
+    return jnp.mean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def max(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def min(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def amax(x, axis=None, keepdim=False, name=None):
+    return jnp.max(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def amin(x, axis=None, keepdim=False, name=None):
+    return jnp.min(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    return jnp.prod(x, axis=_axis(axis), dtype=dtypes.to_jax_dtype(dtype),
+                    keepdims=keepdim)
+
+
+@register_op()
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.std(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op()
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return jnp.var(x, axis=_axis(axis), ddof=1 if unbiased else 0,
+                   keepdims=keepdim)
+
+
+@register_op()
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmean(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return jnp.nansum(x, axis=_axis(axis), dtype=dtypes.to_jax_dtype(dtype),
+                      keepdims=keepdim)
+
+
+@register_op()
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return jax.scipy.special.logsumexp(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(differentiable=False)
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmax(x, axis=_axis(axis), keepdims=keepdim and axis is not None)
+    return out.astype(dtypes.to_jax_dtype(dtype))
+
+
+@register_op(differentiable=False)
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    out = jnp.argmin(x, axis=_axis(axis), keepdims=keepdim and axis is not None)
+    return out.astype(dtypes.to_jax_dtype(dtype))
+
+
+@register_op(differentiable=False)
+def all(x, axis=None, keepdim=False, name=None):
+    return jnp.all(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(differentiable=False)
+def any(x, axis=None, keepdim=False, name=None):
+    return jnp.any(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(differentiable=False)
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return jnp.count_nonzero(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def cumsum(x, axis=None, dtype=None, name=None):
+    if axis is None:
+        x = x.reshape(-1)
+        axis = 0
+    return jnp.cumsum(x, axis=axis, dtype=dtypes.to_jax_dtype(dtype))
+
+
+@register_op()
+def cumprod(x, dim=None, dtype=None, name=None):
+    if dim is None:
+        x = x.reshape(-1)
+        dim = 0
+    return jnp.cumprod(x, axis=dim, dtype=dtypes.to_jax_dtype(dtype))
+
+
+@register_op()
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    if mode == "avg":
+        return jnp.median(x, axis=_axis(axis), keepdims=keepdim)
+    # 'min' mode: lower of the two middles
+    ax = _axis(axis)
+    if ax is None:
+        flat = x.reshape(-1)
+        n = flat.shape[0]
+        return jnp.sort(flat)[(n - 1) // 2]
+    n = x.shape[ax]
+    srt = jnp.sort(x, axis=ax)
+    return jnp.take(srt, (n - 1) // 2, axis=ax) if not keepdim else \
+        jnp.take(srt, jnp.asarray([(n - 1) // 2]), axis=ax)
+
+
+@register_op()
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return jnp.nanmedian(x, axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op()
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return jnp.quantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim,
+                        method=interpolation)
+
+
+@register_op()
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return jnp.nanquantile(x, jnp.asarray(q), axis=_axis(axis), keepdims=keepdim)
+
+
+@register_op(differentiable=False)
+def mode(x, axis=-1, keepdim=False, name=None):
+    vals = jax.scipy.stats.mode(x, axis=axis, keepdims=keepdim)
+    return vals.mode, vals.count

@@ -1,0 +1,183 @@
+"""Op registry + eager dispatch.
+
+TPU-native redesign of the reference's op machinery: where the reference has
+a YAML corpus (paddle/phi/ops/yaml/ops.yaml) + codegen emitting C++ dispatch
+(paddle/phi/api/generator/api_gen.py) + KernelFactory selection
+(paddle/phi/core/kernel_factory.h:326), here every op is one pure-JAX
+function registered with metadata. "Kernel selection" is XLA's job: the same
+registered function serves eager (dispatched per-op with a tape record) and
+captured/compiled execution (traced under jax.jit into one HLO module).
+
+Dispatch per eager call:
+  1. unwrap Tensor args -> jax arrays
+  2. if grads needed: jax.vjp over a closure treating non-differentiable args
+     as constants; record a GradNode on the tape
+  3. wrap outputs back into Tensors carrying the node link
+
+The registry doubles as the source for installing Tensor methods (the
+reference's monkey_patch_tensor) and the `_C_ops`-style flat namespace.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.flags import get_flag
+from ..core.tensor import Tensor
+from ..autograd import tape as _tape
+
+
+class OpDef:
+    __slots__ = ("name", "fn", "differentiable", "method_name", "wrapper")
+
+    def __init__(self, name, fn, differentiable, method_name, wrapper):
+        self.name = name
+        self.fn = fn
+        self.differentiable = differentiable
+        self.method_name = method_name
+        self.wrapper = wrapper
+
+
+OPS: Dict[str, OpDef] = {}
+_PENDING_METHODS: Dict[str, Callable] = {}
+
+
+def _is_tensor(x) -> bool:
+    return isinstance(x, Tensor)
+
+
+def _map_structure(fn, obj):
+    """Map over Tensors nested at most one container deep (list/tuple of
+    tensors, e.g. concat's input). Dicts are not op inputs in this API."""
+    if isinstance(obj, Tensor):
+        return fn(obj)
+    if isinstance(obj, (list, tuple)) and any(isinstance(e, Tensor) for e in obj):
+        return type(obj)(fn(e) if isinstance(e, Tensor) else e for e in obj)
+    return obj
+
+
+def _collect_tensors(args, kwargs):
+    out = []
+
+    def visit(obj):
+        if isinstance(obj, Tensor):
+            out.append(obj)
+        elif isinstance(obj, (list, tuple)):
+            for e in obj:
+                if isinstance(e, Tensor):
+                    out.append(e)
+
+    for a in args:
+        visit(a)
+    for v in kwargs.values():
+        visit(v)
+    return out
+
+
+def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
+            differentiable: bool = True):
+    """Eager-dispatch `fn` (pure JAX) over possibly-Tensor args."""
+    tensors = _collect_tensors(args, kwargs)
+    need_grad = (differentiable and _tape.grad_enabled()
+                 and any(not t.stop_gradient or t._node is not None
+                         for t in tensors))
+
+    if not need_grad:
+        uw_args = tuple(_map_structure(lambda t: t._data, a) for a in args)
+        uw_kwargs = {k: _map_structure(lambda t: t._data, v)
+                     for k, v in kwargs.items()}
+        out = fn(*uw_args, **uw_kwargs)
+        return _wrap_outputs(name, out, node=None)
+
+    # Differentiable path: inputs needing grad become vjp primals, the rest
+    # are closed over as constants.
+    diff = [t for t in tensors if not t.stop_gradient or t._node is not None]
+    diff_ids = {id(t): i for i, t in enumerate(diff)}
+
+    def pure(*primals):
+        def sub(t):
+            i = diff_ids.get(id(t))
+            return primals[i] if i is not None else t._data
+
+        a = tuple(_map_structure(sub, x) for x in args)
+        k = {kk: _map_structure(sub, v) for kk, v in kwargs.items()}
+        return fn(*a, **k)
+
+    primals = [t._data for t in diff]
+    out, vjp_fn = jax.vjp(pure, *primals)
+
+    flat, treedef = jax.tree_util.tree_flatten(out)
+    avals = [(o.shape, o.dtype) for o in flat]
+    node = _tape.GradNode(name, vjp_fn, diff, avals, treedef)
+    return _wrap_outputs(name, out, node=node)
+
+
+def _wrap_outputs(name: str, out, node):
+    if get_flag("check_nan_inf"):
+        _check_nan_inf(name, out)
+    flat, treedef = jax.tree_util.tree_flatten(out)
+    wrapped = []
+    for i, arr in enumerate(flat):
+        t = Tensor(arr, stop_gradient=(node is None))
+        if node is not None:
+            t._node = node
+            t._out_index = i
+        wrapped.append(t)
+    return jax.tree_util.tree_unflatten(treedef, wrapped)
+
+
+def _check_nan_inf(name, out):
+    import numpy as np
+    for arr in jax.tree_util.tree_leaves(out):
+        if jnp.issubdtype(arr.dtype, jnp.floating) and not isinstance(
+                arr, jax.core.Tracer):
+            if not bool(jnp.isfinite(arr).all()):
+                raise FloatingPointError(
+                    f"NaN/Inf detected in output of op '{name}' "
+                    "(FLAGS_check_nan_inf is on)")
+
+
+def register_op(name: Optional[str] = None, *, differentiable: bool = True,
+                method: Optional[str] = None, also_method: bool = True):
+    """Decorator: register a pure-JAX function as a framework op.
+
+    The decorated function receives raw jax arrays (Tensors are unwrapped);
+    its wrapper accepts Tensors/arrays/scalars and returns Tensors.
+    `method`: name under which to install on Tensor (defaults to op name).
+    """
+
+    def deco(fn):
+        op_name = name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if get_flag("eager_log_ops"):
+                print(f"[paddle_tpu op] {op_name}")
+            return call_op(op_name, fn, args, kwargs, differentiable)
+
+        opdef = OpDef(op_name, fn, differentiable, method or op_name, wrapper)
+        OPS[op_name] = opdef
+        if also_method:
+            _PENDING_METHODS[opdef.method_name] = wrapper
+        return wrapper
+
+    return deco
+
+
+def install_tensor_methods(extra: Optional[Dict[str, Callable]] = None):
+    """Attach registered ops as Tensor methods (the reference's
+    monkey_patch_tensor, python/paddle/base/dygraph/tensor_patch_methods.py)."""
+    for mname, fn in _PENDING_METHODS.items():
+        if not hasattr(Tensor, mname):
+            setattr(Tensor, mname, fn)
+    if extra:
+        for mname, fn in extra.items():
+            setattr(Tensor, mname, fn)
+
+
+def get_op(name: str) -> OpDef:
+    return OPS[name]

@@ -1,0 +1,4 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
+from . import lr
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
+                        RMSProp, Adamax, Lamb, L1Decay, L2Decay)

@@ -1,0 +1,390 @@
+"""Optimizers (reference: python/paddle/optimizer/optimizer.py, adamw.py...).
+
+Dual-mode design:
+- imperative: ``opt.step()`` reads eager ``.grad`` and rebinds parameter
+  storage — paddle UX parity.
+- functional: the same pure-jnp per-parameter update math runs under jit
+  tracing (state slots are Tensors whose storage the TrainStep lifting swaps
+  for traced arrays), so a whole train step compiles to one XLA module with
+  the optimizer fused in. This replaces the reference's per-op CUDA
+  adam/momentum kernels (paddle/phi/kernels/gpu/adam_kernel.cu) with
+  XLA-fused update code.
+
+The learning rate is carried as a 0-d f32 Tensor so LR schedules don't force
+recompilation (it's a traced input, not a baked constant).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Parameter, Tensor
+from ..autograd import no_grad
+from .lr import LRScheduler
+
+
+class L2Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class L1Decay:
+    def __init__(self, coeff=0.0):
+        self.coeff = coeff
+
+
+class Optimizer:
+    _slot_names: List[str] = []
+
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be given (paddle_tpu has no global "
+                "parameter registry); pass model.parameters()")
+        self._param_list = list(parameters)
+        self._param_groups = None
+        if self._param_list and isinstance(self._param_list[0], dict):
+            groups = self._param_list
+            self._param_groups = groups
+            self._param_list = [p for g in groups for p in g["params"]]
+        self._lr_sched = learning_rate if isinstance(learning_rate, LRScheduler) else None
+        init_lr = learning_rate() if self._lr_sched else float(learning_rate)
+        self._lr = Tensor(jnp.asarray(init_lr, jnp.float32))
+        self._weight_decay = weight_decay
+        self._grad_clip = grad_clip
+        self._multi_precision = multi_precision
+        self._accumulators: Dict[str, Dict[int, Tensor]] = {}
+        self._step_count = Tensor(jnp.zeros((), jnp.int32))
+
+    # -- lr -----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if self._lr_sched is not None:
+            return self._lr_sched()
+        return float(np.asarray(self._lr._data))
+
+    def set_lr(self, value):
+        self._lr_sched = None
+        self._lr._data = jnp.asarray(float(value), jnp.float32)
+
+    def set_lr_scheduler(self, scheduler):
+        self._lr_sched = scheduler
+
+    def _sync_lr(self):
+        if self._lr_sched is not None:
+            self._lr._data = jnp.asarray(self._lr_sched(), jnp.float32)
+
+    # -- state --------------------------------------------------------------
+    def _acc(self, name: str, p: Parameter, init=None, dtype=None) -> Tensor:
+        store = self._accumulators.setdefault(name, {})
+        key = id(p)
+        if key not in store:
+            if init is None:
+                dt = dtype or (jnp.float32 if self._use_master(p) else p._data.dtype)
+                store[key] = Tensor(jnp.zeros(p._data.shape, dt))
+            else:
+                store[key] = Tensor(init)
+        return store[key]
+
+    def _use_master(self, p) -> bool:
+        return self._multi_precision and p._data.dtype in (jnp.bfloat16,
+                                                           jnp.float16)
+
+    def _master(self, p: Parameter) -> Optional[Tensor]:
+        if not self._use_master(p):
+            return None
+        store = self._accumulators.setdefault("master_weight", {})
+        if id(p) not in store:
+            store[id(p)] = Tensor(p._data.astype(jnp.float32))
+        return store[id(p)]
+
+    def _all_state_tensors(self) -> List[Tensor]:
+        out = [self._lr, self._step_count]
+        for store in self._accumulators.values():
+            out.extend(store.values())
+        return out
+
+    def state_dict(self):
+        out = {"LR_Scheduler": (self._lr_sched.state_dict()
+                                if self._lr_sched else {"lr": self.get_lr()}),
+               "step_count": int(np.asarray(self._step_count._data))}
+        id2name = {}
+        for i, p in enumerate(self._param_list):
+            id2name[id(p)] = p.name or f"param_{i}"
+        for slot, store in self._accumulators.items():
+            for pid, t in store.items():
+                if pid in id2name:
+                    out[f"{id2name[pid]}_{slot}"] = t
+        return out
+
+    def set_state_dict(self, state):
+        id2name = {}
+        for i, p in enumerate(self._param_list):
+            id2name[id(p)] = p.name or f"param_{i}"
+        for slot, store in self._accumulators.items():
+            for pid in store:
+                key = f"{id2name.get(pid)}_{slot}"
+                if key in state:
+                    v = state[key]
+                    store[pid]._data = (v._data if isinstance(v, Tensor)
+                                        else jnp.asarray(np.asarray(v)))
+        if "LR_Scheduler" in state and self._lr_sched is not None:
+            self._lr_sched.set_state_dict(state["LR_Scheduler"])
+        if "step_count" in state:
+            self._step_count._data = jnp.asarray(int(state["step_count"]),
+                                                 jnp.int32)
+
+    # -- stepping -----------------------------------------------------------
+    @no_grad()
+    def step(self):
+        self._sync_lr()
+        params_grads = []
+        for p in self._param_list:
+            if p.stop_gradient or p._grad is None:
+                continue
+            params_grads.append((p, p._grad._data))
+        if self._grad_clip is not None:
+            clipped = self._grad_clip(params_grads)
+            params_grads = [(p, g) for (p, _), (_, g) in
+                            zip(params_grads, clipped)]
+        self._step_count._data = self._step_count._data + 1
+        lr = self._lr._data
+        for p, g in params_grads:
+            master = self._master(p)
+            wd = self._decay_coeff(p)
+            self._apply_one(p, g, lr, master, wd)
+
+    def _decay_coeff(self, p) -> float:
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if isinstance(wd, (L2Decay, L1Decay)):
+            return wd.coeff
+        return float(wd)
+
+    def _apply_one(self, p, g, lr, master, wd):
+        raise NotImplementedError
+
+    @no_grad()
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._param_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def _param_update(self, p, master, new_value_f32):
+        """Write back an fp32 update into (master, param) respecting dtype."""
+        if master is not None:
+            master._data = new_value_f32
+            p._data = new_value_f32.astype(p._data.dtype)
+        else:
+            p._data = new_value_f32.astype(p._data.dtype)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w = master._data if master is not None else p._data
+        g = g.astype(w.dtype)
+        if wd:
+            g = g + wd * w
+        self._param_update(p, master, w - lr.astype(w.dtype) * g)
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w = master._data if master is not None else p._data
+        g = g.astype(w.dtype)
+        if wd:
+            g = g + wd * w
+        v = self._acc("velocity", p)
+        v._data = self._momentum * v._data.astype(w.dtype) + g
+        if self._nesterov:
+            upd = g + self._momentum * v._data
+        else:
+            upd = v._data
+        self._param_update(p, master, w - lr.astype(w.dtype) * upd)
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 use_multi_tensor=False, amsgrad=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._decoupled_wd = False  # Adam couples decay into grads (L2)
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w32 = (master._data if master is not None else p._data).astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        if wd and not self._decoupled_wd:
+            g32 = g32 + wd * w32
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        t = self._step_count._data.astype(jnp.float32)
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g32
+        v._data = self._beta2 * v._data + (1 - self._beta2) * jnp.square(g32)
+        mhat = m._data / (1 - jnp.power(self._beta1, t))
+        vhat = v._data / (1 - jnp.power(self._beta2, t))
+        lr32 = lr.astype(jnp.float32)
+        new_w = w32 - lr32 * mhat / (jnp.sqrt(vhat) + self._eps)
+        if wd and self._decoupled_wd:
+            new_w = new_w - lr32 * wd * w32
+        self._param_update(p, master, new_w)
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference: python/paddle/optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name=name)
+        self._decoupled_wd = True
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_coeff(self, p):
+        if (self._apply_decay_param_fun is not None
+                and not self._apply_decay_param_fun(p.name)):
+            return 0.0
+        return super()._decay_coeff(p)
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w32 = (master._data if master is not None else p._data).astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * w32
+        acc = self._acc("moment", p,
+                        init=jnp.full(p._data.shape, self._init_acc,
+                                      jnp.float32))
+        acc._data = acc._data + jnp.square(g32)
+        new_w = w32 - lr.astype(jnp.float32) * g32 / (
+            jnp.sqrt(acc._data) + self._eps)
+        self._param_update(p, master, new_w)
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._rho = rho
+        self._eps = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w32 = (master._data if master is not None else p._data).astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * w32
+        ms = self._acc("mean_square", p, dtype=jnp.float32)
+        ms._data = self._rho * ms._data + (1 - self._rho) * jnp.square(g32)
+        if self._centered:
+            mg = self._acc("mean_grad", p, dtype=jnp.float32)
+            mg._data = self._rho * mg._data + (1 - self._rho) * g32
+            denom = jnp.sqrt(ms._data - jnp.square(mg._data) + self._eps)
+        else:
+            denom = jnp.sqrt(ms._data + self._eps)
+        upd = lr.astype(jnp.float32) * g32 / denom
+        if self._momentum:
+            mom = self._acc("momentum", p, dtype=jnp.float32)
+            mom._data = self._momentum * mom._data + upd
+            upd = mom._data
+        self._param_update(p, master, w32 - upd)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w32 = (master._data if master is not None else p._data).astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        if wd:
+            g32 = g32 + wd * w32
+        m = self._acc("moment", p, dtype=jnp.float32)
+        u = self._acc("inf_norm", p, dtype=jnp.float32)
+        t = self._step_count._data.astype(jnp.float32)
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g32
+        u._data = jnp.maximum(self._beta2 * u._data, jnp.abs(g32))
+        lr32 = lr.astype(jnp.float32) / (1 - jnp.power(self._beta1, t))
+        self._param_update(p, master,
+                           w32 - lr32 * m._data / (u._data + self._eps))
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, multi_precision, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._eps = epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w32 = (master._data if master is not None else p._data).astype(jnp.float32)
+        g32 = g.astype(jnp.float32)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        t = self._step_count._data.astype(jnp.float32)
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g32
+        v._data = self._beta2 * v._data + (1 - self._beta2) * jnp.square(g32)
+        mhat = m._data / (1 - jnp.power(self._beta1, t))
+        vhat = v._data / (1 - jnp.power(self._beta2, t))
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        if wd and not (self._exclude_fn and self._exclude_fn(p)):
+            r = r + wd * w32
+        w_norm = jnp.linalg.norm(w32)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        self._param_update(p, master, w32 - lr.astype(jnp.float32) * trust * r)

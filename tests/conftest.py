@@ -1,0 +1,33 @@
+"""Test config: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's fake-device testing pattern (SURVEY.md §4: the
+custom_cpu plugin masquerading as a device, test/custom_runtime/): here the
+fake devices are XLA host-platform devices, so multi-chip sharding code paths
+(pjit/shard_map/collectives) execute for real without TPUs.
+"""
+import os
+
+# force CPU: the session env pins JAX_PLATFORMS to the TPU tunnel, which
+# must not be grabbed by the test suite (single-chip lock + slow compiles)
+os.environ["JAX_PLATFORMS"] = "cpu"
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np
+import pytest
+
+import jax
+
+# numeric tests compare against float64 numpy; use full-precision dots
+# (production/bench keeps JAX's default TPU-friendly precision)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_all():
+    import paddle_tpu as pt
+    pt.seed(2024)
+    np.random.seed(2024)
+    yield

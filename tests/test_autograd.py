@@ -1,0 +1,194 @@
+"""Autograd tape tests (the reference's check_grad pattern,
+test/legacy_test/op_test.py:3114: analytic grads vs numeric/known refs)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def t(x, sg=False):
+    return pt.to_tensor(np.asarray(x, np.float32), stop_gradient=sg)
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    x = np.asarray(x, np.float64)
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        idx = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[idx] += eps
+        xm[idx] -= eps
+        g[idx] = (fn(xp) - fn(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+@pytest.mark.parametrize("opname,fn,lo,hi", [
+    ("exp", lambda x: np.exp(x).sum(), -1, 1),
+    ("tanh", lambda x: np.tanh(x).sum(), -1, 1),
+    ("sqrt", lambda x: np.sqrt(x).sum(), 0.5, 2),
+    ("log", lambda x: np.log(x).sum(), 0.5, 2),
+    ("sigmoid", lambda x: (1 / (1 + np.exp(-x))).sum(), -1, 1),
+])
+def test_unary_grads(opname, fn, lo, hi):
+    x = np.random.RandomState(0).uniform(lo, hi, (3, 4))
+    xt = t(x)
+    y = getattr(pt, opname)(xt).sum()
+    y.backward()
+    ng = numeric_grad(fn, x)
+    np.testing.assert_allclose(xt.grad.numpy(), ng, rtol=1e-2, atol=1e-3)
+
+
+def test_matmul_grad():
+    rng = np.random.RandomState(1)
+    a, b = rng.randn(3, 4), rng.randn(4, 5)
+    at, bt = t(a), t(b)
+    out = pt.matmul(at, bt).sum()
+    out.backward()
+    np.testing.assert_allclose(at.grad.numpy(),
+                               np.ones((3, 5)) @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(bt.grad.numpy(),
+                               a.T @ np.ones((3, 5)), rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = t([1.0, 2.0])
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0, 5.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_stop_gradient():
+    x = t([1.0, 2.0])
+    y = t([3.0, 4.0], sg=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 4.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = t([1.0, 2.0])
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = (x * 2) + y
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_no_grad():
+    x = t([1.0])
+    with pt.no_grad():
+        y = x * 2
+    assert y._node is None and y.stop_gradient
+
+
+def test_retain_graph():
+    x = t([1.0, 2.0])
+    y = (x * x).sum()
+    y.backward(retain_graph=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [4.0, 8.0])
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_second_backward_raises():
+    x = t([1.0])
+    y = (x * x).sum()
+    y.backward()
+    with pytest.raises(RuntimeError):
+        y.backward()
+
+
+def test_branching_graph():
+    x = t([2.0])
+    a = x * 3
+    b = x * 5
+    (a + b).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [8.0])
+
+
+def test_deep_chain():
+    x = t([1.5])
+    y = x
+    for _ in range(50):
+        y = y * 1.01
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.01 ** 50], rtol=1e-4)
+
+
+def test_functional_grad_api():
+    x = t([1.0, 2.0])
+    y = t([3.0, 4.0])
+    out = (x * y).sum()
+    gx, gy = pt.grad(out, [x, y])
+    np.testing.assert_allclose(gx.numpy(), [3.0, 4.0])
+    np.testing.assert_allclose(gy.numpy(), [1.0, 2.0])
+    assert x.grad is None  # .grad not polluted
+
+
+def test_grad_hooks():
+    x = t([1.0, 2.0])
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(seen[0], [3.0, 3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])
+
+
+def test_multi_output_op_grad():
+    x = t(np.random.RandomState(2).randn(4, 6))
+    parts = pt.split(x, 2, axis=1)
+    (parts[0].sum() * 2 + parts[1].sum() * 3).backward()
+    expect = np.concatenate([np.full((4, 3), 2.0), np.full((4, 3), 3.0)], 1)
+    np.testing.assert_allclose(x.grad.numpy(), expect)
+
+
+def test_backward_nonscalar_with_grad():
+    x = t([[1.0, 2.0], [3.0, 4.0]])
+    y = x * 2
+    y.backward(pt.to_tensor([[1.0, 0.0], [0.0, 1.0]]))
+    np.testing.assert_allclose(x.grad.numpy(), [[2.0, 0.0], [0.0, 2.0]])
+
+
+def test_pylayer():
+    class Double(pt.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x, factor):
+            ctx.save_for_backward(x)
+            ctx.factor = factor
+            return x * factor
+
+        @staticmethod
+        def backward(ctx, gy):
+            (x,) = ctx.saved_tensor()
+            return gy * ctx.factor
+
+    x = t([1.0, 2.0])
+    out = Double.apply(x, 3.0)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+
+def test_broadcast_grad():
+    x = t(np.ones((3, 4)))
+    b = t(np.ones((4,)))
+    (x + b).sum().backward()
+    np.testing.assert_allclose(b.grad.numpy(), [3.0] * 4)
+
+
+def test_getitem_grad():
+    x = t(np.arange(6.0).reshape(2, 3))
+    y = x[0, :2].sum()
+    y.backward()
+    expect = np.zeros((2, 3))
+    expect[0, :2] = 1
+    np.testing.assert_allclose(x.grad.numpy(), expect)

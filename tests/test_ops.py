@@ -1,0 +1,199 @@
+"""Op numeric tests vs numpy (the reference's OpTest check_output pattern,
+test/legacy_test/op_test.py:2910, distilled: forward vs numpy reference)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def t(x, sg=True):
+    return pt.to_tensor(x, stop_gradient=sg)
+
+
+RNG = np.random.RandomState(0)
+A = RNG.randn(3, 4).astype(np.float32)
+B = RNG.randn(3, 4).astype(np.float32)
+M = RNG.randn(4, 5).astype(np.float32)
+
+
+@pytest.mark.parametrize("op,npop", [
+    ("add", np.add), ("subtract", np.subtract), ("multiply", np.multiply),
+    ("divide", np.divide), ("maximum", np.maximum), ("minimum", np.minimum),
+    ("atan2", np.arctan2), ("logaddexp", np.logaddexp),
+])
+def test_binary(op, npop):
+    got = getattr(pt, op)(t(A), t(B)).numpy()
+    rtol = 1e-3 if op == "logaddexp" else 1e-4
+    np.testing.assert_allclose(got, npop(A, B), rtol=rtol, atol=1e-5)
+
+
+@pytest.mark.parametrize("op,npop,pos", [
+    ("exp", np.exp, False), ("log", np.log, True), ("sqrt", np.sqrt, True),
+    ("tanh", np.tanh, False), ("sin", np.sin, False), ("cos", np.cos, False),
+    ("abs", np.abs, False), ("floor", np.floor, False),
+    ("ceil", np.ceil, False), ("square", np.square, False),
+    ("log1p", np.log1p, True), ("expm1", np.expm1, False),
+])
+def test_unary(op, npop, pos):
+    x = np.abs(A) + 0.1 if pos else A
+    got = getattr(pt, op)(t(x)).numpy()
+    np.testing.assert_allclose(got, npop(x), rtol=1e-3, atol=1e-5)
+
+
+def test_matmul():
+    np.testing.assert_allclose(pt.matmul(t(A), t(M)).numpy(), A @ M, rtol=1e-5)
+    np.testing.assert_allclose(
+        pt.matmul(t(A), t(A), transpose_y=True).numpy(), A @ A.T, rtol=1e-5)
+    np.testing.assert_allclose((t(A) @ t(M)).numpy(), A @ M, rtol=1e-5)
+
+
+@pytest.mark.parametrize("op,kwargs,npfn", [
+    ("sum", {}, lambda x: x.sum()),
+    ("sum", {"axis": 0}, lambda x: x.sum(0)),
+    ("sum", {"axis": 1, "keepdim": True}, lambda x: x.sum(1, keepdims=True)),
+    ("mean", {"axis": -1}, lambda x: x.mean(-1)),
+    ("max", {"axis": 0}, lambda x: x.max(0)),
+    ("min", {}, lambda x: x.min()),
+    ("prod", {"axis": 1}, lambda x: x.prod(1)),
+    ("std", {}, lambda x: x.std(ddof=1)),
+    ("var", {"axis": 0}, lambda x: x.var(0, ddof=1)),
+])
+def test_reductions(op, kwargs, npfn):
+    got = getattr(pt, op)(t(A), **kwargs).numpy()
+    np.testing.assert_allclose(got, npfn(A), rtol=1e-5, atol=1e-6)
+
+
+def test_argmax_argsort_topk():
+    np.testing.assert_array_equal(pt.argmax(t(A), axis=1).numpy(), A.argmax(1))
+    np.testing.assert_array_equal(pt.argsort(t(A), axis=1).numpy(), A.argsort(1))
+    v, i = pt.topk(t(A), 2, axis=1)
+    expect = np.sort(A, axis=1)[:, ::-1][:, :2]
+    np.testing.assert_allclose(v.numpy(), expect, rtol=1e-6)
+
+
+def test_manipulation():
+    x = t(A)
+    assert pt.reshape(x, [4, 3]).shape == [4, 3]
+    assert pt.reshape(x, [-1]).shape == [12]
+    assert pt.transpose(x, [1, 0]).shape == [4, 3]
+    assert pt.unsqueeze(x, 0).shape == [1, 3, 4]
+    assert pt.squeeze(pt.unsqueeze(x, 0), 0).shape == [3, 4]
+    assert pt.flatten(x).shape == [12]
+    assert pt.concat([x, x], axis=1).shape == [3, 8]
+    assert pt.stack([x, x]).shape == [2, 3, 4]
+    parts = pt.split(x, 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == [3, 2]
+    parts = pt.split(x, [1, 3], axis=1)
+    assert parts[0].shape == [3, 1] and parts[1].shape == [3, 3]
+    assert pt.tile(x, [2, 1]).shape == [6, 4]
+    assert pt.expand(pt.ones([1, 4]), [3, 4]).shape == [3, 4]
+    np.testing.assert_allclose(pt.flip(x, axis=0).numpy(), A[::-1], rtol=1e-6)
+    np.testing.assert_allclose(pt.roll(x, 1, axis=0).numpy(),
+                               np.roll(A, 1, axis=0), rtol=1e-6)
+
+
+def test_gather_scatter():
+    x = t(A)
+    idx = pt.to_tensor([2, 0])
+    np.testing.assert_allclose(pt.gather(x, idx, axis=0).numpy(), A[[2, 0]])
+    np.testing.assert_allclose(pt.index_select(x, idx, axis=1).numpy(),
+                               A[:, [2, 0]])
+    base = pt.zeros([4, 3])
+    upd = t(RNG.randn(2, 3).astype(np.float32))
+    out = pt.scatter(base, pt.to_tensor([1, 3]), upd)
+    expect = np.zeros((4, 3), np.float32)
+    expect[[1, 3]] = upd.numpy()
+    np.testing.assert_allclose(out.numpy(), expect)
+    # gather_nd
+    gx = t(np.arange(12).reshape(3, 4).astype(np.float32))
+    gidx = pt.to_tensor([[0, 1], [2, 3]])
+    np.testing.assert_allclose(pt.gather_nd(gx, gidx).numpy(), [1.0, 11.0])
+
+
+def test_where_comparisons():
+    c = pt.where(t(A) > 0, t(A), pt.zeros_like(t(A)))
+    np.testing.assert_allclose(c.numpy(), np.where(A > 0, A, 0))
+    assert bool(pt.allclose(t(A), t(A.copy())))
+    assert not bool(pt.allclose(t(A), t(B)))
+    assert bool(pt.equal_all(t(A), t(A.copy())))
+    np.testing.assert_array_equal((t(A) == t(A)).numpy(), np.ones_like(A, bool))
+
+
+def test_creation():
+    assert pt.zeros([2, 3]).numpy().sum() == 0
+    assert pt.ones([2, 3], dtype="int32").dtype == pt.int32
+    np.testing.assert_array_equal(pt.arange(5).numpy(), np.arange(5))
+    np.testing.assert_array_equal(pt.eye(3).numpy(), np.eye(3, dtype=np.float32))
+    np.testing.assert_allclose(pt.full([2], 3.5).numpy(), [3.5, 3.5])
+    np.testing.assert_allclose(pt.linspace(0, 1, 5).numpy(),
+                               np.linspace(0, 1, 5), rtol=1e-6)
+    assert pt.ones_like(t(A)).shape == [3, 4]
+    np.testing.assert_allclose(pt.tril(t(A)).numpy(), np.tril(A))
+    np.testing.assert_allclose(pt.triu(t(A)).numpy(), np.triu(A))
+
+
+def test_linalg():
+    S = (A @ A.T + 3 * np.eye(3)).astype(np.float32)
+    np.testing.assert_allclose(pt.inverse(t(S)).numpy(), np.linalg.inv(S),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(pt.det(t(S)).item(), np.linalg.det(S), rtol=1e-4)
+    np.testing.assert_allclose(pt.norm(t(A)).item(), np.linalg.norm(A), rtol=1e-5)
+    L = pt.cholesky(t(S))
+    np.testing.assert_allclose((L @ L.T).numpy(), S, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        pt.einsum("ij,jk->ik", t(A), t(M)).numpy(), A @ M, rtol=1e-5)
+    sol = pt.solve(t(S), t(A))
+    np.testing.assert_allclose(sol.numpy(), np.linalg.solve(S, A),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_indexing():
+    x = t(A)
+    np.testing.assert_allclose(x[1].numpy(), A[1])
+    np.testing.assert_allclose(x[:, 1:3].numpy(), A[:, 1:3])
+    np.testing.assert_allclose(x[1, 2].item(), A[1, 2], rtol=1e-6)
+    np.testing.assert_allclose(x[t(np.array([0, 2]))].numpy(), A[[0, 2]])
+    y = t(A.copy())
+    y[0] = 0.0
+    assert y.numpy()[0].sum() == 0
+    y[1, 2] = 7.0
+    assert y.numpy()[1, 2] == 7.0
+    # boolean mask indexing
+    np.testing.assert_allclose(x[x > 0].numpy(), A[A > 0])
+
+
+def test_cast_dtype():
+    x = t(A)
+    assert x.astype("bfloat16").dtype == pt.bfloat16
+    assert x.astype(pt.int32).dtype == pt.int32
+    assert pt.cast(x, "float16").dtype == pt.float16
+
+
+def test_random_ops():
+    pt.seed(7)
+    a = pt.rand([100, 100])
+    assert 0.4 < a.mean().item() < 0.6
+    b = pt.randn([1000])
+    assert -0.2 < b.mean().item() < 0.2
+    c = pt.randint(0, 5, [100])
+    assert int(c.max()) <= 4 and int(c.min()) >= 0
+    p = pt.randperm(10)
+    assert sorted(p.tolist()) == list(range(10))
+    pt.seed(7)
+    a2 = pt.rand([100, 100])
+    np.testing.assert_allclose(a.numpy(), a2.numpy())
+
+
+def test_cumulative():
+    np.testing.assert_allclose(pt.cumsum(t(A), axis=1).numpy(),
+                               np.cumsum(A, 1), rtol=1e-5)
+    np.testing.assert_allclose(pt.cumprod(t(A), dim=0).numpy(),
+                               np.cumprod(A, 0), rtol=1e-5)
+
+
+def test_pad():
+    x = t(A)
+    out = pt.pad(x, [1, 2], value=0.0)
+    assert out.shape == [3, 7]
+    out4 = pt.pad(t(RNG.randn(2, 3, 4, 5).astype(np.float32)), [1, 1, 2, 2])
+    assert out4.shape == [2, 3, 8, 7]
