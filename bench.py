@@ -1,0 +1,83 @@
+"""Benchmark: flagship Llama pretrain step MFU on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline (BASELINE.md north star): 40% MFU for Llama pretrain. vs_baseline
+is measured MFU / 0.40.
+"""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+_PEAK_BF16 = {
+    "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12, "v5": 459e12,
+    "v4": 275e12, "v6 lite": 918e12, "v6e": 918e12,
+}
+
+
+def peak_flops(dev) -> float:
+    kind = getattr(dev, "device_kind", "").lower()
+    for key, val in _PEAK_BF16.items():
+        if key in kind:
+            return val
+    return 197e12  # assume v5e
+
+
+def main():
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.parallel import init_hybrid_mesh
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = L.LlamaConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+            num_hidden_layers=16, num_attention_heads=8,
+            num_key_value_heads=8, max_position_embeddings=2048,
+            dtype=jnp.bfloat16, remat=False, use_flash_attention=True)
+        B, T, iters = 4, 2048, 10
+    else:  # CI/smoke fallback
+        cfg = L.LlamaConfig.tiny(dtype=jnp.float32,
+                                 use_flash_attention=False, remat=False)
+        B, T, iters = 4, 64, 3
+
+    hm = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
+    with hm.mesh:
+        step, init = L.make_train_step(cfg, hm.mesh)
+        state = init(jax.random.PRNGKey(0))
+        batch = L.make_batch(cfg, batch_size=B, seq_len=T, mesh=hm.mesh)
+        state, loss = step(state, batch)  # compile + warmup
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, loss = step(state, batch)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / iters
+
+    # PaLM-style MFU accounting: per-token train FLOPs = 6N + 6*L*D*T (causal)
+    D, L_, V = cfg.hidden_size, cfg.num_hidden_layers, cfg.vocab_size
+    H, Hkv, Dh, F = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                     cfg.head_dim, cfg.intermediate_size)
+    n_params = (V * D * 2  # embed + lm_head
+                + L_ * (D * H * Dh + 2 * D * Hkv * Dh + H * Dh * D
+                        + 3 * D * F))
+    tokens = B * T
+    flops = (6 * n_params + 6 * L_ * D * T) * tokens
+    mfu = flops / dt / peak_flops(jax.devices()[0])
+    tok_s = tokens / dt
+
+    print(json.dumps({
+        "metric": "llama_pretrain_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "vs_baseline": round(mfu / 0.40, 4),
+        "tokens_per_sec": round(tok_s, 1),
+        "step_ms": round(dt * 1e3, 2),
+        "loss": float(loss),
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,10 @@
+"""paddle_tpu.models — reference model families, TPU-first.
+
+The flagship pretrain path (llama.py) is functional JAX: params are a pytree,
+the train step is one jitted SPMD program over the hybrid mesh. Eager
+``nn.Layer`` wrappers exist for the vision models (lenet.py, resnet.py),
+mirroring the reference's python/paddle/vision/models/.
+"""
+from . import llama
+from .llama import LlamaConfig
+from .lenet import LeNet

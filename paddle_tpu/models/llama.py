@@ -1,0 +1,322 @@
+"""Llama-family decoder, functional and TPU-first.
+
+This is the flagship pretrain path: the capability target is the reference's
+hybrid-parallel Llama training stack (fleet TP layers mp_layers.py, pipeline
+schedules pipeline_parallel.py, sharding optimizer, sequence-parallel utils —
+see SURVEY.md §2.8/§3.4), redesigned as ONE jitted SPMD program:
+
+  - params are a plain pytree; per-layer weights are stacked on a leading
+    layer axis and consumed by ``lax.scan`` (fast compiles, XLA-friendly);
+  - TP  = GSPMD sharding annotations on weights (column/row parallel exactly
+    where fleet's ColumnParallelLinear/RowParallelLinear shard);
+  - SP  = sequence-sharded residual stream between blocks over the tp axis
+    (megatron sequence parallel, sequence_parallel_utils.py:427);
+  - PP  = microbatch pipeline via parallel.pipeline_spmd (collective-permute
+    ring instead of NCCL isend/irecv);
+  - DP/ZeRO = batch sharded over dp; optimizer state sharded like params.
+
+XLA inserts every collective (all-gather / reduce-scatter / ppermute) from
+the sharding annotations — there is no hand-written communication here.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..parallel.pipeline_spmd import pipeline_spmd, microbatch
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.bfloat16
+    # parallelism
+    pp_stages: int = 1
+    num_microbatches: int = 1
+    remat: bool = True
+    # kernels
+    use_flash_attention: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        return LlamaConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test/dryrun config."""
+        return LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=4, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=128, **kw)
+
+
+# ---------------------------------------------------------------------------
+# params
+# ---------------------------------------------------------------------------
+
+def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
+    """Init a params pytree; per-layer tensors stacked on a leading L axis."""
+    D, F, V = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    L = cfg.num_hidden_layers
+    ks = jax.random.split(key, 10)
+
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) *
+                (1.0 / np.sqrt(fan_in))).astype(cfg.dtype)
+
+    layers = {
+        "wq": init(ks[0], (L, D, H * Dh), D),
+        "wk": init(ks[1], (L, D, Hkv * Dh), D),
+        "wv": init(ks[2], (L, D, Hkv * Dh), D),
+        "wo": init(ks[3], (L, H * Dh, D), H * Dh),
+        "w_gate": init(ks[4], (L, D, F), D),
+        "w_up": init(ks[5], (L, D, F), D),
+        "w_down": init(ks[6], (L, F, D), F),
+        "attn_norm": jnp.ones((L, D), cfg.dtype),
+        "mlp_norm": jnp.ones((L, D), cfg.dtype),
+    }
+    return {
+        "embed": init(ks[7], (V, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), cfg.dtype),
+        "lm_head": init(ks[8], (D, V), D),
+    }
+
+
+def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:
+    """PartitionSpecs: where fleet's TP layers shard, we annotate.
+
+    Column-parallel (out-dim on tp): wq/wk/wv, w_gate/w_up — fleet's
+    ColumnParallelLinear (mp_layers.py). Row-parallel (in-dim on tp):
+    wo, w_down — RowParallelLinear. Vocab-parallel embedding shards the
+    vocab dim; lm_head is column-parallel over vocab (ParallelCrossEntropy
+    consumes vocab-sharded logits). Leading axis of layer weights is the
+    layer/stage axis: sharded over pp when pipelining.
+    """
+    pp = "pp" if cfg.pp_stages > 1 else None
+    layers = {
+        "wq": P(pp, None, "tp"),
+        "wk": P(pp, None, "tp"),
+        "wv": P(pp, None, "tp"),
+        "wo": P(pp, "tp", None),
+        "w_gate": P(pp, None, "tp"),
+        "w_up": P(pp, None, "tp"),
+        "w_down": P(pp, "tp", None),
+        "attn_norm": P(pp, None),
+        "mlp_norm": P(pp, None),
+    }
+    return {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def shard_params(params, cfg: LlamaConfig, mesh: Mesh):
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+# ---------------------------------------------------------------------------
+# model math
+# ---------------------------------------------------------------------------
+
+def rms_norm(x, weight, eps):
+    dt = x.dtype
+    x = x.astype(jnp.float32)
+    x = x * lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    return (x * weight.astype(jnp.float32)).astype(dt)
+
+
+def rope(q, k, positions, theta, head_dim):
+    """Rotary embedding applied to [B, T, H, Dh] q/k."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B,T,half]
+    cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+
+    def rot(x):
+        x1, x2 = x[..., :half], x[..., half:]
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                              axis=-1)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def attention(q, k, v, cfg: LlamaConfig):
+    """Causal GQA attention, dense path (single implementation lives in
+    ops/pallas/flash_attention; this forces impl='dense')."""
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    return _fa(q, k, v, causal=True, impl="dense")
+
+
+def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None):
+    """One transformer block on [B, T, D]. ``lp`` holds this layer's
+    (unstacked) weights."""
+    B, T, D = h.shape
+    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+
+    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(B, T, H, Dh)
+    k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
+    v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
+    q, k = rope(q, k, positions, cfg.rope_theta, Dh)
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    o = _fa(q, k, v, causal=True,
+            impl="auto" if cfg.use_flash_attention else "dense")
+    h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
+    if sp_spec is not None:
+        # sequence-parallel residual stream: reduce-scatter the row-parallel
+        # output over tp along the seq dim (sequence_parallel_utils.py:427)
+        h = lax.with_sharding_constraint(h, sp_spec)
+
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    if sp_spec is not None:
+        h = lax.with_sharding_constraint(h, sp_spec)
+    return h
+
+
+def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False):
+    fn = partial(decoder_layer, cfg=cfg, sp_spec=sp_spec)
+    if remat:
+        fn = jax.checkpoint(fn)
+
+    def body(carry, lp):
+        return fn(lp, carry), None
+
+    h, _ = lax.scan(body, h, layer_params)
+    return h
+
+
+def forward(params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
+    """tokens [B, T] -> logits [B, T, V]. Single pipeline stage (pp=1)."""
+    sp_spec = None
+    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+        sp_spec = NamedSharding(mesh, P("dp", "tp", None))
+    h = params["embed"].astype(cfg.dtype)[tokens]
+    if sp_spec is not None:
+        h = lax.with_sharding_constraint(h, sp_spec)
+    h = _scan_layers(params["layers"], h, cfg, sp_spec, remat=cfg.remat)
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h @ params["lm_head"]
+
+
+def _split_stages(layer_params, cfg: LlamaConfig):
+    """[L, ...] stacked layers -> [S, L/S, ...] (stage axis leading)."""
+    S = cfg.pp_stages
+    L = cfg.num_hidden_layers
+    assert L % S == 0, f"layers {L} not divisible by pp_stages {S}"
+    return jax.tree_util.tree_map(
+        lambda x: x.reshape((S, L // S) + x.shape[1:]), layer_params)
+
+
+def forward_pipelined(params, tokens, cfg: LlamaConfig, mesh: Mesh):
+    """Full pp×tp×sp×dp forward: embed → pipeline over stages → head."""
+    sp_spec = (NamedSharding(mesh, P(None, "dp", "tp", None))
+               if mesh.shape.get("tp", 1) > 1 else None)
+    h = params["embed"].astype(cfg.dtype)[tokens]          # [B, T, D]
+    h = microbatch(h, cfg.num_microbatches)                # [M, mb, T, D]
+    h = lax.with_sharding_constraint(
+        h, NamedSharding(mesh, P(None, "dp", "tp" if sp_spec is not None else None, None)))
+
+    stage_params = _split_stages(params["layers"], cfg)
+
+    def stage_fn(sp, x):
+        inner_sp = sp_spec.spec if sp_spec is not None else None
+        inner = NamedSharding(mesh, P(*inner_sp[1:])) if sp_spec is not None else None
+        return _scan_layers(sp, x, cfg, inner, remat=False)
+
+    h = pipeline_spmd(stage_fn, stage_params, h,
+                      num_stages=cfg.pp_stages, remat=cfg.remat)
+    h = h.reshape((-1,) + h.shape[2:])                     # [B, T, D]
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h @ params["lm_head"]
+
+
+# ---------------------------------------------------------------------------
+# loss / train step
+# ---------------------------------------------------------------------------
+
+def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
+    """Next-token cross entropy. Logits stay vocab-sharded (tp) — the
+    softmax over a sharded axis is GSPMD's ParallelCrossEntropy
+    (mp_ops.py _c_softmax_with_cross_entropy equivalent)."""
+    tokens, labels = batch["tokens"], batch["labels"]
+    if mesh is not None and cfg.pp_stages > 1:
+        logits = forward_pipelined(params, tokens, cfg, mesh)
+    else:
+        logits = forward(params, tokens, cfg, mesh)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return nll.mean()
+
+
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
+    """Build the jitted SPMD train step (fwd+bwd+adamw) over ``mesh``.
+
+    Returns (step_fn, init_fn). ``init_fn(key, lr)`` places params and
+    optimizer state sharded on the mesh (optimizer state inherits the param
+    sharding = ZeRO-style sharded state, dygraph_sharding_optimizer.py:48
+    equivalent comes free); ``step_fn(state, batch)`` is one update.
+    """
+    import optax
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+
+    def init_fn(key):
+        params = init_params(cfg, key)
+        params = shard_params(params, cfg, mesh)
+        opt_state = optimizer.init(params)
+        return {"params": params, "opt": opt_state, "step": jnp.zeros((), jnp.int32)}
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch, cfg, mesh)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": opt,
+                "step": state["step"] + 1}, loss
+
+    return step_fn, init_fn
+
+
+def make_batch(cfg: LlamaConfig, batch_size: int, seq_len: int, mesh: Mesh,
+               key=None):
+    """Synthetic next-token batch, dp-sharded."""
+    key = key if key is not None else jax.random.PRNGKey(0)
+    toks = jax.random.randint(key, (batch_size, seq_len + 1), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    sh = NamedSharding(mesh, P("dp", None))
+    return {"tokens": jax.device_put(toks[:, :-1], sh),
+            "labels": jax.device_put(toks[:, 1:], sh)}

@@ -164,25 +164,43 @@ def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
 # -- pooling ----------------------------------------------------------------
 
 def _pool(x, ksize, stride, padding, spatial, data_format, reducer, init,
-          ceil_mode=False, count_include_pad=True, average=False):
+          ceil_mode=False, count_include_pad=True, average=False,
+          return_mask=False):
     channel_last = data_format[-1] == "C"
     k = _pair(ksize, spatial)
     s = _pair(stride if stride is not None else ksize, spatial)
     pad = _conv_padding(padding, spatial)
+    spatial_axes = (tuple(range(1, 1 + spatial)) if channel_last
+                    else tuple(range(2, 2 + spatial)))
     if channel_last:
         dims = (1,) + k + (1,)
         strides = (1,) + s + (1,)
     else:
         dims = (1, 1) + k
         strides = (1, 1) + s
+    extra = [0] * spatial
     if isinstance(pad, str):
-        padding_cfg = pad
-    elif channel_last:
-        padding_cfg = [(0, 0)] + list(pad) + [(0, 0)]
+        padding_cfg = pad  # SAME/VALID: ceil_mode has no effect
+        pad_pairs = None
     else:
-        padding_cfg = [(0, 0), (0, 0)] + list(pad)
+        pad_pairs = [tuple(p) for p in pad]
+        if ceil_mode:
+            # extend the high side so the output size rounds up: the last
+            # window may start inside the (orig-)padded input and hang over
+            for i, ax in enumerate(spatial_axes):
+                span = x.shape[ax] + pad_pairs[i][0] + pad_pairs[i][1] - k[i]
+                rem = span % s[i]
+                if rem:
+                    extra[i] = s[i] - rem
+        full = [(lo, hi + e) for (lo, hi), e in zip(pad_pairs, extra)]
+        if channel_last:
+            padding_cfg = [(0, 0)] + full + [(0, 0)]
+        else:
+            padding_cfg = [(0, 0), (0, 0)] + full
     if init == -jnp.inf:
-        init_val = (jnp.finfo(x.dtype).min
+        # floats must use -inf: reduce_window's VJP only recognises the
+        # max monoid with its identity as init
+        init_val = (jnp.asarray(-jnp.inf, x.dtype)
                     if jnp.issubdtype(x.dtype, jnp.floating)
                     else jnp.iinfo(x.dtype).min)
     else:
@@ -190,23 +208,77 @@ def _pool(x, ksize, stride, padding, spatial, data_format, reducer, init,
     out = jax.lax.reduce_window(x, init_val, reducer, dims, strides,
                                 padding_cfg)
     if average:
-        padded = (not isinstance(pad, str)) and any(p[0] or p[1] for p in pad)
-        if not padded or count_include_pad:
+        padded = pad_pairs is not None and any(p[0] or p[1] for p in pad_pairs)
+        if (not padded or count_include_pad) and not any(extra):
             out = out / np.prod(k)
         else:
-            ones = jnp.ones_like(x)
-            counts = jax.lax.reduce_window(ones, jnp.asarray(0.0, x.dtype),
-                                           jax.lax.add, dims, strides,
-                                           padding_cfg)
+            # per-window divisor: data cells always count, original padding
+            # counts iff count_include_pad, ceil-mode extra never counts
+            mask_cfg = [(0, 0)] * x.ndim
+            extra_cfg = [(0, 0)] * x.ndim
+            for i, ax in enumerate(spatial_axes):
+                mask_cfg[ax] = pad_pairs[i]
+                extra_cfg[ax] = (0, extra[i])
+            ones = jnp.pad(jnp.ones_like(x), mask_cfg,
+                           constant_values=1 if count_include_pad else 0)
+            counts = jax.lax.reduce_window(
+                ones, jnp.asarray(0.0, x.dtype), jax.lax.add, dims, strides,
+                extra_cfg)
             out = out / counts
+    if return_mask:
+        return out, _pool_argmax_mask(x, k, s, pad_pairs, extra,
+                                      spatial_axes, channel_last)
     return out
+
+
+def _pool_argmax_mask(x, k, s, pad_pairs, extra, spatial_axes, channel_last):
+    """Flattened-spatial argmax index per pooling window (paddle's
+    max_poolNd(..., return_mask=True) second output)."""
+    if pad_pairs is None:
+        raise NotImplementedError("return_mask with string padding")
+    if channel_last:
+        raise NotImplementedError("return_mask requires channel-first layout")
+    # finite sentinel: patches are conv-based, and -inf * 0 kernel taps = NaN
+    neg = (jnp.finfo(x.dtype).min
+           if jnp.issubdtype(x.dtype, jnp.floating)
+           else jnp.iinfo(x.dtype).min)
+    cfg = [(0, 0)] * x.ndim
+    for i, ax in enumerate(spatial_axes):
+        cfg[ax] = (pad_pairs[i][0], pad_pairs[i][1] + extra[i])
+    xp = jnp.pad(x, cfg, constant_values=neg)
+    N, C = x.shape[0], x.shape[1]
+    # patches: [N, C*prod(k), *out_spatial], window-position-major over C
+    patches = jax.lax.conv_general_dilated_patches(
+        xp, filter_shape=k, window_strides=s,
+        padding=[(0, 0)] * len(k))
+    out_sp = patches.shape[2:]
+    patches = patches.reshape((N, C, int(np.prod(k))) + out_sp)
+    am = jnp.argmax(patches, axis=2)  # window-local flat index
+    # map to global flattened index over the UNPADDED spatial dims
+    in_sp = [x.shape[ax] for ax in spatial_axes]
+    local = []
+    rem = am
+    for ki in k[::-1]:
+        local.append(rem % ki)
+        rem = rem // ki
+    local = local[::-1]  # per-dim local offsets
+    flat = jnp.zeros_like(am)
+    for d in range(len(k)):
+        idx = jnp.arange(out_sp[d])
+        shape = [1] * am.ndim
+        shape[2 + d] = out_sp[d]
+        start = (idx * s[d] - pad_pairs[d][0]).reshape(shape)
+        coord = jnp.clip(start + local[d], 0, in_sp[d] - 1)
+        flat = flat * in_sp[d] + coord
+    return flat
 
 
 @register_op(name="max_pool2d")
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
     return _pool(x, kernel_size, stride, padding, 2, data_format,
-                 jax.lax.max, -jnp.inf)
+                 jax.lax.max, -jnp.inf, ceil_mode=ceil_mode,
+                 return_mask=return_mask)
 
 
 @register_op(name="avg_pool2d")
@@ -214,7 +286,7 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCHW",
                name=None):
     return _pool(x, kernel_size, stride, padding, 2, data_format,
-                 jax.lax.add, 0.0, average=True,
+                 jax.lax.add, 0.0, average=True, ceil_mode=ceil_mode,
                  count_include_pad=not exclusive)
 
 
@@ -222,14 +294,15 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
     return _pool(x, kernel_size, stride, padding, 1, "NCW",
-                 jax.lax.max, -jnp.inf)
+                 jax.lax.max, -jnp.inf, ceil_mode=ceil_mode,
+                 return_mask=return_mask)
 
 
 @register_op(name="avg_pool1d")
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
                ceil_mode=False, name=None):
     return _pool(x, kernel_size, stride, padding, 1, "NCW",
-                 jax.lax.add, 0.0, average=True,
+                 jax.lax.add, 0.0, average=True, ceil_mode=ceil_mode,
                  count_include_pad=not exclusive)
 
 
@@ -237,7 +310,8 @@ def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
     return _pool(x, kernel_size, stride, padding, 3, data_format,
-                 jax.lax.max, -jnp.inf)
+                 jax.lax.max, -jnp.inf, ceil_mode=ceil_mode,
+                 return_mask=return_mask)
 
 
 @register_op(name="avg_pool3d")
@@ -245,7 +319,7 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                exclusive=True, divisor_override=None, data_format="NCDHW",
                name=None):
     return _pool(x, kernel_size, stride, padding, 3, data_format,
-                 jax.lax.add, 0.0, average=True,
+                 jax.lax.add, 0.0, average=True, ceil_mode=ceil_mode,
                  count_include_pad=not exclusive)
 
 

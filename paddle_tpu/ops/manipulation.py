@@ -371,8 +371,16 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
         pad_from_left_axis=True, name=None):
     pad = [int(p) for p in pad]
     nd = x.ndim
+    if len(pad) % 2:
+        raise ValueError(
+            f"pad length must be even (lo/hi pairs), got {len(pad)}")
+    if len(pad) > 2 * nd:
+        raise ValueError(
+            f"pad specifies {len(pad) // 2} dims but input has only {nd}")
     if len(pad) == 2 * nd:
         pairs = [(pad[2 * i], pad[2 * i + 1]) for i in range(nd)]
+        if not pad_from_left_axis:  # spec runs from the last axis backwards
+            pairs = pairs[::-1]
     else:
         # partial spec pads spatial dims from the LAST dim backwards
         # (paddle/torch convention: [w_lo, w_hi, h_lo, h_hi, ...])
@@ -380,6 +388,8 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW",
         pairs = [(0, 0)] * nd
         spatial = (list(range(1, nd - 1)) if data_format.endswith("C")
                    else list(range(2, nd)))  # NHWC vs NCHW layouts
+        if len(spatial) < k:  # low-rank input: pad the last k dims
+            spatial = list(range(nd - k, nd))
         for i in range(k):
             pairs[spatial[-1 - i]] = (pad[2 * i], pad[2 * i + 1])
     mode_map = {"constant": "constant", "reflect": "reflect",

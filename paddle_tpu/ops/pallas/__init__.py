@@ -1,0 +1,8 @@
+"""paddle_tpu.ops.pallas — hand-written TPU kernels (Pallas/Mosaic).
+
+The capability counterpart of the reference's fused CUDA kernel library
+(paddle/phi/kernels/fusion/gpu/, fusion/cutlass/ — fused attention, rope,
+rms_norm, MoE dispatch). On TPU the hot ops are Pallas kernels; every entry
+point keeps a pure-XLA fallback so the same code runs on the CPU test mesh.
+"""
+from . import flash_attention

@@ -1,0 +1,81 @@
+"""Flash attention for TPU.
+
+Counterpart of the reference's flash_attn kernels
+(paddle/phi/kernels/gpu/flash_attn_kernel.cu, exposed at
+python/paddle/nn/functional/flash_attention.py:242): tiled
+online-softmax attention that never materialises the [T, T] score matrix.
+On TPU we dispatch to the Pallas flash kernel that ships with JAX
+(jax.experimental.pallas.ops.tpu.flash_attention — block-tiled for the MXU,
+fwd+bwd); elsewhere (the 8-device CPU test mesh) a dense XLA path with
+identical semantics runs instead.
+
+Layout contract: q/k/v are [B, T, H, Dh] (time-major like the reference's
+python API); GQA (fewer kv heads) is handled by logical broadcast.
+"""
+from __future__ import annotations
+
+import warnings
+from functools import partial
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_warned_fallback = False
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _dense_reference(q, k, v, causal, sm_scale):
+    B, T, H, Dh = q.shape
+    S = k.shape[1]
+    scores = jnp.einsum("bthd,bshd->bhts", q, k) * sm_scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, S), bool), k=S - T)
+        scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    else:
+        scores = scores.astype(jnp.float32)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, sm_scale=None,
+                    impl: str = "auto"):
+    """[B, T, H, Dh] attention; returns [B, T, H, Dh].
+
+    impl: "auto" (pallas on TPU when shapes allow, dense otherwise),
+    "pallas" (error if unavailable), or "dense".
+    """
+    H, Dh = q.shape[2], q.shape[3]
+    Hkv = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(Dh)
+    if Hkv != H:
+        rep = H // Hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+
+    pallas_ok = _on_tpu() and Dh % 128 == 0 and q.shape[1] % 128 == 0
+    if impl == "pallas" or (impl == "auto" and pallas_ok):
+        try:
+            from jax.experimental.pallas.ops.tpu.flash_attention import (
+                flash_attention as _pallas_flash)
+            # pallas kernel layout is [B, H, T, Dh]
+            qt, kt, vt = (x.transpose(0, 2, 1, 3) for x in (q, k, v))
+            out = _pallas_flash(qt, kt, vt, causal=causal, sm_scale=sm_scale)
+            return out.transpose(0, 2, 1, 3)
+        except Exception as e:
+            if impl == "pallas":
+                raise
+            global _warned_fallback
+            if not _warned_fallback:
+                _warned_fallback = True
+                warnings.warn(
+                    f"pallas flash attention unavailable, using dense "
+                    f"O(T^2) fallback: {type(e).__name__}: {e}")
+    return _dense_reference(q, k, v, causal, sm_scale)

@@ -1,0 +1,16 @@
+"""paddle_tpu.parallel — device-mesh topology and SPMD parallelism.
+
+TPU-native replacement for the reference's fleet hybrid-parallel stack
+(python/paddle/distributed/fleet/base/topology.py:70,189-238 and
+meta_parallel/): instead of NCCL process groups per axis, one
+``jax.sharding.Mesh`` with named axes carries every parallelism dimension,
+and XLA GSPMD inserts the collectives over ICI.
+"""
+from .mesh import (
+    HybridMesh,
+    init_hybrid_mesh,
+    get_hybrid_mesh,
+    mesh_axis_size,
+    P,
+)
+from .pipeline_spmd import pipeline_spmd, stack_stage_params

@@ -1,0 +1,123 @@
+"""Hybrid-parallel topology over a jax.sharding.Mesh.
+
+Redesign of the reference's ``HybridCommunicateGroup``
+(python/paddle/distributed/fleet/base/topology.py:70,189-238): the reference
+builds one NCCL communicator per parallelism axis (data/pipe/sharding/sep/
+model); here the same topology is expressed as ONE device mesh with named
+axes, and per-axis "groups" are simply the mesh axis names used in
+PartitionSpecs / collective calls. XLA GSPMD then emits the collectives so
+they ride ICI neighbours instead of host networking.
+
+Axis naming convention (matching fleet's order topology.py:189):
+  - ``dp``   data parallel (batch sharding; also ZeRO/sharding axis)
+  - ``pp``   pipeline parallel (stage sharding)
+  - ``tp``   tensor/model parallel (megatron TP; sequence parallel
+             reuses this axis, as megatron-SP does in the reference's
+             sequence_parallel_utils.py)
+  - ``ep``   expert parallel (MoE dispatch axis; may alias dp)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+_GLOBAL_MESH: Optional["HybridMesh"] = None
+
+
+@dataclasses.dataclass
+class HybridMesh:
+    """A named-axis device mesh + the fleet-style degree bookkeeping.
+
+    ``mesh`` is the jax Mesh; the ``*_degree`` properties mirror the
+    reference's ``HybridCommunicateGroup.get_*_parallel_world_size`` API
+    surface (topology.py:262-331) so user code can query the topology the
+    same way.
+    """
+
+    mesh: Mesh
+
+    # -- degrees ------------------------------------------------------------
+    def degree(self, axis: str) -> int:
+        return self.mesh.shape.get(axis, 1)
+
+    @property
+    def dp_degree(self) -> int:
+        return self.degree("dp")
+
+    @property
+    def pp_degree(self) -> int:
+        return self.degree("pp")
+
+    @property
+    def tp_degree(self) -> int:
+        return self.degree("tp")
+
+    @property
+    def ep_degree(self) -> int:
+        return self.degree("ep")
+
+    @property
+    def world_size(self) -> int:
+        return int(np.prod(list(self.mesh.shape.values())))
+
+    # -- sharding helpers ---------------------------------------------------
+    def sharding(self, *spec) -> NamedSharding:
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def __enter__(self):
+        self._ctx = self.mesh.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self.mesh.__exit__(*exc)
+
+
+def init_hybrid_mesh(
+    dp: int = 1,
+    pp: int = 1,
+    tp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    set_global: bool = True,
+) -> HybridMesh:
+    """Build the hybrid mesh, fleet's ``fleet.init(strategy)`` equivalent.
+
+    Axis order is (dp, pp, tp): pp and tp innermost so stage/tensor
+    collectives ride nearest-neighbour ICI links, dp outermost (its
+    all-reduce tolerates the longer hops / DCN), matching the layout intent
+    of the reference's order (topology.py:189 'data','pipe','sharding',
+    'sep','model' — model innermost).
+
+    ``ep`` (expert parallel) aliases a slice of dp*tp rather than adding a
+    fourth physical axis; MoE layers reshape to it explicitly.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    need = dp * pp * tp
+    if len(devices) < need:
+        raise ValueError(
+            f"mesh dp*pp*tp={need} exceeds available devices {len(devices)}")
+    arr = np.array(devices[:need]).reshape(dp, pp, tp)
+    mesh = Mesh(arr, axis_names=("dp", "pp", "tp"))
+    hm = HybridMesh(mesh=mesh)
+    if set_global:
+        global _GLOBAL_MESH
+        _GLOBAL_MESH = hm
+    return hm
+
+
+def get_hybrid_mesh() -> Optional[HybridMesh]:
+    return _GLOBAL_MESH
+
+
+def mesh_axis_size(axis: str) -> int:
+    hm = get_hybrid_mesh()
+    return hm.degree(axis) if hm is not None else 1

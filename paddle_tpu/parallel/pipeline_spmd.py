@@ -1,0 +1,86 @@
+"""SPMD pipeline parallelism inside one jitted program.
+
+TPU-native redesign of the reference's microbatch pipeline schedules
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:565
+``forward_backward_pipeline`` and pp_utils/p2p_communication.py isend/irecv):
+instead of per-rank Python schedule loops exchanging activations over NCCL
+p2p, the whole pipeline is ONE traced computation. A buffer of per-stage
+microbatch states carries the leading ``pp``-sharded stage axis; shifting the
+buffer by one slot each step lowers to an XLA ``collective_permute`` over the
+ICI ring, and every stage's compute runs concurrently inside a single
+``lax.scan`` step (the GPipe schedule; fill/drain bubbles included).
+
+Because the schedule is traced, ``jax.grad`` through it yields the reverse
+pipeline automatically — the backward bubble mirrors forward, which is what
+the reference's hand-written 1F1B achieves by interleaving; XLA's scheduler
+overlaps the permute with compute.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def stack_stage_params(params_list):
+    """Stack per-stage pytrees into one pytree with a leading stage axis
+    (shard it with PartitionSpec('pp', ...))."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+
+def pipeline_spmd(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    x: jax.Array,
+    *,
+    num_stages: int,
+    remat: bool = True,
+):
+    """Run ``x``'s microbatches through ``num_stages`` pipeline stages.
+
+    Args:
+      stage_fn: ``(params_s, state) -> state`` for ONE stage; vmapped over
+        the stage axis so every stage computes concurrently.
+      stage_params: pytree whose leaves have leading dim ``num_stages``
+        (see stack_stage_params); shard that axis over the mesh's ``pp``.
+      x: ``[M, mb, ...]`` microbatched input (M = number of microbatches).
+      remat: rematerialise stage activations in the backward pass
+        (the reference's recompute pass; trades FLOPs for HBM).
+
+    Returns ``[M, mb, ...]`` outputs, each having passed through all stages.
+    """
+    S = num_stages
+    M = x.shape[0]
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+    stages_step = jax.vmap(stage_fn)  # over the stage axis
+
+    # state buffer: slot s holds the microbatch currently inside stage s
+    state0 = jnp.zeros((S,) + x.shape[1:], dtype=x.dtype)
+    # pad the input schedule with drain-phase dummies
+    pad = jnp.zeros((S - 1,) + x.shape[1:], dtype=x.dtype) if S > 1 else x[:0]
+    feed = jnp.concatenate([x, pad], axis=0) if S > 1 else x
+
+    def step(state, x_t):
+        # shift: stage s takes stage s-1's previous output; stage 0 ingests
+        # the next microbatch. On a pp-sharded buffer this concatenate+slice
+        # is a collective_permute over neighbouring stages.
+        state = jnp.concatenate([x_t[None], state[:-1]], axis=0)
+        state = stages_step(stage_params, state)
+        return state, state[-1]
+
+    _, ys = lax.scan(step, state0, feed)
+    return ys[S - 1:]  # first S-1 emissions are fill-phase garbage
+
+
+def microbatch(x: jax.Array, num_microbatches: int) -> jax.Array:
+    """[B, ...] -> [M, B/M, ...] (reference: PipelineParallel micro-batching
+    of the global batch, pipeline_parallel.py:810 train_batch)."""
+    B = x.shape[0]
+    if B % num_microbatches:
+        raise ValueError(f"batch {B} not divisible by {num_microbatches}")
+    return x.reshape((num_microbatches, B // num_microbatches) + x.shape[1:])

@@ -1,0 +1,64 @@
+"""ceil_mode / return_mask / pad edge cases (validated against torch CPU,
+mirroring the reference's OpTest numeric-vs-reference pattern,
+test/legacy_test/op_test.py check_output)."""
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as TF
+
+import paddle_tpu as pt
+import paddle_tpu.nn.functional as F
+
+
+@pytest.fixture
+def x():
+    rng = np.random.RandomState(7)
+    return rng.randn(2, 3, 7, 7).astype(np.float32)
+
+
+def test_max_pool2d_ceil_mode(x):
+    ref = TF.max_pool2d(torch.tensor(x), 3, 2, padding=0, ceil_mode=True)
+    out = F.max_pool2d(pt.to_tensor(x), 3, 2, padding=0, ceil_mode=True)
+    np.testing.assert_allclose(ref.numpy(), out.numpy())
+
+
+def test_avg_pool2d_ceil_exclusive(x):
+    ref = TF.avg_pool2d(torch.tensor(x), 3, 2, padding=1, ceil_mode=True,
+                        count_include_pad=False)
+    out = F.avg_pool2d(pt.to_tensor(x), 3, 2, padding=1, ceil_mode=True,
+                       exclusive=True)
+    np.testing.assert_allclose(ref.numpy(), out.numpy(), rtol=1e-6)
+
+
+def test_max_pool2d_return_mask(x):
+    for k, s, p in [(2, 2, 0), (3, 2, 1), (3, 1, 1)]:
+        ref, refidx = TF.max_pool2d(torch.tensor(x), k, s, padding=p,
+                                    return_indices=True)
+        out, mask = F.max_pool2d(pt.to_tensor(x), k, s, padding=p,
+                                 return_mask=True)
+        np.testing.assert_allclose(ref.numpy(), out.numpy())
+        np.testing.assert_array_equal(refidx.numpy(), mask.numpy())
+
+
+def test_max_pool1d_return_mask(x):
+    ref, refidx = TF.max_pool1d(torch.tensor(x[:, :, 0]), 2, 2,
+                                return_indices=True)
+    out, mask = F.max_pool1d(pt.to_tensor(x[:, :, 0]), 2, 2,
+                             return_mask=True)
+    np.testing.assert_allclose(ref.numpy(), out.numpy())
+    np.testing.assert_array_equal(refidx.numpy(), mask.numpy())
+
+
+def test_pad_validation():
+    z = pt.to_tensor(np.zeros((2, 3), "float32"))
+    with pytest.raises(ValueError):
+        pt.pad(z, [1, 2, 3])
+    with pytest.raises(ValueError):
+        pt.pad(z, [1, 1, 1, 1, 1, 1])  # 3 pairs on 2-D input
+    assert pt.pad(z, [1, 2]).shape == [2, 6]
+
+
+def test_pad_from_left_axis():
+    z = pt.to_tensor(np.zeros((2, 3), "float32"))
+    assert pt.pad(z, [1, 1, 0, 0], pad_from_left_axis=True).shape == [4, 3]
+    assert pt.pad(z, [1, 1, 0, 0], pad_from_left_axis=False).shape == [2, 5]
