@@ -1,0 +1,44 @@
+"""paddle_tpu.distributed — the `paddle.distributed` capability surface.
+
+TPU-native redesign (SURVEY.md §2.7/§2.8): the reference's ProcessGroup+NCCL
+world becomes jax collectives over a device mesh. Three API strata:
+
+  1. auto-parallel (this is the primary path on TPU): ProcessMesh /
+     Shard / Replicate / Partial placements, shard_tensor / reshard /
+     shard_layer — thin, faithful wrappers over jax NamedSharding
+     (reference: python/paddle/distributed/auto_parallel/api.py:194,716).
+  2. communication facade: all_reduce / all_gather / ... on sharded
+     jax Arrays or eager Tensors (reference:
+     python/paddle/distributed/communication/).
+  3. fleet-style topology + env: init_parallel_env, get_rank,
+     get_world_size backed by jax.distributed / process indices.
+"""
+from .process_mesh import ProcessMesh
+from .placement import Placement, Shard, Replicate, Partial
+from .auto_parallel_api import (
+    shard_tensor, reshard, shard_layer, shard_optimizer, dtensor_from_fn,
+    unshard_dtensor,
+)
+from .communication import (
+    all_reduce, all_gather, all_gather_object, broadcast, reduce, scatter,
+    alltoall, barrier, ReduceOp, Group, new_group,
+)
+from . import functional
+from . import mpu
+from . import sharding
+from . import sequence_parallel
+from .sharding import group_sharded_parallel
+from .env import (
+    init_parallel_env, get_rank, get_world_size, is_initialized,
+    ParallelEnv,
+)
+from . import fleet
+from .parallel import DataParallel
+
+
+def launch():
+    raise NotImplementedError(
+        "use standard jax multi-host launch: one python process per host, "
+        "paddle_tpu.distributed.init_parallel_env() calls "
+        "jax.distributed.initialize() (coordination service replaces the "
+        "reference's TCPStore rendezvous)")

@@ -1,0 +1,157 @@
+"""Dygraph auto-parallel API: shard_tensor / reshard / shard_layer /
+shard_optimizer.
+
+Reference: python/paddle/distributed/auto_parallel/api.py:194 (shard_tensor),
+:716 (reshard), :817 (shard_layer), :1525 (shard_optimizer). There,
+DistTensor carries (mesh, placements) and 101 C++ SPMD rules propagate them
+op-by-op. Here a sharded tensor IS a jax.Array with a NamedSharding, and
+propagation is XLA GSPMD's job — so each API is a direct translation of
+placements → PartitionSpec + device_put, and "reshard" is a resharding
+device_put that XLA turns into the right collective.
+
+Partial placements: in the reference, Partial marks per-rank unreduced
+values (the 'p' in the r/s/p lattice). Under a single controller a global
+array is never in a partial state outside shard_map, so Partial here maps
+to replication (already-reduced); it is accepted for API compatibility and
+is meaningful in the shard_map-level collectives (communication.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .placement import Placement, Shard, Replicate, Partial
+from .process_mesh import ProcessMesh
+from ..core.tensor import Tensor
+
+
+def _to_spec(mesh: ProcessMesh, placements: Sequence[Placement],
+             ndim: int) -> PartitionSpec:
+    """placements (one per MESH dim) → PartitionSpec (one entry per
+    TENSOR dim, possibly multiple mesh axes per dim)."""
+    entries: List[Any] = [None] * ndim
+    for mesh_dim, pl in enumerate(placements):
+        if isinstance(pl, Shard):
+            d = pl.get_dim()
+            if d >= ndim or d < -ndim:
+                raise ValueError(
+                    f"Shard(dim={d}) out of range for {ndim}-D tensor")
+            d %= ndim
+            name = mesh.dim_names[mesh_dim]
+            if entries[d] is None:
+                entries[d] = name
+            elif isinstance(entries[d], tuple):
+                entries[d] = entries[d] + (name,)
+            else:
+                entries[d] = (entries[d], name)
+        elif isinstance(pl, (Replicate, Partial)):
+            continue
+        else:
+            raise TypeError(f"unknown placement {pl!r}")
+    return PartitionSpec(*entries)
+
+
+def _placements_of(arr: jax.Array, mesh: ProcessMesh) -> List[Placement]:
+    """Derive reference-style placements from an array's NamedSharding."""
+    placements: List[Placement] = [Replicate()] * mesh.ndim
+    sharding = getattr(arr, "sharding", None)
+    if not isinstance(sharding, NamedSharding):
+        return placements
+    for tdim, entry in enumerate(sharding.spec):
+        if entry is None:
+            continue
+        names = entry if isinstance(entry, tuple) else (entry,)
+        for name in names:
+            if name in mesh.dim_names:
+                placements[mesh.dim_names.index(name)] = Shard(tdim)
+    return placements
+
+
+def shard_tensor(data, mesh: ProcessMesh,
+                 placements: Sequence[Placement],
+                 dtype=None, place=None, stop_gradient=None) -> Tensor:
+    """Place ``data`` on ``mesh`` with ``placements`` (api.py:194)."""
+    t = data if isinstance(t := data, Tensor) else Tensor(data)
+    if len(placements) != mesh.ndim:
+        raise ValueError(
+            f"need {mesh.ndim} placements (one per mesh dim), "
+            f"got {len(placements)}")
+    spec = _to_spec(mesh, placements, t.ndim)
+    arr = jax.device_put(t.data, NamedSharding(mesh.jax_mesh, spec))
+    out = Tensor(arr, stop_gradient=(t.stop_gradient if stop_gradient is None
+                                     else stop_gradient))
+    return out
+
+
+def reshard(t: Tensor, mesh: ProcessMesh,
+            placements: Sequence[Placement]) -> Tensor:
+    """Transition to new placements (api.py:716). XLA emits the matching
+    collective (all-gather for s→r, dynamic-slice for r→s, all-to-all for
+    s(i)→s(j)) — the whole 30-file reshard lattice collapses to this."""
+    return shard_tensor(t, mesh, placements)
+
+
+def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
+                    placements: Sequence[Placement], *args, **kwargs):
+    return shard_tensor(fn(*args, **kwargs), mesh, placements)
+
+
+def unshard_dtensor(t: Tensor) -> Tensor:
+    """Gather to a fully-replicated tensor (api.py dtensor_to_local-ish)."""
+    devs = getattr(t.data, "sharding", None)
+    if devs is None:
+        return t
+    mesh = getattr(devs, "mesh", None)
+    if mesh is None:
+        return t
+    arr = jax.device_put(t.data, NamedSharding(mesh, PartitionSpec()))
+    return Tensor(arr, stop_gradient=t.stop_gradient)
+
+
+def shard_layer(layer, process_mesh: ProcessMesh,
+                shard_fn: Optional[Callable] = None,
+                input_fn: Optional[Callable] = None,
+                output_fn: Optional[Callable] = None):
+    """Shard every parameter of ``layer`` in place (api.py:817).
+
+    ``shard_fn(sublayer_name, sublayer, process_mesh)`` shards the
+    sublayer's params itself; default replicates everything onto the mesh.
+    """
+    def default_fn(name, sublayer, mesh):
+        for pname, p in list(sublayer._parameters.items()):
+            if p is None:
+                continue
+            sharded = shard_tensor(p, mesh,
+                                   [Replicate()] * mesh.ndim)
+            p.data = sharded.data
+
+    fn = shard_fn or default_fn
+    for name, sub in layer.named_sublayers(include_self=True):
+        fn(name, sub, process_mesh)
+    if input_fn is not None:
+        layer.register_forward_pre_hook(
+            lambda lyr, inputs: input_fn(inputs, process_mesh))
+    if output_fn is not None:
+        layer.register_forward_post_hook(
+            lambda lyr, inputs, outputs: output_fn(outputs, process_mesh))
+    return layer
+
+
+def shard_optimizer(optimizer, shard_fn: Optional[Callable] = None):
+    """ZeRO-style optimizer-state sharding (api.py:1525). Under GSPMD the
+    accumulators inherit each parameter's sharding automatically when they
+    are created from the (already-sharded) param values; this wrapper
+    exists for API parity and forces that inheritance for accumulators
+    created from shapes."""
+    orig_acc = getattr(optimizer, "_acc", None)
+    if orig_acc is not None and shard_fn is None:
+        def sharded_acc(name, p, init=None, dtype=None):
+            acc = orig_acc(name, p, init=init, dtype=dtype)
+            sharding = getattr(p.data, "sharding", None)
+            if sharding is not None and acc.data.shape == p.data.shape:
+                acc.data = jax.device_put(acc.data, sharding)
+            return acc
+        optimizer._acc = sharded_acc
+    return optimizer

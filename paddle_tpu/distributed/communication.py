@@ -1,0 +1,159 @@
+"""Communication facade — `paddle.distributed.{all_reduce,...}` parity.
+
+Reference: python/paddle/distributed/communication/ wrapping
+phi::distributed::ProcessGroup (process_group.h:126-363, NCCL backend).
+
+TPU-native semantics: JAX is single-controller — one Python process drives
+all local devices, and arrays are global. The reference's rank-based eager
+collectives therefore split into two layers here:
+
+  * process-level (this module): collectives across *hosts* in a multi-host
+    run (jax.process_count() ranks), implemented over
+    jax.experimental.multihost_utils. In a single-process run every group
+    has world size 1 and the ops are identities — matching the reference's
+    behaviour for world_size=1 groups.
+  * device-level: collectives across mesh axes happen inside jit — either
+    implicitly via GSPMD sharding, or explicitly through the shard_map
+    helpers in ``paddle_tpu.distributed.functional`` (psum/all_gather/
+    ppermute named like lax).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator handle (reference: paddle.distributed.new_group).
+    Under single-controller JAX a 'group' over local devices is degenerate
+    (world size = process count it spans)."""
+
+    def __init__(self, ranks: Optional[List[int]] = None):
+        self.ranks = ranks
+        n = jax.process_count()
+        self.nranks = len(ranks) if ranks is not None else n
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    @property
+    def rank(self):
+        return jax.process_index()
+
+
+def _is_multiprocess() -> bool:
+    return jax.process_count() > 1
+
+
+def _as_tensor(x) -> Tensor:
+    return x if isinstance(x, Tensor) else Tensor(x)
+
+
+def all_reduce(tensor, op: str = ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """Cross-process allreduce; identity in a single-process run (where the
+    'world' is the one controller and device-level reduction is GSPMD's)."""
+    t = _as_tensor(tensor)
+    if not _is_multiprocess():
+        return t
+    from jax.experimental import multihost_utils
+    reducers = {ReduceOp.SUM: jnp.sum, ReduceOp.MAX: jnp.max,
+                ReduceOp.MIN: jnp.min, ReduceOp.PROD: jnp.prod,
+                ReduceOp.AVG: jnp.mean}
+    gathered = multihost_utils.process_allgather(t.data)  # [P, ...]
+    out = reducers[op](gathered, axis=0)
+    t._data = out
+    return t
+
+
+def all_gather(tensor_list: Optional[List] = None, tensor=None,
+               group: Optional[Group] = None, sync_op: bool = True):
+    t = _as_tensor(tensor if tensor is not None else tensor_list)
+    if not _is_multiprocess():
+        out = [t]
+    else:
+        from jax.experimental import multihost_utils
+        stacked = multihost_utils.process_allgather(t.data)
+        out = [Tensor(stacked[i]) for i in range(stacked.shape[0])]
+    if isinstance(tensor_list, list):
+        tensor_list.clear()
+        tensor_list.extend(out)
+        return tensor_list
+    return out
+
+
+def all_gather_object(object_list: List, obj: Any,
+                      group: Optional[Group] = None):
+    if not _is_multiprocess():
+        object_list.clear()
+        object_list.append(obj)
+        return object_list
+    raise NotImplementedError(
+        "multi-host object gather: serialise to a tensor and use all_gather")
+
+
+def broadcast(tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    t = _as_tensor(tensor)
+    if not _is_multiprocess():
+        return t
+    from jax.experimental import multihost_utils
+    t._data = multihost_utils.broadcast_one_to_all(
+        t.data, is_source=jax.process_index() == src)
+    return t
+
+
+def reduce(tensor, dst: int = 0, op: str = ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    # every process computes the reduction; dst semantics preserved at the
+    # API level (non-dst ranks simply also hold the value)
+    return all_reduce(tensor, op=op, group=group)
+
+
+def scatter(tensor, tensor_list: Optional[List] = None, src: int = 0,
+            group: Optional[Group] = None, sync_op: bool = True):
+    t = _as_tensor(tensor)
+    if not _is_multiprocess():
+        if tensor_list:
+            t._data = _as_tensor(tensor_list[0]).data
+        return t
+    raise NotImplementedError("multi-host scatter: use shard_tensor")
+
+
+def alltoall(in_tensor_list, out_tensor_list=None,
+             group: Optional[Group] = None, sync_op: bool = True):
+    if not _is_multiprocess():
+        out = [_as_tensor(x) for x in in_tensor_list]
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.clear()
+            out_tensor_list.extend(out)
+            return out_tensor_list
+        return out
+    raise NotImplementedError(
+        "multi-host eager alltoall: use lax.all_to_all inside shard_map "
+        "(paddle_tpu.distributed.functional.all_to_all)")
+
+
+def barrier(group: Optional[Group] = None):
+    if _is_multiprocess():
+        from jax.experimental import multihost_utils
+        multihost_utils.sync_global_devices("paddle_tpu.distributed.barrier")
+
+
+def new_group(ranks: Optional[List[int]] = None, backend=None,
+              timeout=None) -> Group:
+    return Group(ranks)

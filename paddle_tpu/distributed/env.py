@@ -1,0 +1,72 @@
+"""Process/rank environment.
+
+Reference: paddle.distributed.init_parallel_env + ParallelEnv
+(python/paddle/distributed/parallel.py) bootstrapping via TCPStore.
+TPU-native: jax's coordination service is the rendezvous —
+jax.distributed.initialize() wires PJRT's multi-host runtime; rank/world
+come from jax.process_index()/process_count().
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized or jax.process_count() > 1
+
+
+def init_parallel_env():
+    """Multi-host bootstrap. Single-host: no-op (devices already visible).
+    Multi-host: jax.distributed.initialize() using standard env vars
+    (COORDINATOR_ADDRESS / num_processes / process_id), replacing the
+    reference's TCPStore + gloo/nccl comm init."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("COORDINATOR_ADDRESS")
+    if coord:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(os.environ.get("NUM_PROCESSES", "1")),
+            process_id=int(os.environ.get("PROCESS_ID", "0")))
+    _initialized = True
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None and getattr(group, "nranks", None):
+        return group.nranks
+    return jax.process_count()
+
+
+class ParallelEnv:
+    """Reference: paddle.distributed.ParallelEnv (env-var view of the
+    launch topology)."""
+
+    @property
+    def rank(self) -> int:
+        return get_rank()
+
+    @property
+    def world_size(self) -> int:
+        return get_world_size()
+
+    @property
+    def device_id(self) -> int:
+        return 0  # single-controller: all local devices belong to this proc
+
+    @property
+    def current_endpoint(self) -> str:
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")

@@ -1,0 +1,96 @@
+"""fleet — hybrid-parallel orchestration API.
+
+Reference: python/paddle/distributed/fleet/fleet.py:218 (fleet.init parses
+strategy.hybrid_configs and builds HybridCommunicateGroup), model.py:142-174
+(distributed_model wraps by mode), hybrid_parallel_optimizer.py.
+
+TPU-native: fleet.init builds the global HybridMesh (one jax Mesh). The
+"wrapping" the reference does per mode (grad allreduce hooks, TP param
+broadcast, PP schedule objects) is unnecessary under GSPMD — sharding
+annotations drive the collectives — so distributed_model/optimizer validate
+and pass through, keeping user scripts source-compatible.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..parallel.mesh import HybridMesh, init_hybrid_mesh, get_hybrid_mesh
+
+
+class DistributedStrategy:
+    """Reference: fleet/base/distributed_strategy.py (protobuf-backed).
+    Only the knobs that matter on TPU are kept; unknown attrs are accepted
+    and ignored so existing configs load."""
+
+    def __init__(self):
+        self.hybrid_configs = {
+            "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {}
+
+    def __setattr__(self, k, v):  # tolerate reference-only options
+        object.__setattr__(self, k, v)
+
+
+_fleet_state = {"initialized": False, "strategy": None}
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    dp = int(hc.get("dp_degree", 1)) * int(hc.get("sharding_degree", 1))
+    tp = int(hc.get("mp_degree", 1)) * int(hc.get("sep_degree", 1))
+    pp = int(hc.get("pp_degree", 1))
+    n = len(jax.devices())
+    if dp * tp * pp > n:
+        raise ValueError(
+            f"hybrid degrees dp{dp}*pp{pp}*tp{tp} exceed {n} devices")
+    if dp * tp * pp < n and dp == tp == pp == 1:
+        dp = n  # default: pure data parallel over all devices
+    init_hybrid_mesh(dp=dp, pp=pp, tp=tp)
+    _fleet_state["initialized"] = True
+    _fleet_state["strategy"] = strategy
+
+
+def distributed_model(model):
+    if not _fleet_state["initialized"]:
+        raise RuntimeError("call fleet.init() first")
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    if not _fleet_state["initialized"]:
+        raise RuntimeError("call fleet.init() first")
+    return optimizer
+
+
+def get_hybrid_communicate_group() -> Optional[HybridMesh]:
+    return get_hybrid_mesh()
+
+
+def worker_num() -> int:
+    return jax.process_count()
+
+
+def worker_index() -> int:
+    return jax.process_index()
+
+
+def is_first_worker() -> bool:
+    return jax.process_index() == 0
+
+
+def barrier_worker():
+    from .communication import barrier
+    barrier()

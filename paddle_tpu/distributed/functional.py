@@ -1,0 +1,54 @@
+"""Device-level collectives for use inside jit/shard_map.
+
+This is where the reference's ProcessGroup collectives actually live on
+TPU: as lax collectives over named mesh axes, traced into the XLA program
+so they ride ICI. The names mirror paddle.distributed.* so model code
+reads the same (reference: python/paddle/distributed/communication/ and
+the c_* collective ops, paddle/fluid/operators/collective/).
+
+Use with parallel.init_hybrid_mesh + jax.shard_map, e.g.::
+
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+    f = shard_map(lambda x: dist.functional.all_reduce(x, "tp"),
+                  mesh=hm.mesh, in_specs=P("tp"), out_specs=P())
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+def all_reduce(x, axis_name: str, op: str = "sum"):
+    ops = {"sum": lax.psum, "max": lax.pmax, "min": lax.pmin,
+           "avg": lax.pmean, "mean": lax.pmean}
+    return ops[op](x, axis_name)
+
+
+def all_gather(x, axis_name: str, axis: int = 0, tiled: bool = True):
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def reduce_scatter(x, axis_name: str, axis: int = 0):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=axis, tiled=True)
+
+
+def all_to_all(x, axis_name: str, split_axis: int, concat_axis: int,
+               tiled: bool = True):
+    return lax.all_to_all(x, axis_name, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=tiled)
+
+
+def ppermute(x, axis_name: str, perm):
+    return lax.ppermute(x, axis_name, perm)
+
+
+def send_recv_next(x, axis_name: str, n: int):
+    """Ring shift to the next rank on ``axis_name`` (the p2p primitive
+    pipeline schedules use; reference: p2p_communication.py isend/irecv)."""
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name: str):
+    return lax.axis_index(axis_name)

@@ -1,0 +1,134 @@
+"""Tensor-parallel layers (megatron mpu).
+
+Reference: python/paddle/distributed/fleet/layers/mpu/mp_layers.py (791 LoC:
+VocabParallelEmbedding / ColumnParallelLinear / RowParallelLinear) and
+mp_ops.py (c_identity/c_concat/c_split + _c_softmax_with_cross_entropy).
+
+TPU-native: the reference manually slices weights per rank and issues
+NCCL collectives in forward/backward. Here each layer is the ordinary dense
+layer with its weight *sharded over the mesh's tp axis* — XLA GSPMD emits
+the identity/allreduce/allgather pattern the reference hand-codes, and the
+same module works eagerly (global arrays) and under jit. gather_output /
+input_is_parallel flags become output-layout hints.
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer import Layer
+from ..parallel.mesh import get_hybrid_mesh
+
+
+def _tp_put(t, *spec):
+    hm = get_hybrid_mesh()
+    if t is not None and hm is not None and hm.tp_degree > 1:
+        t.data = jax.device_put(t.data, hm.sharding(*spec))
+    return t
+
+
+def _tp_degree() -> int:
+    hm = get_hybrid_mesh()
+    return hm.tp_degree if hm is not None else 1
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over tp (mp_layers.py
+    VocabParallelEmbedding: per-rank vocab range + allreduce; the range
+    bookkeeping is GSPMD's here)."""
+
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 weight_attr=None, mp_group=None, name=None):
+        super().__init__()
+        self.num_embeddings = num_embeddings
+        self.embedding_dim = embedding_dim
+        if num_embeddings % max(_tp_degree(), 1):
+            raise ValueError(
+                f"num_embeddings {num_embeddings} not divisible by tp "
+                f"degree {_tp_degree()}")
+        self.weight = self.create_parameter(
+            (num_embeddings, embedding_dim), attr=weight_attr,
+            default_initializer=I.Normal(0.0, 1.0))
+        _tp_put(self.weight, "tp", None)
+
+    def forward(self, x):
+        return F.embedding(x, self.weight)
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with out_features sharded over tp. gather_output=False keeps
+    the activation tp-sharded on the last dim (a layout hint under global
+    arrays, not a value change)."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 gather_output: bool = True, fuse_matmul_bias: bool = False,
+                 mp_group=None, name=None):
+        super().__init__()
+        if out_features % max(_tp_degree(), 1):
+            raise ValueError(
+                f"out_features {out_features} not divisible by tp degree "
+                f"{_tp_degree()}")
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _tp_put(self.weight, None, "tp")
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+        _tp_put(self.bias, "tp")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output:
+            out = _tp_put(out, *([None] * (out.ndim - 1) + ["tp"]))
+        return out
+
+
+class RowParallelLinear(Layer):
+    """Linear with in_features sharded over tp; XLA inserts the allreduce
+    the reference issues manually after the per-rank partial matmul."""
+
+    def __init__(self, in_features: int, out_features: int,
+                 weight_attr=None, has_bias: bool = True,
+                 input_is_parallel: bool = False,
+                 fuse_matmul_bias: bool = False, mp_group=None, name=None):
+        super().__init__()
+        if in_features % max(_tp_degree(), 1):
+            raise ValueError(
+                f"in_features {in_features} not divisible by tp degree "
+                f"{_tp_degree()}")
+        self.input_is_parallel = input_is_parallel
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        _tp_put(self.weight, "tp", None)
+        self.bias = (self.create_parameter((out_features,), is_bias=True)
+                     if has_bias else None)
+
+    def forward(self, x):
+        return F.linear(x, self.weight, self.bias)
+
+
+class ParallelCrossEntropy(Layer):
+    """Softmax-CE over vocab-sharded logits (mp_ops.py
+    _c_softmax_with_cross_entropy). The stable log-softmax compiles to the
+    same max-allreduce + sum-allreduce under GSPMD when the class dim is
+    tp-sharded."""
+
+    def __init__(self, mp_group=None, name=None, ignore_index: int = -100):
+        super().__init__()
+        self.ignore_index = ignore_index
+
+    def forward(self, input, label):
+        return F.cross_entropy(input, label, reduction="none",
+                               ignore_index=self.ignore_index)
+
+
+def split(x, axis=0, group=None):
+    """mp_ops.c_split equivalent: under global arrays, a layout transition
+    to tp-sharded along ``axis`` rather than a value slice."""
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    return _tp_put(t, *["tp" if i == axis else None for i in range(t.ndim)])

@@ -1,0 +1,71 @@
+"""DataParallel wrapper.
+
+Reference: python/paddle/distributed/parallel.py:219 — wraps a Layer,
+registers EagerReducer bucketed-allreduce hooks on backward
+(reducer.cc:MarkVarReady).
+
+TPU-native: under a single controller, a "data parallel" eager model is
+simply one whose batch is dp-sharded on the mesh; gradients of replicated
+params come out of jax already globally reduced (GSPMD inserts the
+all-reduce). So the wrapper's job collapses to (a) API parity incl.
+no_sync/scale_loss, (b) optionally sharding inputs over the dp axis.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..nn.layer import Layer
+from ..parallel.mesh import get_hybrid_mesh
+from ..core.tensor import Tensor
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        hm = get_hybrid_mesh()
+        if hm is not None and hm.dp_degree > 1:
+            sharded = []
+            for x in inputs:
+                if isinstance(x, Tensor) and x.ndim > 0 and \
+                        x.shape[0] % hm.dp_degree == 0:
+                    spec = PartitionSpec(*((["dp"] + [None] * (x.ndim - 1))))
+                    x = Tensor(jax.device_put(
+                        x.data, NamedSharding(hm.mesh, spec)),
+                        stop_gradient=x.stop_gradient)
+                sharded.append(x)
+            inputs = tuple(sharded)
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        """Reference: skip grad allreduce inside the context. GSPMD reduces
+        at use, so there is nothing to defer; kept for source compat."""
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    # delegate everything else to the wrapped layer
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self._layers, name)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)

@@ -1,0 +1,75 @@
+"""Placement types for distributed tensors.
+
+Reference: paddle/phi/core/distributed/auto_parallel/placement_types.h —
+Shard(dim) / Replicate / Partial(reduce_type). Identical semantics here;
+they translate to jax PartitionSpec entries (Shard → mesh axis on that
+tensor dim, Replicate → None, Partial → pending-reduction marker used by
+reshard)."""
+from __future__ import annotations
+
+
+class Placement:
+    def is_shard(self, dim=None) -> bool:
+        return False
+
+    def is_replicated(self) -> bool:
+        return False
+
+    def is_partial(self) -> bool:
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = dim
+
+    def get_dim(self) -> int:
+        return self.dim
+
+    def is_shard(self, dim=None) -> bool:
+        return dim is None or dim == self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, other):
+        return isinstance(other, Shard) and other.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, other):
+        return isinstance(other, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    """A tensor whose values are partial sums pending reduction over the
+    mesh axis (the 'p' state in the reference's r/s/p reshard lattice,
+    paddle/phi/core/distributed/auto_parallel/reshard/)."""
+
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return f"Partial(reduce_type={self.reduce_type})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Partial)
+                and other.reduce_type == self.reduce_type)
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))

@@ -1,0 +1,68 @@
+"""ProcessMesh — the auto-parallel device-mesh abstraction.
+
+Reference: paddle/phi/core/distributed/auto_parallel/process_mesh.h and
+python/paddle/distributed/auto_parallel/process_mesh.py: an N-D array of
+ranks with named dims. Here it wraps a jax.sharding.Mesh directly — ranks
+are jax device ids, and the mesh is immediately usable in PartitionSpecs.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(
+                f"dim_names {dim_names} rank != mesh rank {arr.ndim}")
+        self._ids = arr
+        self._dim_names = list(dim_names)
+        devices = list(devices if devices is not None else jax.devices())
+        dev_by_id = {d.id: d for d in devices}
+        try:
+            dev_arr = np.vectorize(lambda i: dev_by_id[int(i)])(arr)
+        except KeyError as e:
+            raise ValueError(f"process id {e} is not a visible device id")
+        self._jax_mesh = Mesh(dev_arr, axis_names=tuple(dim_names))
+
+    # -- reference API surface ---------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._ids.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return [int(i) for i in self._ids.flatten()]
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._ids.shape[self._dim_names.index(dim_name)]
+
+    # -- jax bridge ---------------------------------------------------------
+    @property
+    def jax_mesh(self) -> Mesh:
+        return self._jax_mesh
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._ids, other._ids)
+                and self._dim_names == other._dim_names)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, "
+                f"dim_names={self._dim_names})")

@@ -1,0 +1,69 @@
+"""Megatron sequence parallelism utilities.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+— AllGatherOp/ReduceScatterOp PyLayers (:85-146), ColumnSequenceParallel-
+Linear / RowSequenceParallelLinear (:255,427,562) with hand-scheduled
+allgather-before-column / reduce-scatter-after-row and overlap variants.
+
+TPU-native: SP is a layout discipline — activations between TP regions are
+sequence-sharded over the tp axis; GSPMD materialises the all-gather /
+reduce-scatter pair at the TP boundary and overlaps it. The Layer classes
+below are the mpu layers plus the seq-dim layout hint; the functional
+helpers give the explicit shard_map forms for custom schedules.
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from ..parallel.mesh import get_hybrid_mesh
+from .mpu import ColumnParallelLinear, RowParallelLinear, _tp_put
+
+
+def mark_sequence_parallel(t: Tensor, seq_axis: int = 1) -> Tensor:
+    """Constrain activations to be sequence-sharded over tp ([B, T, ...]
+    by default; the residual-stream layout between transformer blocks)."""
+    spec = ["dp" if False else None] * t.ndim
+    spec[seq_axis] = "tp"
+    return _tp_put(t, *spec)
+
+
+# explicit shard_map-level forms (sequence_parallel_utils.py:85-146)
+def all_gather_sequence(x, axis_name: str = "tp", seq_axis: int = 1):
+    return lax.all_gather(x, axis_name, axis=seq_axis, tiled=True)
+
+
+def reduce_scatter_sequence(x, axis_name: str = "tp", seq_axis: int = 1):
+    return lax.psum_scatter(x, axis_name, scatter_dimension=seq_axis,
+                            tiled=True)
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Column-parallel linear whose INPUT is sequence-sharded; the
+    allgather the reference issues (:255) is GSPMD's at the matmul."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        return out
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Row-parallel linear whose OUTPUT returns to sequence-sharded layout
+    (reduce-scatter, reference :427)."""
+
+    def forward(self, x):
+        out = super().forward(x)
+        if out.ndim >= 2:
+            out = mark_sequence_parallel(out, seq_axis=out.ndim - 2)
+        return out
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse=False):
+    """Reference :192 — SP params (norms) need an allreduce over tp because
+    their grads are computed from seq-sharded activations. Under GSPMD,
+    replicated params already receive fully-reduced grads; kept for source
+    compatibility."""
+    return model

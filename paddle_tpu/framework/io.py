@@ -1,0 +1,67 @@
+"""paddle.save / paddle.load.
+
+Reference: python/paddle/framework/io.py:773 (save), :1020 (load) — nested
+state_dict pickled with tensors converted through numpy. Same wire idea
+here (numpy + pickle), so checkpoints survive process/device changes;
+arrays restore to the default device and can be resharded afterwards
+(distributed/checkpoint.py handles the sharded multi-file format).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+import jax
+
+from ..core.tensor import Tensor, Parameter
+
+
+_SENTINEL = "_paddle_tpu_tensor_"
+
+
+def _pack(obj: Any):
+    if isinstance(obj, (Tensor, Parameter)):
+        return {_SENTINEL: True, "data": np.asarray(obj.data),
+                "stop_gradient": obj.stop_gradient,
+                "is_param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool):
+    if isinstance(obj, dict):
+        if obj.get(_SENTINEL):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_param") else Tensor
+            t = cls(obj["data"])
+            t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol: int = 4, **configs):
+    if hasattr(path, "write"):  # file-like
+        pickle.dump(_pack(obj), path, protocol=protocol)
+        return
+    d = os.path.dirname(str(path))
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    return_numpy = bool(configs.get("return_numpy", False))
+    if hasattr(path, "read"):
+        return _unpack(pickle.load(path), return_numpy)
+    with open(path, "rb") as f:
+        return _unpack(pickle.load(f), return_numpy)

@@ -1,0 +1,224 @@
+"""paddle.jit — dynamic-to-static capture and saved programs.
+
+Reference: python/paddle/jit/api.py:195 (@to_static decorator), the SOT
+bytecode frontend (jit/sot/translate.py:99 + eval_frame.c) and AST
+frontend, lowering to PIR programs run by the StandaloneExecutor.
+
+TPU-native redesign: capture IS jax tracing. ``to_static`` wraps a function
+or Layer so the whole computation traces once into a single XLA module
+(jax.jit); parameters become inputs so training keeps working — the tape
+records ONE GradNode at the jit boundary whose vjp is the compiled backward
+module. No bytecode interpreter is needed: Python control flow that is
+tensor-independent folds at trace time (same effect as the reference's
+graph-break-free path), and data-dependent control flow should use
+lax.cond/scan via ops (matching XLA's compilation model — SURVEY.md §7).
+
+``jit.save``/``jit.load`` serialize the traced program as StableHLO via
+jax.export — the deployment artifact (reference: inference program +
+AnalysisPredictor, SURVEY.md L9).
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor, Parameter
+from ..autograd import tape as _tape
+from ..ops import registry as _registry
+
+
+class InputSpec:
+    """Reference: paddle.static.InputSpec — symbolic input signature."""
+
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+@contextlib.contextmanager
+def _bind_params(params: List[Parameter], arrays):
+    saved = [p._data for p in params]
+    for p, a in zip(params, arrays):
+        p._data = a
+    try:
+        yield
+    finally:
+        for p, s in zip(params, saved):
+            p._data = s
+
+
+class StaticFunction:
+    """The compiled callable ``to_static`` returns (api.py
+    StaticFunction equivalent). Collects the owning Layer's parameters as
+    traced inputs; caches one XLA executable per input signature (the
+    reference caches one program per spec the same way)."""
+
+    def __init__(self, dygraph_function: Callable, layer=None,
+                 input_spec=None, full_graph: bool = True):
+        self._fn = dygraph_function
+        self._layer = layer
+        self._input_spec = input_spec
+        functools.update_wrapper(self, dygraph_function)
+
+        def _wrap(a):
+            return (Tensor(a) if isinstance(a, (jax.Array, jax.core.Tracer,
+                                                np.ndarray)) else a)
+
+        def pure(param_arrays, arg_arrays, kwarg_arrays, static_kwargs):
+            params = self._params()
+            targs = [_wrap(a) for a in arg_arrays]
+            tkw = {k: _wrap(v) for k, v in kwarg_arrays.items()}
+            tkw.update(dict(static_kwargs))
+            with _bind_params(params, param_arrays), _tape.no_grad():
+                if self._layer is not None:
+                    out = self._fn(self._layer, *targs, **tkw)
+                else:
+                    out = self._fn(*targs, **tkw)
+            return jax.tree_util.tree_map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        self._pure = pure
+        self._jitted = jax.jit(pure, static_argnums=(3,))
+
+    def _params(self) -> List[Parameter]:
+        return self._layer.parameters() if self._layer is not None else []
+
+    def __call__(self, *args, **kwargs):
+        params = self._params()
+        static_kwargs = tuple(
+            (k, v) for k, v in kwargs.items()
+            if not isinstance(v, (Tensor, jax.Array, np.ndarray)))
+        dyn_kwargs = {k: v for k, v in kwargs.items()
+                      if isinstance(v, (Tensor, jax.Array, np.ndarray))}
+
+        def fn(param_arrays, *arg_arrays, **kwarr):
+            return self._jitted(list(param_arrays), list(arg_arrays),
+                                dict(kwarr), static_kwargs)
+
+        return _registry.call_op(
+            f"to_static:{getattr(self._fn, '__name__', 'fn')}",
+            fn, (params,) + args, dyn_kwargs, differentiable=True)
+
+    # reference API surface
+    @property
+    def dygraph_function(self):
+        return self._fn
+
+    def concrete_program(self, *args, **kwargs):
+        raise NotImplementedError("inspect via jax: .lower(...).as_text()")
+
+    def lower(self, *args):
+        """Return the StableHLO text for given example inputs."""
+        params = [p.data for p in self._params()]
+        arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        return self._jitted.lower(params, arrs, {}, ()).as_text()
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """Decorator/wrapper (api.py:195). ``backend`` accepted for source
+    compat (the reference's CINN switch); compilation is always XLA here."""
+    from ..nn.layer import Layer
+
+    def wrap(f):
+        if isinstance(f, Layer):
+            sf = StaticFunction(type(f).forward, layer=f,
+                                input_spec=input_spec)
+            f.forward = sf
+            return f
+        return StaticFunction(f, input_spec=input_spec)
+
+    if function is not None:
+        return wrap(function)
+    return wrap
+
+
+def not_to_static(function=None):
+    if function is None:
+        return lambda f: f
+    return function
+
+
+def enable_to_static(flag: bool = True):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# save / load: StableHLO export
+# ---------------------------------------------------------------------------
+
+def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
+         **configs):
+    """Serialize program + params (reference jit.save → __model__ +
+    params; here: jax.export StableHLO bytes + numpy params)."""
+    from ..nn.layer import Layer
+    from jax import export as jexport
+
+    if isinstance(layer_or_fn, Layer):
+        layer = layer_or_fn
+        params = layer.parameters()
+        if input_spec is None:
+            raise ValueError("jit.save(layer, ...) needs input_spec")
+
+        def pure(param_arrays, arg_arrays):
+            with _bind_params(params, param_arrays), _tape.no_grad():
+                out = layer(*[Tensor(a) for a in arg_arrays])
+            return jax.tree_util.tree_map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        args_shape = [jax.ShapeDtypeStruct(tuple(s.shape),
+                                           jnp.dtype(str(s.dtype)))
+                      for s in input_spec]
+        params_shape = [jax.ShapeDtypeStruct(p.data.shape, p.data.dtype)
+                        for p in params]
+        exported = jexport.export(jax.jit(pure))(params_shape, args_shape)
+        blob = {
+            "stablehlo": exported.serialize(),
+            "params": [np.asarray(p.data) for p in params],
+        }
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path + ".pdmodel", "wb") as f:
+            pickle.dump(blob, f)
+        return
+    raise TypeError("jit.save expects a Layer (functions: use jax.export)")
+
+
+class TranslatedLayer:
+    """Loaded inference program (reference: translated_layer.py)."""
+
+    def __init__(self, exported, params):
+        self._exported = exported
+        self._params = params
+
+    def __call__(self, *args):
+        arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
+                for a in args]
+        out = self._exported.call(self._params, arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("loaded StableHLO programs are inference-only")
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    from jax import export as jexport
+    with open(path + ".pdmodel", "rb") as f:
+        blob = pickle.load(f)
+    exported = jexport.deserialize(blob["stablehlo"])
+    params = [jnp.asarray(p) for p in blob["params"]]
+    return TranslatedLayer(exported, params)
