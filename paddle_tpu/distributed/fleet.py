@@ -27,7 +27,7 @@ class DistributedStrategy:
     def __init__(self):
         self.hybrid_configs = {
             "dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
-            "sharding_degree": 1, "sep_degree": 1,
+            "sharding_degree": 1, "sep_degree": 1, "ep_degree": 1,
         }
         self.amp = False
         self.amp_configs = {}
@@ -50,15 +50,19 @@ def init(role_maker=None, is_collective: bool = True,
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
     dp = int(hc.get("dp_degree", 1)) * int(hc.get("sharding_degree", 1))
-    tp = int(hc.get("mp_degree", 1)) * int(hc.get("sep_degree", 1))
+    tp = int(hc.get("mp_degree", 1))
     pp = int(hc.get("pp_degree", 1))
+    cp = int(hc.get("sep_degree", 1))   # reference SEP axis == our cp
+    ep = int(hc.get("ep_degree", 1))
     n = len(jax.devices())
-    if dp * tp * pp > n:
+    need = dp * tp * pp * cp * ep
+    if need > n:
         raise ValueError(
-            f"hybrid degrees dp{dp}*pp{pp}*tp{tp} exceed {n} devices")
-    if dp * tp * pp < n and dp == tp == pp == 1:
+            f"hybrid degrees dp{dp}*pp{pp}*cp{cp}*ep{ep}*tp{tp} "
+            f"exceed {n} devices")
+    if need < n and need == 1:
         dp = n  # default: pure data parallel over all devices
-    init_hybrid_mesh(dp=dp, pp=pp, tp=tp)
+    init_hybrid_mesh(dp=dp, pp=pp, tp=tp, ep=ep, cp=cp)
     _fleet_state["initialized"] = True
     _fleet_state["strategy"] = strategy
 

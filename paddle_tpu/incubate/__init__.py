@@ -1,0 +1,9 @@
+"""paddle_tpu.incubate — experimental surfaces (reference: python/paddle/incubate/).
+
+Holds MoE (incubate/distributed/models/moe), fused functional ops
+(incubate/nn/functional), and experimental optimizers.
+"""
+from . import moe  # noqa: F401
+from .nn import functional as _fused  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401

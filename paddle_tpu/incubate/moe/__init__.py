@@ -1,0 +1,8 @@
+from .functional import moe_ffn, top_k_gating, default_capacity
+from .gate import NaiveGate, GShardGate, SwitchGate
+from .layer import MoELayer, ExpertLayer
+
+__all__ = [
+    "moe_ffn", "top_k_gating", "default_capacity",
+    "NaiveGate", "GShardGate", "SwitchGate", "MoELayer", "ExpertLayer",
+]

@@ -1,0 +1,165 @@
+"""Functional MoE: gating + expert dispatch, TPU-first.
+
+Reference capability: python/paddle/incubate/distributed/models/moe/
+(moe_layer.py:119-190,263 — gates + global_scatter/global_gather alltoall
+dispatch; gshard_gate.py, switch_gate.py, naive_gate.py) and the fused
+cutlass MoE kernel (paddle/phi/kernels/fusion/cutlass/fused_moe_kernel.cu).
+
+TPU-native redesign: instead of per-rank index scatter + NCCL alltoall, the
+whole dispatch is expressed as dense one-hot einsums over static shapes
+(the GShard formulation). Expert weights carry a leading E axis sharded over
+the mesh's ``ep`` axis; when dispatch/combine einsums contract against
+ep-sharded operands, XLA GSPMD emits exactly the all_to_all the reference
+hand-codes — and the expert FFN itself is one big grouped batched matmul
+on the MXU.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def default_capacity(num_tokens: int, num_experts: int, top_k: int,
+                     capacity_factor: float) -> int:
+    """Per-expert token slots C (gshard_gate.py capacity computation)."""
+    cap = int(capacity_factor * top_k * num_tokens / num_experts)
+    return max(cap, top_k)
+
+
+def top_k_gating(
+    logits: jax.Array,
+    top_k: int,
+    capacity: int,
+    *,
+    key: Optional[jax.Array] = None,
+    second_policy: str = "all",
+    normalize_topk: bool = False,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Dense top-k gating (GShard).
+
+    Args:
+      logits: ``[S, E]`` router logits for S tokens over E experts.
+      top_k: experts per token (1 = switch, 2 = gshard).
+      capacity: per-expert slot count C; overflow tokens are dropped.
+      key: optional PRNG key; with ``second_policy='random'`` the 2nd+
+        expert is kept with probability proportional to its gate value
+        (gshard_gate.py random routing).
+
+    Returns:
+      (dispatch, combine, aux_loss) with dispatch ``[S, E, C]`` one-hot,
+      combine ``[S, E, C]`` float weights, and the load-balance aux loss
+      (switch/gshard l_aux: E * mean_e(importance_e * load_e)).
+    """
+    S, E = logits.shape
+    compute_dtype = jnp.float32
+    raw_gates = jax.nn.softmax(logits.astype(compute_dtype), axis=-1)
+
+    # iteratively peel off the top-k experts per token
+    masks, gate_vals = [], []
+    g = raw_gates
+    for i in range(top_k):
+        idx = jnp.argmax(g, axis=-1)
+        m = jax.nn.one_hot(idx, E, dtype=compute_dtype)      # [S, E]
+        g = g * (1.0 - m)  # peel BEFORE random drop so a dropped expert
+        #                    is never re-picked at the next iteration
+        gv = jnp.sum(raw_gates * m, axis=-1)                 # [S]
+        if i > 0 and second_policy == "random" and key is not None:
+            # keep the i-th expert with prob 2*gate (gshard random routing)
+            key, sub = jax.random.split(key)
+            keep = jax.random.uniform(sub, (S,)) < (2.0 * gv)
+            m = m * keep[:, None].astype(compute_dtype)
+            gv = gv * keep.astype(compute_dtype)
+        masks.append(m)
+        gate_vals.append(gv)
+
+    # aux load-balance loss uses the top-1 assignment (switch_gate.py)
+    density = jnp.mean(masks[0], axis=0)                     # fraction routed
+    density_proxy = jnp.mean(raw_gates, axis=0)              # mean gate prob
+    aux_loss = jnp.mean(density * density_proxy) * (E * E)
+
+    # position of each token in its expert's queue; earlier k-slots and
+    # earlier tokens win capacity (cumsum ordering == reference prioritizing)
+    dispatch = jnp.zeros((S, E, capacity), compute_dtype)
+    combine = jnp.zeros((S, E, capacity), compute_dtype)
+    if normalize_topk:  # mixtral-style renormalization over the chosen k
+        denom = sum(gate_vals)
+        denom = jnp.where(denom > 0, denom, 1.0)
+        gate_vals = [gv / denom for gv in gate_vals]
+    running = jnp.zeros((E,), compute_dtype)
+    for m, gv in zip(masks, gate_vals):
+        pos_all = jnp.cumsum(m, axis=0) - m + running        # [S, E]
+        pos = jnp.sum(pos_all * m, axis=-1).astype(jnp.int32)  # [S]
+        running = running + jnp.sum(m, axis=0)
+        within = (pos < capacity).astype(compute_dtype)
+        oh_pos = jax.nn.one_hot(pos, capacity, dtype=compute_dtype)  # [S, C]
+        d = (m * within[:, None])[:, :, None] * oh_pos[:, None, :]   # [S,E,C]
+        dispatch = dispatch + d
+        combine = combine + gv[:, None, None] * d
+    return dispatch, combine, aux_loss
+
+
+def moe_expert_compute(
+    xs: jax.Array,
+    dispatch: jax.Array,
+    combine: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    ep_axis: Optional[str] = None,
+    activation=jax.nn.silu,
+) -> jax.Array:
+    """Dispatch -> grouped expert SwiGLU -> combine, on tokens ``[S, D]``
+    with gating tensors ``[S, E, C]`` (shared by moe_ffn and MoELayer)."""
+    dispatch = dispatch.astype(xs.dtype)
+    combine = combine.astype(xs.dtype)
+    expert_in = jnp.einsum("sec,sd->ecd", dispatch, xs)      # [E, C, D]
+    if ep_axis is not None:
+        expert_in = lax.with_sharding_constraint(
+            expert_in, jax.sharding.PartitionSpec(ep_axis, None, None))
+    h = activation(jnp.einsum("ecd,edf->ecf", expert_in, w_gate))
+    h = h * jnp.einsum("ecd,edf->ecf", expert_in, w_up)
+    expert_out = jnp.einsum("ecf,efd->ecd", h, w_down)       # [E, C, D]
+    if ep_axis is not None:
+        expert_out = lax.with_sharding_constraint(
+            expert_out, jax.sharding.PartitionSpec(ep_axis, None, None))
+    return jnp.einsum("sec,ecd->sd", combine, expert_out)    # [S, D]
+
+
+def moe_ffn(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int = 2,
+    capacity_factor: float = 2.0,
+    key: Optional[jax.Array] = None,
+    ep_axis: Optional[str] = None,
+    activation=jax.nn.silu,
+) -> Tuple[jax.Array, jax.Array]:
+    """Mixture-of-experts SwiGLU FFN over tokens ``x`` ``[..., D]``.
+
+    Expert weights are stacked on a leading E axis: ``w_gate/w_up [E, D, F]``,
+    ``w_down [E, F, D]``. With ``ep_axis`` set and the weights ep-sharded,
+    the dispatch/combine einsums below compile to the expert-parallel
+    all_to_all (moe_layer.py global_scatter/global_gather equivalent).
+
+    Returns (y, aux_loss) with y shaped like x.
+    """
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    E = w_gate.shape[0]
+    xs = x.reshape(-1, D)                                    # [S, D]
+    S = xs.shape[0]
+    capacity = default_capacity(S, E, top_k, capacity_factor)
+
+    logits = xs.astype(jnp.float32) @ gate_w.astype(jnp.float32)  # [S, E]
+    dispatch, combine, aux = top_k_gating(logits, top_k, capacity, key=key)
+    y = moe_expert_compute(xs, dispatch, combine, w_gate, w_up, w_down,
+                           ep_axis=ep_axis, activation=activation)
+    return y.reshape(orig_shape), aux.astype(jnp.float32)

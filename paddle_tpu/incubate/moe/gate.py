@@ -1,0 +1,72 @@
+"""Gate modules for MoELayer.
+
+Reference: python/paddle/incubate/distributed/models/moe/gate/
+(naive_gate.py, gshard_gate.py, switch_gate.py). Each gate maps token
+activations to (dispatch, combine, aux_loss) via the dense formulation in
+``functional.top_k_gating``.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...nn.layer import Layer
+from ...nn import initializer as I
+from . import functional as MF
+
+
+class NaiveGate(Layer):
+    """Plain learned top-k router, no randomness (naive_gate.py)."""
+
+    top_k = 2
+    second_policy = "all"
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2):
+        super().__init__()
+        self.num_expert = num_expert * world_size
+        self.top_k = topk
+        self.weight = self.create_parameter(
+            (d_model, self.num_expert),
+            default_initializer=I.XavierUniform())
+
+    def forward(self, x, capacity_factor: float = 2.0,
+                key: Optional[jax.Array] = None):
+        xs = x.reshape(-1, x.shape[-1])
+        logits = xs.astype(jnp.float32) @ self.weight.data.astype(jnp.float32)
+        cap = MF.default_capacity(xs.shape[0], self.num_expert, self.top_k,
+                                  capacity_factor)
+        return MF.top_k_gating(logits, self.top_k, cap, key=key,
+                               second_policy=self.second_policy)
+
+
+class GShardGate(NaiveGate):
+    """Top-2 with random second-expert routing (gshard_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 2, capacity=(1.2, 2.4), group=None):
+        super().__init__(d_model, num_expert, world_size, topk)
+        self.capacity = capacity
+        self.second_policy = "random"
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 switch-transformer router (switch_gate.py)."""
+
+    def __init__(self, d_model: int, num_expert: int, world_size: int = 1,
+                 topk: int = 1, switch_eps: float = 0.1, capacity=(1.2, 2.4),
+                 group=None):
+        super().__init__(d_model, num_expert, world_size, topk=1)
+        self.switch_eps = switch_eps
+
+    def forward(self, x, capacity_factor: float = 2.0,
+                key: Optional[jax.Array] = None):
+        if key is not None:
+            # switch jitter: multiplicative uniform noise on the logits
+            noise = jax.random.uniform(
+                key, x.shape, minval=1.0 - self.switch_eps,
+                maxval=1.0 + self.switch_eps)
+            x = x * noise.astype(x.dtype)
+        return super().forward(x, capacity_factor, key=None)

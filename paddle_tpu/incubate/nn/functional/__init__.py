@@ -1,0 +1,127 @@
+"""Fused functional ops (reference: python/paddle/incubate/nn/functional/ —
+fused_rms_norm, swiglu, fused_rotary_position_embedding, fused_moe, ...).
+
+On TPU "fused" means: express the math in one traced region and let XLA's
+fusion pass emit a single kernel — plus Pallas for the cases XLA can't fuse
+(flash attention, ops/pallas/). The APIs keep the reference's names so
+model code ports unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-5,
+                   begin_norm_axis: int = -1, **kw):
+    """fused_rms_norm.py equivalent; XLA fuses the whole thing."""
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=begin_norm_axis, keepdims=True)
+    out = xf * jax.lax.rsqrt(var + epsilon) * norm_weight.astype(jnp.float32)
+    if norm_bias is not None:
+        out = out + norm_bias.astype(jnp.float32)
+    return out.astype(dt)
+
+
+def fused_layer_norm(x, norm_weight, norm_bias=None, epsilon: float = 1e-5,
+                     begin_norm_axis: int = -1, **kw):
+    dt = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=begin_norm_axis, keepdims=True)
+    var = jnp.var(xf, axis=begin_norm_axis, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + epsilon)
+    if norm_weight is not None:
+        out = out * norm_weight.astype(jnp.float32)
+    if norm_bias is not None:
+        out = out + norm_bias.astype(jnp.float32)
+    return out.astype(dt)
+
+
+def swiglu(x, y=None):
+    """swiglu.py: silu(x) * y; single-arg form splits x in half."""
+    if y is None:
+        x, y = jnp.split(x, 2, axis=-1)
+    return jax.nn.silu(x) * y
+
+
+def fused_bias_act(x, bias=None, act_method: str = "gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    return {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+            "silu": jax.nn.silu, "swiglu": swiglu}[act_method](x)
+
+
+def fused_linear(x, weight, bias=None, transpose_weight: bool = False):
+    if transpose_weight:
+        weight = weight.T
+    out = x @ weight
+    return out + bias if bias is not None else out
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation: str = "gelu"):
+    if trans_x:
+        x = x.T
+    if trans_y:
+        y = y.T
+    return fused_bias_act(x @ y, bias, act_method=activation)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    rotary_emb_base: float = 10000.0):
+    """fused_rotary_position_embedding equivalent on [B, T, H, Dh] tensors."""
+    B, T, _, Dh = q.shape
+    if cos is None or sin is None:
+        half = Dh // 2
+        inv = 1.0 / (rotary_emb_base **
+                     (jnp.arange(0, half, dtype=jnp.float32) / half))
+        pos = (position_ids if position_ids is not None
+               else jnp.broadcast_to(jnp.arange(T), (B, T)))
+        ang = pos[..., None].astype(jnp.float32) * inv       # [B, T, half]
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+
+    def rot(x):
+        if x is None:
+            return None
+        half = x.shape[-1] // 2
+        if use_neox_rotary_style:
+            x1, x2 = x[..., :half], x[..., half:]
+            out = jnp.concatenate(
+                [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        else:
+            x1, x2 = x[..., 0::2], x[..., 1::2]
+            r1 = x1 * cos - x2 * sin
+            r2 = x2 * cos + x1 * sin
+            out = jnp.stack([r1, r2], axis=-1).reshape(x.shape)
+        return out.astype(x.dtype)
+
+    return rot(q), rot(k), rot(v)
+
+
+def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, *, top_k: int = 2,
+              capacity_factor: float = 2.0, **kw):
+    """cutlass fused_moe_kernel.cu equivalent: dense-dispatch grouped GEMM
+    (see incubate.moe.functional.moe_ffn). ffn1 [E, D, 2F] packs gate|up."""
+    from ...moe.functional import moe_ffn
+    w_gate, w_up = jnp.split(ffn1_weight, 2, axis=-1)
+    y, _ = moe_ffn(x, gate_weight, w_gate, w_up, ffn2_weight,
+                   top_k=top_k, capacity_factor=capacity_factor)
+    return y
+
+
+def masked_multihead_attention(x, cache_kv=None, *args, **kw):
+    raise NotImplementedError(
+        "decode-time masked_multihead_attention: use "
+        "paddle_tpu.ops.pallas.flash_attention with a KV cache "
+        "(models/llama.py decode path)")
+
+
+def fused_multi_head_attention(q, k, v, *, causal=True, **kw):
+    from ....ops.pallas.flash_attention import flash_attention
+    return flash_attention(q, k, v, causal=causal)

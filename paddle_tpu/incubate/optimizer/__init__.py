@@ -1,0 +1,48 @@
+"""Experimental optimizers / training utilities.
+
+Reference: python/paddle/incubate/optimizer/ (recompute.py, lookahead.py,
+lbfgs.py, distributed_fused_lamb.py).
+"""
+from __future__ import annotations
+
+import jax
+
+
+def recompute(function, *args, use_reentrant: bool = True, **kwargs):
+    """Activation recomputation (recompute.py). On TPU this is
+    jax.checkpoint: forward runs without saving intermediates; they are
+    rematerialized in the backward pass — HBM for FLOPs."""
+    return jax.checkpoint(function)(*args, **kwargs)
+
+
+class LookAhead:
+    """lookahead.py: slow/fast weights. k inner steps, then slow update."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_count = 0
+        self._slow = {}
+
+    def step(self):
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k:
+            return
+        for p in self.inner_optimizer._parameter_list:
+            pid = id(p)
+            if pid not in self._slow:
+                self._slow[pid] = p.data
+            slow = self._slow[pid] + self.alpha * (p.data - self._slow[pid])
+            self._slow[pid] = slow
+            p.data = slow
+
+    def clear_grad(self):
+        self.inner_optimizer.clear_grad()
+
+    def minimize(self, loss, **kw):
+        out = self.inner_optimizer.minimize(loss, **kw)
+        self.step()
+        return out

@@ -6,5 +6,7 @@ the train step is one jitted SPMD program over the hybrid mesh. Eager
 mirroring the reference's python/paddle/vision/models/.
 """
 from . import llama
+from . import qwen2_moe
 from .llama import LlamaConfig
+from .qwen2_moe import Qwen2MoeConfig
 from .lenet import LeNet

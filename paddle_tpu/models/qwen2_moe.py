@@ -1,0 +1,238 @@
+"""Qwen2-MoE-family decoder: Llama attention + MoE FFN with shared expert.
+
+Capability target: the reference ecosystem's MoE pretrain path —
+python/paddle/incubate/distributed/models/moe/moe_layer.py (dispatch) +
+fused cutlass MoE kernels — redesigned as one jitted SPMD program.
+
+Parallelism (on top of models/llama.py's tp/sp/dp):
+  - EP: expert weights carry a leading E axis sharded over the mesh ``ep``
+    axis; the dense dispatch einsums (incubate.moe.functional) compile to
+    the expert all_to_all under GSPMD.
+  - The router and shared expert stay tp-sharded like llama's MLP.
+"""
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..incubate.moe.functional import moe_ffn
+from .llama import rms_norm, rope
+
+
+@dataclasses.dataclass
+class Qwen2MoeConfig:
+    vocab_size: int = 151936
+    hidden_size: int = 2048
+    num_hidden_layers: int = 24
+    num_attention_heads: int = 16
+    num_key_value_heads: int = 16
+    max_position_embeddings: int = 8192
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 1000000.0
+    # MoE
+    num_experts: int = 60
+    num_experts_per_tok: int = 4
+    moe_intermediate_size: int = 1408
+    shared_expert_intermediate_size: int = 5632
+    capacity_factor: float = 2.0
+    router_aux_loss_coef: float = 0.001
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    use_flash_attention: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @staticmethod
+    def tiny(**kw) -> "Qwen2MoeConfig":
+        return Qwen2MoeConfig(
+            vocab_size=256, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=128, num_experts=4,
+            num_experts_per_tok=2, moe_intermediate_size=32,
+            shared_expert_intermediate_size=64, **kw)
+
+
+def init_params(cfg: Qwen2MoeConfig, key: jax.Array) -> Dict[str, Any]:
+    D, V = cfg.hidden_size, cfg.vocab_size
+    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    L, E = cfg.num_hidden_layers, cfg.num_experts
+    Fm, Fs = cfg.moe_intermediate_size, cfg.shared_expert_intermediate_size
+    ks = jax.random.split(key, 16)
+
+    def init(k, shape, fan_in):
+        return (jax.random.normal(k, shape, jnp.float32) *
+                (1.0 / np.sqrt(fan_in))).astype(cfg.dtype)
+
+    layers = {
+        "wq": init(ks[0], (L, D, H * Dh), D),
+        "wk": init(ks[1], (L, D, Hkv * Dh), D),
+        "wv": init(ks[2], (L, D, Hkv * Dh), D),
+        "wo": init(ks[3], (L, H * Dh, D), H * Dh),
+        "attn_norm": jnp.ones((L, D), cfg.dtype),
+        "mlp_norm": jnp.ones((L, D), cfg.dtype),
+        # router stays fp32 for stable softmax
+        "router": jax.random.normal(ks[4], (L, D, E), jnp.float32) * 0.02,
+        "experts": {
+            "w_gate": init(ks[5], (L, E, D, Fm), D),
+            "w_up": init(ks[6], (L, E, D, Fm), D),
+            "w_down": init(ks[7], (L, E, Fm, D), Fm),
+        },
+        "shared": {
+            "w_gate": init(ks[8], (L, D, Fs), D),
+            "w_up": init(ks[9], (L, D, Fs), D),
+            "w_down": init(ks[10], (L, Fs, D), Fs),
+            "gate": init(ks[11], (L, D, 1), D),  # shared-expert gate proj
+        },
+    }
+    return {
+        "embed": init(ks[12], (V, D), D),
+        "layers": layers,
+        "final_norm": jnp.ones((D,), cfg.dtype),
+        "lm_head": init(ks[13], (D, V), D),
+    }
+
+
+def param_specs(cfg: Qwen2MoeConfig) -> Dict[str, Any]:
+    """TP shards attention + shared expert like llama; EP shards the E axis
+    of routed experts; expert matrices additionally tp-shard their F dim."""
+    layers = {
+        "wq": P(None, None, "tp"),
+        "wk": P(None, None, "tp"),
+        "wv": P(None, None, "tp"),
+        "wo": P(None, "tp", None),
+        "attn_norm": P(None, None),
+        "mlp_norm": P(None, None),
+        "router": P(None, None, None),
+        "experts": {
+            "w_gate": P(None, "ep", None, "tp"),
+            "w_up": P(None, "ep", None, "tp"),
+            "w_down": P(None, "ep", "tp", None),
+        },
+        "shared": {
+            "w_gate": P(None, None, "tp"),
+            "w_up": P(None, None, "tp"),
+            "w_down": P(None, "tp", None),
+            "gate": P(None, None, None),
+        },
+    }
+    return {
+        "embed": P("tp", None),
+        "layers": layers,
+        "final_norm": P(None),
+        "lm_head": P(None, "tp"),
+    }
+
+
+def shard_params(params, cfg: Qwen2MoeConfig, mesh: Mesh):
+    specs = param_specs(cfg)
+
+    def put(x, s):
+        # drop only the axes absent from this mesh (e.g. no 'ep' axis when
+        # ep=1), keeping the rest of the spec intact
+        pruned = P(*(n if (n is not None and n in mesh.shape) else None
+                     for n in s))
+        return jax.device_put(x, NamedSharding(mesh, pruned))
+
+    return jax.tree_util.tree_map(
+        put, params, specs, is_leaf=lambda x: isinstance(x, P))
+
+
+def decoder_layer(lp, h, cfg: Qwen2MoeConfig, ep_axis: Optional[str]):
+    B, T, D = h.shape
+    H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+
+    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(B, T, H, Dh)
+    k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
+    v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
+    q, k = rope(q, k, positions, cfg.rope_theta, Dh)
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    o = _fa(q, k, v, causal=True,
+            impl="auto" if cfg.use_flash_attention else "dense")
+    h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
+
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    routed, aux = moe_ffn(
+        x, lp["router"],
+        lp["experts"]["w_gate"], lp["experts"]["w_up"],
+        lp["experts"]["w_down"],
+        top_k=cfg.num_experts_per_tok,
+        capacity_factor=cfg.capacity_factor,
+        ep_axis=ep_axis)
+    sh = lp["shared"]
+    shared = (jax.nn.silu(x @ sh["w_gate"]) * (x @ sh["w_up"])) @ sh["w_down"]
+    shared = jax.nn.sigmoid(x @ sh["gate"]) * shared
+    return h + routed + shared, aux
+
+
+def forward(params, tokens, cfg: Qwen2MoeConfig,
+            mesh: Optional[Mesh] = None):
+    """tokens [B, T] -> (logits [B, T, V], total_aux_loss)."""
+    ep_axis = ("ep" if mesh is not None and mesh.shape.get("ep", 1) > 1
+               else None)
+    h = params["embed"].astype(cfg.dtype)[tokens]
+
+    fn = partial(decoder_layer, cfg=cfg, ep_axis=ep_axis)
+    if cfg.remat:
+        fn = jax.checkpoint(fn)
+
+    def body(carry, lp):
+        h, aux = carry
+        h, a = fn(lp, h)
+        return (h, aux + a), None
+
+    (h, aux), _ = lax.scan(body, (h, jnp.zeros((), jnp.float32)),
+                           params["layers"])
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    return h @ params["lm_head"], aux
+
+
+def loss_fn(params, batch, cfg: Qwen2MoeConfig, mesh=None):
+    tokens, labels = batch["tokens"], batch["labels"]
+    logits, aux = forward(params, tokens, cfg, mesh)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    return nll.mean() + cfg.router_aux_loss_coef * aux
+
+
+def make_train_step(cfg: Qwen2MoeConfig, mesh: Mesh, optimizer=None):
+    """Jitted SPMD train step; optimizer state inherits param sharding
+    (ZeRO-style, like models/llama.py make_train_step)."""
+    import optax
+    if optimizer is None:
+        optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+
+    def init_fn(key):
+        params = init_params(cfg, key)
+        params = shard_params(params, cfg, mesh)
+        opt_state = optimizer.init(params)
+        return {"params": params, "opt": opt_state,
+                "step": jnp.zeros((), jnp.int32)}
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(
+            state["params"], batch, cfg, mesh)
+        updates, opt = optimizer.update(grads, state["opt"], state["params"])
+        params = optax.apply_updates(state["params"], updates)
+        return {"params": params, "opt": opt,
+                "step": state["step"] + 1}, loss
+
+    return step_fn, init_fn
+
+
+def make_batch(cfg: Qwen2MoeConfig, batch_size: int, seq_len: int,
+               mesh: Mesh, key=None):
+    from .llama import make_batch as _llama_make_batch
+    return _llama_make_batch(cfg, batch_size, seq_len, mesh, key=key)

@@ -14,7 +14,9 @@ Axis naming convention (matching fleet's order topology.py:189):
   - ``tp``   tensor/model parallel (megatron TP; sequence parallel
              reuses this axis, as megatron-SP does in the reference's
              sequence_parallel_utils.py)
-  - ``ep``   expert parallel (MoE dispatch axis; may alias dp)
+  - ``ep``   expert parallel (own physical axis when >1; MoE all_to_all)
+  - ``cp``   context parallel (sequence dim; the reference's SEP axis,
+             topology.py:204 — ring attention / Ulysses ride this)
 """
 from __future__ import annotations
 
@@ -63,6 +65,10 @@ class HybridMesh:
         return self.degree("ep")
 
     @property
+    def cp_degree(self) -> int:
+        return self.degree("cp")
+
+    @property
     def world_size(self) -> int:
         return int(np.prod(list(self.mesh.shape.values())))
 
@@ -86,27 +92,39 @@ def init_hybrid_mesh(
     pp: int = 1,
     tp: int = 1,
     ep: int = 1,
+    cp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
     set_global: bool = True,
 ) -> HybridMesh:
     """Build the hybrid mesh, fleet's ``fleet.init(strategy)`` equivalent.
 
-    Axis order is (dp, pp, tp): pp and tp innermost so stage/tensor
+    Axis order is (dp, pp[, cp][, ep], tp) — tp innermost so tensor
     collectives ride nearest-neighbour ICI links, dp outermost (its
     all-reduce tolerates the longer hops / DCN), matching the layout intent
     of the reference's order (topology.py:189 'data','pipe','sharding',
-    'sep','model' — model innermost).
+    'sep','model' — model innermost). ``need = dp*pp*tp*ep*cp`` devices.
 
-    ``ep`` (expert parallel) aliases a slice of dp*tp rather than adding a
-    fourth physical axis; MoE layers reshape to it explicitly.
+    ``ep`` (expert parallel) and ``cp`` (context parallel, the reference's
+    SEP axis topology.py:204) only materialise as mesh axes when their
+    degree > 1, so PartitionSpecs written against dp/pp/tp are unaffected.
     """
     devices = list(devices if devices is not None else jax.devices())
-    need = dp * pp * tp
+    need = dp * pp * tp * ep * cp
     if len(devices) < need:
         raise ValueError(
-            f"mesh dp*pp*tp={need} exceeds available devices {len(devices)}")
-    arr = np.array(devices[:need]).reshape(dp, pp, tp)
-    mesh = Mesh(arr, axis_names=("dp", "pp", "tp"))
+            f"mesh dp*pp*tp*ep*cp={need} exceeds available devices "
+            f"{len(devices)}")
+    shape, names = [dp, pp], ["dp", "pp"]
+    if cp > 1:
+        shape.append(cp)
+        names.append("cp")
+    if ep > 1:
+        shape.append(ep)
+        names.append("ep")
+    shape.append(tp)
+    names.append("tp")
+    arr = np.array(devices[:need]).reshape(shape)
+    mesh = Mesh(arr, axis_names=tuple(names))
     hm = HybridMesh(mesh=mesh)
     if set_global:
         global _GLOBAL_MESH
