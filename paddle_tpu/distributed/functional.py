@@ -9,7 +9,7 @@ the c_* collective ops, paddle/fluid/operators/collective/).
 Use with parallel.init_hybrid_mesh + jax.shard_map, e.g.::
 
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
     f = shard_map(lambda x: dist.functional.all_reduce(x, "tp"),
                   mesh=hm.mesh, in_specs=P("tp"), out_specs=P())
 """

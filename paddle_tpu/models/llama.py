@@ -51,6 +51,9 @@ class LlamaConfig:
     remat: bool = True
     # kernels
     use_flash_attention: bool = True
+    # context parallelism: "none" | "ring" | "ulysses" — shards the
+    # sequence dim over the mesh cp axis (parallel/context_parallel.py)
+    context_parallel: str = "none"
 
     @property
     def head_dim(self) -> int:
@@ -177,7 +180,7 @@ def attention(q, k, v, cfg: LlamaConfig):
     return _fa(q, k, v, causal=True, impl="dense")
 
 
-def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None):
+def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
     """One transformer block on [B, T, D]. ``lp`` holds this layer's
     (unstacked) weights."""
     B, T, D = h.shape
@@ -189,9 +192,16 @@ def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None):
     k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
     v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
     q, k = rope(q, k, positions, cfg.rope_theta, Dh)
-    from ..ops.pallas.flash_attention import flash_attention as _fa
-    o = _fa(q, k, v, causal=True,
-            impl="auto" if cfg.use_flash_attention else "dense")
+    cp_on = (cfg.context_parallel != "none" and mesh is not None
+             and mesh.shape.get("cp", 1) > 1)
+    if cp_on:
+        from ..parallel.context_parallel import context_parallel_attention
+        o = context_parallel_attention(q, k, v, mesh,
+                                       impl=cfg.context_parallel)
+    else:
+        from ..ops.pallas.flash_attention import flash_attention as _fa
+        o = _fa(q, k, v, causal=True,
+                impl="auto" if cfg.use_flash_attention else "dense")
     h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
     if sp_spec is not None:
         # sequence-parallel residual stream: reduce-scatter the row-parallel
@@ -205,8 +215,9 @@ def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None):
     return h
 
 
-def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False):
-    fn = partial(decoder_layer, cfg=cfg, sp_spec=sp_spec)
+def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False,
+                 mesh=None):
+    fn = partial(decoder_layer, cfg=cfg, sp_spec=sp_spec, mesh=mesh)
     if remat:
         fn = jax.checkpoint(fn)
 
@@ -220,12 +231,16 @@ def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False):
 def forward(params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
     """tokens [B, T] -> logits [B, T, V]. Single pipeline stage (pp=1)."""
     sp_spec = None
-    if mesh is not None and mesh.shape.get("tp", 1) > 1:
+    if mesh is not None and mesh.shape.get("cp", 1) > 1:
+        # context parallel: residual stream sequence-sharded over cp
+        sp_spec = NamedSharding(mesh, P("dp", "cp", None))
+    elif mesh is not None and mesh.shape.get("tp", 1) > 1:
         sp_spec = NamedSharding(mesh, P("dp", "tp", None))
     h = params["embed"].astype(cfg.dtype)[tokens]
     if sp_spec is not None:
         h = lax.with_sharding_constraint(h, sp_spec)
-    h = _scan_layers(params["layers"], h, cfg, sp_spec, remat=cfg.remat)
+    h = _scan_layers(params["layers"], h, cfg, sp_spec, remat=cfg.remat,
+                     mesh=mesh)
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h @ params["lm_head"]
 
@@ -241,6 +256,12 @@ def _split_stages(layer_params, cfg: LlamaConfig):
 
 def forward_pipelined(params, tokens, cfg: LlamaConfig, mesh: Mesh):
     """Full pp×tp×sp×dp forward: embed → pipeline over stages → head."""
+    if cfg.context_parallel != "none":
+        raise NotImplementedError(
+            "context_parallel with pp_stages > 1 is not supported yet: the "
+            "pipeline stage loop would need the cp shard_map nested inside "
+            "it; use cp with pp=1 (ring attention already gives the "
+            "long-sequence memory scaling pipelining would)")
     sp_spec = (NamedSharding(mesh, P(None, "dp", "tp", None))
                if mesh.shape.get("tp", 1) > 1 else None)
     h = params["embed"].astype(cfg.dtype)[tokens]          # [B, T, D]
@@ -317,6 +338,7 @@ def make_batch(cfg: LlamaConfig, batch_size: int, seq_len: int, mesh: Mesh,
     key = key if key is not None else jax.random.PRNGKey(0)
     toks = jax.random.randint(key, (batch_size, seq_len + 1), 0,
                               cfg.vocab_size, dtype=jnp.int32)
-    sh = NamedSharding(mesh, P("dp", None))
+    cp = "cp" if mesh.shape.get("cp", 1) > 1 else None
+    sh = NamedSharding(mesh, P("dp", cp))
     return {"tokens": jax.device_put(toks[:, :-1], sh),
             "labels": jax.device_put(toks[:, 1:], sh)}

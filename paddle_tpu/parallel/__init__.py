@@ -14,3 +14,8 @@ from .mesh import (
     P,
 )
 from .pipeline_spmd import pipeline_spmd, stack_stage_params
+from .context_parallel import (
+    ring_attention,
+    ulysses_attention,
+    context_parallel_attention,
+)
