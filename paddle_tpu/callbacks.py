@@ -1,0 +1,6 @@
+"""paddle.callbacks namespace (reference: python/paddle/hapi/callbacks.py
+exported as paddle.callbacks)."""
+from .hapi.callbacks import (  # noqa: F401
+    Callback, CallbackList, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+    LRScheduler,
+)

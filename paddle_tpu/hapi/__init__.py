@@ -1,0 +1,7 @@
+"""paddle_tpu.hapi — high-level Model.fit API (reference: python/paddle/hapi/)."""
+from .model import Model  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, CallbackList, ProgBarLogger, ModelCheckpoint, EarlyStopping,
+    LRScheduler,
+)
+from .summary import summary  # noqa: F401

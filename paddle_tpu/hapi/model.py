@@ -1,0 +1,222 @@
+"""High-level Model API: prepare / fit / evaluate / predict.
+
+Reference: python/paddle/hapi/model.py:1082 (Model.fit), :1808 (predict) —
+the Keras-style trainer over a Layer, with metrics and callbacks.
+
+TPU note: the train loop is eager op-by-op (tape autograd) like the
+reference's dygraph path; each batch is device_put once and all math stays
+on device. For the jit-compiled whole-step path use models/llama-style
+functional train steps or jit.to_static on the Layer.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io.dataloader import DataLoader
+from ..io.dataset import Dataset
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup --------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m!r} is not a paddle_tpu.metric.Metric")
+        return self
+
+    def parameters(self):
+        return self.network.parameters()
+
+    # -- per-batch ----------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        outs = _to_list(outputs)
+        lbls = _to_list(labels)
+        loss = self._loss(*(outs + lbls))
+        if isinstance(loss, (list, tuple)):
+            loss = sum(loss)
+        if loss.ndim > 0:
+            loss = loss.mean()
+        return loss
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        outputs = self.network(*_to_list(inputs))
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = self._update_metrics(outputs, labels)
+        return [float(loss.numpy())], metrics
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        outputs = self.network(*_to_list(inputs))
+        loss = self._compute_loss(outputs, labels)
+        metrics = self._update_metrics(outputs, labels)
+        return [float(loss.numpy())], metrics
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        out = self.network(*_to_list(inputs))
+        return [o.numpy() if hasattr(o, "numpy") else np.asarray(o)
+                for o in _to_list(out)]
+
+    def _update_metrics(self, outputs, labels):
+        res = []
+        out = _to_list(outputs)[0]
+        lbl = _to_list(labels)[0] if labels is not None else None
+        for m in self._metrics:
+            m.update(*_to_list(m.compute(out, lbl)))
+            res.append(m.accumulate())
+        return res
+
+    # -- loops --------------------------------------------------------------
+    def _loader(self, data, batch_size, shuffle, num_workers):
+        if data is None or isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers, drop_last=True)
+        return data  # assume iterable of batches
+
+    def _metric_logs(self, prefix=""):
+        logs = {}
+        for m in self._metrics:
+            names = m.name() if isinstance(m.name(), list) else [m.name()]
+            vals = m.accumulate()
+            vals = vals if isinstance(vals, list) else [vals]
+            for n, v in zip(names, vals):
+                logs[prefix + n] = v
+        return logs
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert self._optimizer is not None and self._loss is not None, \
+            "call prepare(optimizer, loss) before fit"
+        loader = self._loader(train_data, batch_size, shuffle, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir, metrics=self._metrics)
+
+        self.stop_training = False
+        cbks.on_train_begin()
+        it = 0
+        for epoch in range(epochs):
+            for m in self._metrics:
+                m.reset()
+            cbks.on_epoch_begin(epoch)
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbls = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                losses, _ = self.train_batch(ins, lbls, update=update)
+                logs = {"loss": losses[0], **self._metric_logs()}
+                cbks.on_train_batch_end(step, logs)
+                it += 1
+                if (num_iters and it >= num_iters) or self.stop_training:
+                    break
+            cbks.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose, callbacks=callbacks,
+                              num_workers=num_workers)
+            if (num_iters and it >= num_iters) or self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = self._loader(eval_data, batch_size, False, num_workers)
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size,
+            steps=len(loader) if hasattr(loader, "__len__") else None,
+            log_freq=log_freq, verbose=verbose, metrics=self._metrics,
+            mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin()
+        losses = []
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbls = self._split_batch(batch)
+            l, _ = self.eval_batch(ins, lbls)
+            losses.append(l[0])
+            cbks.on_eval_batch_end(step, {"loss": l[0]})
+        logs = {"loss": float(np.mean(losses)) if losses else 0.0,
+                **self._metric_logs()}
+        cbks.on_eval_end(logs)
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._loader(test_data, batch_size, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = self._split_batch(batch, has_labels=False)
+            outputs.append(self.predict_batch(ins))
+        if stack_outputs and outputs:
+            n = len(outputs[0])
+            return [np.concatenate([o[i] for o in outputs]) for i in range(n)]
+        return outputs
+
+    def _split_batch(self, batch, has_labels=True):
+        if isinstance(batch, (list, tuple)):
+            if len(batch) >= 2:
+                # (x..., y) pairs: predict drops the trailing label a
+                # labelled Dataset yields (reference predict does the same
+                # via its _inputs spec)
+                return batch[:-1], (batch[-1:] if has_labels else None)
+            return batch, None
+        return [batch], None
+
+    # -- persistence --------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework import io as fio
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import io as fio
+        self.network.set_state_dict(fio.load(path + ".pdparams"))
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+        return self
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+        return _summary(self.network, input_size, dtypes=dtype)

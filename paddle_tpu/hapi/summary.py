@@ -1,0 +1,29 @@
+"""Model summary (reference: python/paddle/hapi/model_summary.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Print a per-layer parameter table; returns
+    {'total_params': N, 'trainable_params': N}."""
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if not getattr(p, "stop_gradient", False):
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    w = max([len(r[0]) for r in rows], default=10) + 2
+    print(f"{'Layer (param)':<{w}}{'Shape':<20}{'Param #':>12}")
+    print("-" * (w + 32))
+    for name, shape, n in rows:
+        print(f"{name:<{w}}{str(shape):<20}{n:>12,}")
+    print("-" * (w + 32))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

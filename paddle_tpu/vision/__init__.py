@@ -1,0 +1,22 @@
+"""paddle_tpu.vision — vision models, transforms, datasets, ops.
+
+Reference: python/paddle/vision/ (models/, transforms/, datasets/, ops.py).
+Model definitions live in paddle_tpu.models and are re-exported here under
+the reference's paths.
+"""
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import models  # noqa: F401
+from .models import (  # noqa: F401
+    LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
+)
+
+
+def get_image_backend() -> str:
+    return "numpy"
+
+
+def set_image_backend(backend: str) -> None:
+    if backend not in ("numpy", "cv2", "pil"):
+        raise ValueError(f"unknown image backend {backend!r}")

@@ -1,0 +1,8 @@
+"""vision.models — re-export of the model zoo under the reference's path
+(python/paddle/vision/models/__init__.py)."""
+from ...models.lenet import LeNet  # noqa: F401
+from ...models.resnet import (  # noqa: F401
+    ResNet, BasicBlock, BottleneckBlock,
+    resnet18, resnet34, resnet50, resnet101, resnet152,
+    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
+)

@@ -1,0 +1,228 @@
+"""Image transforms (reference: python/paddle/vision/transforms/transforms.py).
+
+Numpy-based host-side preprocessing: transforms run in DataLoader workers on
+CPU; only the collated batch is device_put to TPU. Images are HWC uint8/float
+numpy arrays (or CHW float after ToTensor), matching the reference's
+conventions.
+"""
+from __future__ import annotations
+
+import numbers
+import random
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class Compose:
+    def __init__(self, transforms: Sequence):
+        self.transforms = list(transforms)
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+def _size2(size) -> Tuple[int, int]:
+    if isinstance(size, numbers.Number):
+        return int(size), int(size)
+    return int(size[0]), int(size[1])
+
+
+def _resize_np(img: np.ndarray, h: int, w: int) -> np.ndarray:
+    """Bilinear resize via separable linear interpolation (no PIL/cv2
+    dependency in this environment)."""
+    ih, iw = img.shape[:2]
+    if (ih, iw) == (h, w):
+        return img
+    ys = np.linspace(0, ih - 1, h)
+    xs = np.linspace(0, iw - 1, w)
+    y0 = np.floor(ys).astype(np.int64)
+    x0 = np.floor(xs).astype(np.int64)
+    y1 = np.minimum(y0 + 1, ih - 1)
+    x1 = np.minimum(x0 + 1, iw - 1)
+    wy = (ys - y0)[:, None]
+    wx = (xs - x0)[None, :]
+    im = img.astype(np.float32)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    top = im[y0][:, x0] * (1 - wx[..., None]) + im[y0][:, x1] * wx[..., None]
+    bot = im[y1][:, x0] * (1 - wx[..., None]) + im[y1][:, x1] * wx[..., None]
+    out = top * (1 - wy[..., None]) + bot * wy[..., None]
+    if img.ndim == 2:
+        out = out[:, :, 0]
+    return out.astype(img.dtype) if img.dtype != np.uint8 else \
+        np.clip(out, 0, 255).astype(np.uint8)
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = size
+
+    def _apply_image(self, img):
+        if isinstance(self.size, numbers.Number):
+            # shorter side -> size, keep aspect
+            h, w = img.shape[:2]
+            if h < w:
+                nh, nw = int(self.size), int(round(w * self.size / h))
+            else:
+                nh, nw = int(round(h * self.size / w)), int(self.size)
+        else:
+            nh, nw = _size2(self.size)
+        return _resize_np(img, nh, nw)
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = _size2(size)
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        return img[i:i + th, j:j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = _size2(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        if self.padding:
+            p = self.padding if isinstance(self.padding, (list, tuple)) \
+                else (self.padding,) * 4
+            pad = [(p[1], p[3]), (p[0], p[2])] + \
+                  [(0, 0)] * (img.ndim - 2)
+            img = np.pad(img, pad)
+        h, w = img.shape[:2]
+        th, tw = self.size
+        i = random.randint(0, max(h - th, 0))
+        j = random.randint(0, max(w - tw, 0))
+        return img[i:i + th, j:j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return img[:, ::-1].copy()
+        return img
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob: float = 0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if random.random() < self.prob:
+            return img[::-1].copy()
+        return img
+
+
+class Normalize(BaseTransform):
+    """(img - mean) / std per channel; expects CHW float (after ToTensor)
+    or HWC with data_format='HWC'."""
+
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img, np.float32)
+        if self.data_format == "CHW":
+            m = self.mean.reshape(-1, 1, 1)
+            s = self.std.reshape(-1, 1, 1)
+        else:
+            m, s = self.mean, self.std
+        return (img - m) / s
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        if img.ndim == 2:
+            img = img[:, :, None]
+        return img.transpose(self.order)
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] numpy (collate device_puts)."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        img = np.asarray(img)
+        if img.ndim == 2:
+            img = img[:, :, None]
+        img = img.astype(np.float32)
+        if np.issubdtype(np.asarray(img).dtype, np.floating):
+            img = img / 255.0 if img.max() > 1.5 else img
+        if self.data_format == "CHW":
+            img = img.transpose(2, 0, 1)
+        return img
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        alpha = 1 + np.random.uniform(-self.value, self.value)
+        dt = img.dtype
+        out = np.clip(img.astype(np.float32) * alpha, 0,
+                      255 if dt == np.uint8 else np.inf)
+        return out.astype(dt)
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.brightness = BrightnessTransform(brightness)
+
+    def _apply_image(self, img):
+        return self.brightness(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return ToTensor(data_format)(pic)
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    return Normalize(mean, std, data_format)(img)
+
+
+def hflip(img):
+    return img[:, ::-1].copy()
+
+
+def vflip(img):
+    return img[::-1].copy()
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def crop(img, top, left, height, width):
+    return img[top:top + height, left:left + width]

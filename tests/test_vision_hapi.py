@@ -1,0 +1,130 @@
+"""Vision models + transforms + metrics + hapi Model.fit.
+
+Mirrors the reference's test/legacy_test/test_vision_models.py,
+test_transforms.py, test_metrics.py, and hapi test_model.py, scaled for CI.
+"""
+import os
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import transforms as T
+from paddle_tpu.vision.datasets import FakeData
+from paddle_tpu.metric import Accuracy, Precision, Recall, Auc
+from paddle_tpu.hapi import Model
+
+
+def test_resnet18_forward():
+    net = pt.vision.models.resnet18(num_classes=10)
+    x = pt.to_tensor(np.random.randn(2, 3, 32, 32).astype("float32"))
+    out = net(x)
+    assert tuple(out.shape) == (2, 10)
+
+
+def test_resnet50_bottleneck_forward():
+    net = pt.vision.models.resnet50(num_classes=7)
+    x = pt.to_tensor(np.random.randn(1, 3, 64, 64).astype("float32"))
+    out = net(x)
+    assert tuple(out.shape) == (1, 7)
+
+
+def test_resnext_grouped_conv():
+    net = pt.vision.models.resnext50_32x4d(num_classes=4)
+    x = pt.to_tensor(np.random.randn(1, 3, 32, 32).astype("float32"))
+    assert tuple(net(x).shape) == (1, 4)
+
+
+def test_transforms_pipeline():
+    tf = T.Compose([
+        T.Resize(40), T.CenterCrop(32), T.RandomHorizontalFlip(0.5),
+        T.ToTensor(),
+        T.Normalize(mean=[0.5, 0.5, 0.5], std=[0.5, 0.5, 0.5]),
+    ])
+    img = (np.random.rand(48, 64, 3) * 255).astype(np.uint8)
+    out = tf(img)
+    assert out.shape == (3, 32, 32)
+    assert out.dtype == np.float32
+    assert -1.01 <= out.min() and out.max() <= 1.01
+
+
+def test_resize_aspect_and_exact():
+    img = np.zeros((40, 80, 3), np.uint8)
+    assert T.Resize(20)(img).shape == (20, 40, 3)
+    assert T.Resize((16, 24))(img).shape == (16, 24, 3)
+
+
+def test_accuracy_metric():
+    m = Accuracy(topk=(1, 2))
+    pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1], [0.1, 0.2, 0.7]])
+    label = np.array([[1], [2], [2]])
+    m.update(m.compute(pred, label))
+    top1, top2 = m.accumulate()
+    np.testing.assert_allclose(top1, 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(top2, 2 / 3, rtol=1e-6)
+
+
+def test_precision_recall_auc():
+    p, r, a = Precision(), Recall(), Auc()
+    preds = np.array([0.9, 0.8, 0.2, 0.6])
+    labels = np.array([1, 0, 1, 1])
+    p.update(preds, labels)
+    r.update(preds, labels)
+    a.update(preds, labels)
+    np.testing.assert_allclose(p.accumulate(), 2 / 3, rtol=1e-6)
+    np.testing.assert_allclose(r.accumulate(), 2 / 3, rtol=1e-6)
+    assert 0.0 <= a.accumulate() <= 1.0
+
+
+def test_model_fit_evaluate_predict(tmp_path):
+    train = FakeData(num_samples=64, image_shape=(1, 28, 28), num_classes=10)
+    test = FakeData(num_samples=32, image_shape=(1, 28, 28), num_classes=10,
+                    seed=1)
+    net = pt.models.LeNet()
+    model = Model(net)
+    model.prepare(
+        optimizer=pt.optimizer.Adam(learning_rate=1e-3,
+                                    parameters=net.parameters()),
+        loss=pt.nn.CrossEntropyLoss(),
+        metrics=Accuracy())
+    model.fit(train, batch_size=16, epochs=1, verbose=0)
+    logs = model.evaluate(test, batch_size=16, verbose=0)
+    assert "loss" in logs and "acc" in logs
+    preds = model.predict(test, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (32, 10)
+    # save / load round-trip
+    path = str(tmp_path / "ckpt" / "m")
+    model.save(path)
+    model2 = Model(pt.models.LeNet())
+    model2.prepare(optimizer=pt.optimizer.Adam(
+        learning_rate=1e-3, parameters=model2.network.parameters()),
+        loss=pt.nn.CrossEntropyLoss())
+    model2.load(path)
+    w1 = model.network.state_dict()
+    w2 = model2.network.state_dict()
+    for k in w1:
+        np.testing.assert_array_equal(np.asarray(w1[k].numpy()),
+                                      np.asarray(w2[k].numpy()))
+
+
+def test_model_fit_improves_on_learnable_data():
+    """Two separable gaussian blobs: a few epochs must beat chance."""
+    rng = np.random.RandomState(0)
+    ys = rng.randint(0, 2, (128, 1)).astype(np.int64)
+    xs = (rng.randn(128, 1, 8, 8) + ys[:, :, None, None]).astype(np.float32)
+
+    class Arr(pt.io.Dataset):
+        def __len__(self):
+            return len(xs)
+
+        def __getitem__(self, i):
+            return xs[i], ys[i]
+
+    net = pt.nn.Sequential(pt.nn.Flatten(), pt.nn.Linear(64, 2))
+    model = Model(net)
+    model.prepare(pt.optimizer.Adam(learning_rate=0.05,
+                                    parameters=net.parameters()),
+                  pt.nn.CrossEntropyLoss(), Accuracy())
+    model.fit(Arr(), batch_size=32, epochs=5, verbose=0, shuffle=False)
+    logs = model.evaluate(Arr(), batch_size=32, verbose=0)
+    assert logs["acc"] > 0.8
