@@ -1,0 +1,119 @@
+"""Host staging allocator + memory stats API.
+
+Reference surface: paddle/phi/core/memory/ (AllocatorFacade,
+AutoGrowthBestFitAllocator, stats.h) and the python
+paddle.device.cuda.max_memory_allocated family. On TPU, device HBM belongs
+to XLA — what the framework owns natively is the pinned host staging
+memory the input pipeline uses, managed by the C++ best-fit allocator
+(csrc/allocator.cc) when available, with a numpy-backed fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from . import native
+
+
+class HostAllocator:
+    """Auto-growth best-fit host allocator (native when possible)."""
+
+    def __init__(self, chunk_size: int = 64 << 20):
+        self._lib = native.lib()
+        self._lock = threading.Lock()
+        self._py_stats = [0, 0, 0, 0]  # allocated/reserved/peaks fallback
+        if self._lib is not None:
+            self._h = self._lib.pt_alloc_create(chunk_size)
+        else:
+            self._h = None
+        self._live: Dict[int, object] = {}
+
+    @property
+    def native(self) -> bool:
+        return self._h is not None
+
+    def alloc_buffer(self, nbytes: int) -> memoryview:
+        """A writable buffer of ``nbytes`` from the arena."""
+        if self._h is not None:
+            ptr = self._lib.pt_alloc_malloc(self._h, nbytes)
+            if not ptr:
+                raise MemoryError(f"host allocator failed for {nbytes} bytes")
+            buf = (ctypes.c_char * nbytes).from_address(ptr)
+            mv = memoryview(buf).cast("B")
+            with self._lock:
+                self._live[id(mv.obj)] = ptr
+            return mv
+        arr = np.empty(nbytes, np.uint8)
+        with self._lock:
+            self._py_stats[0] += nbytes
+            self._py_stats[1] += nbytes
+            self._py_stats[2] = max(self._py_stats[2], self._py_stats[0])
+            self._py_stats[3] = max(self._py_stats[3], self._py_stats[1])
+            self._live[id(arr)] = arr
+        return memoryview(arr)
+
+    def free_buffer(self, mv: memoryview) -> None:
+        key = id(mv.obj)
+        with self._lock:
+            ref = self._live.pop(key, None)
+        if ref is None:
+            return
+        if self._h is not None:
+            self._lib.pt_alloc_free(self._h, ref)
+        else:
+            with self._lock:
+                self._py_stats[0] -= mv.nbytes
+                self._py_stats[1] -= mv.nbytes
+
+    def stats(self) -> Dict[str, int]:
+        if self._h is not None:
+            out = (ctypes.c_uint64 * 4)()
+            self._lib.pt_alloc_stats(self._h, out)
+            vals = list(out)
+        else:
+            vals = list(self._py_stats)
+        return {"allocated": vals[0], "reserved": vals[1],
+                "peak_allocated": vals[2], "peak_reserved": vals[3]}
+
+    def reset_peak(self) -> None:
+        if self._h is not None:
+            self._lib.pt_alloc_reset_peak(self._h)
+        else:
+            with self._lock:
+                self._py_stats[2] = self._py_stats[0]
+                self._py_stats[3] = self._py_stats[1]
+
+    def __del__(self):
+        if getattr(self, "_h", None) is not None and native.lib() is not None:
+            try:
+                native.lib().pt_alloc_destroy(self._h)
+            except Exception:
+                pass
+
+
+_default: Optional[HostAllocator] = None
+_default_lock = threading.Lock()
+
+
+def default_allocator() -> HostAllocator:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = HostAllocator()
+        return _default
+
+
+def memory_stats() -> Dict[str, int]:
+    """paddle.device.*.memory_stats equivalent for host staging memory."""
+    return default_allocator().stats()
+
+
+def max_memory_allocated() -> int:
+    return default_allocator().stats()["peak_allocated"]
+
+
+def max_memory_reserved() -> int:
+    return default_allocator().stats()["peak_reserved"]

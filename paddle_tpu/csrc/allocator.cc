@@ -1,0 +1,157 @@
+// Auto-growth best-fit host allocator with stats.
+//
+// TPU-native counterpart of the reference's allocator stack
+// (paddle/phi/core/memory/allocation/auto_growth_best_fit_allocator.h,
+// allocator_facade.h, stats.h): device HBM is managed by XLA, so the native
+// allocator's job here is pinned host staging buffers for the input
+// pipeline (DataLoader batches, checkpoint IO) — large page-aligned chunks
+// grown on demand, best-fit reuse, and the allocated/reserved/peak stat
+// counters paddle.device.*.max_memory_allocated exposes.
+//
+// C ABI (ctypes-consumed; see paddle_tpu/core/native.py).
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <set>
+#include <vector>
+
+namespace {
+
+constexpr size_t kAlign = 256;  // matches TPU-friendly host buffer alignment
+
+inline size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+
+struct Chunk {
+  void* base;
+  size_t size;
+};
+
+struct FreeBlock {
+  size_t size;
+  void* ptr;
+  bool operator<(const FreeBlock& o) const {
+    return size != o.size ? size < o.size : ptr < o.ptr;
+  }
+};
+
+class AutoGrowthBestFit {
+ public:
+  explicit AutoGrowthBestFit(size_t chunk_size)
+      : chunk_size_(chunk_size ? align_up(chunk_size) : (64u << 20)) {}
+
+  ~AutoGrowthBestFit() {
+    for (auto& c : chunks_) std::free(c.base);
+  }
+
+  void* Alloc(size_t n) {
+    if (n == 0) return nullptr;
+    n = align_up(n);
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = free_.lower_bound(FreeBlock{n, nullptr});
+    if (it == free_.end()) {
+      size_t grow = n > chunk_size_ ? n : chunk_size_;
+      void* base = nullptr;
+      if (posix_memalign(&base, kAlign, grow) != 0) return nullptr;
+      chunks_.push_back({base, grow});
+      reserved_ += grow;
+      if (reserved_ > peak_reserved_) peak_reserved_ = reserved_;
+      it = free_.insert(FreeBlock{grow, base}).first;
+    }
+    FreeBlock blk = *it;
+    free_.erase(it);
+    void* out = blk.ptr;
+    if (blk.size > n) {  // split: remainder back to the free list
+      free_.insert(
+          FreeBlock{blk.size - n, static_cast<char*>(blk.ptr) + n});
+    }
+    size_t got = blk.size > n ? n : blk.size;
+    in_use_[out] = got;
+    allocated_ += got;
+    if (allocated_ > peak_allocated_) peak_allocated_ = allocated_;
+    return out;
+  }
+
+  bool Free(void* p) {
+    if (p == nullptr) return true;
+    std::lock_guard<std::mutex> g(mu_);
+    auto it = in_use_.find(p);
+    if (it == in_use_.end()) return false;
+    size_t n = it->second;
+    allocated_ -= n;
+    in_use_.erase(it);
+    // coalesce with adjacent free blocks
+    char* lo = static_cast<char*>(p);
+    char* hi = lo + n;
+    for (auto fit = free_.begin(); fit != free_.end();) {
+      char* fb = static_cast<char*>(fit->ptr);
+      char* fe = fb + fit->size;
+      if (fe == lo) {
+        lo = fb;
+        fit = free_.erase(fit);
+      } else if (fb == hi) {
+        hi = fe;
+        fit = free_.erase(fit);
+      } else {
+        ++fit;
+      }
+    }
+    free_.insert(FreeBlock{static_cast<size_t>(hi - lo), lo});
+    return true;
+  }
+
+  void Stats(uint64_t* out4) {
+    std::lock_guard<std::mutex> g(mu_);
+    out4[0] = allocated_;
+    out4[1] = reserved_;
+    out4[2] = peak_allocated_;
+    out4[3] = peak_reserved_;
+  }
+
+  void ResetPeak() {
+    std::lock_guard<std::mutex> g(mu_);
+    peak_allocated_ = allocated_;
+    peak_reserved_ = reserved_;
+  }
+
+ private:
+  std::mutex mu_;
+  size_t chunk_size_;
+  std::vector<Chunk> chunks_;
+  std::set<FreeBlock> free_;
+  std::map<void*, size_t> in_use_;
+  uint64_t allocated_ = 0, reserved_ = 0;
+  uint64_t peak_allocated_ = 0, peak_reserved_ = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_alloc_create(uint64_t chunk_size) {
+  return new (std::nothrow) AutoGrowthBestFit(chunk_size);
+}
+
+void pt_alloc_destroy(void* a) {
+  delete static_cast<AutoGrowthBestFit*>(a);
+}
+
+void* pt_alloc_malloc(void* a, uint64_t n) {
+  return static_cast<AutoGrowthBestFit*>(a)->Alloc(n);
+}
+
+int pt_alloc_free(void* a, void* p) {
+  return static_cast<AutoGrowthBestFit*>(a)->Free(p) ? 0 : -1;
+}
+
+void pt_alloc_stats(void* a, uint64_t* out4) {
+  static_cast<AutoGrowthBestFit*>(a)->Stats(out4);
+}
+
+void pt_alloc_reset_peak(void* a) {
+  static_cast<AutoGrowthBestFit*>(a)->ResetPeak();
+}
+
+}  // extern "C"

@@ -1,0 +1,189 @@
+// Shared-memory ring buffer for multiprocess DataLoader transfer.
+//
+// Counterpart of the reference's shared-memory LoDTensor blobs between
+// DataLoader worker processes and the trainer
+// (python/paddle/io/dataloader/flat.py, multiprocess_utils.py, and the
+// underlying paddle/fluid memory::allocation shm machinery): a worker
+// process serialises a batch and pushes the bytes; the main process pops
+// without an extra pickle-through-pipe copy.
+//
+// Single-producer single-consumer, lock-free (acquire/release atomics on
+// head/tail), messages are length-prefixed byte spans that wrap around the
+// ring. One ring per worker.
+#include <atomic>
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <ctime>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct RingHdr {
+  std::atomic<uint64_t> head;  // next write offset (producer-owned)
+  std::atomic<uint64_t> tail;  // next read offset (consumer-owned)
+  uint64_t capacity;           // data bytes
+  std::atomic<uint32_t> closed;
+  uint32_t _pad;
+};
+
+struct Ring {
+  RingHdr* hdr;
+  char* data;
+  size_t map_size;
+  bool owner;
+  char name[256];
+};
+
+constexpr uint64_t kLenSize = 8;
+
+Ring* map_ring(const char* name, uint64_t capacity, bool create) {
+  int flags = create ? (O_CREAT | O_EXCL | O_RDWR) : O_RDWR;
+  int fd = shm_open(name, flags, 0600);
+  if (fd < 0) return nullptr;
+  size_t map_size = sizeof(RingHdr) + capacity;
+  if (create && ftruncate(fd, static_cast<off_t>(map_size)) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  if (!create) {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || static_cast<size_t>(st.st_size) < sizeof(RingHdr)) {
+      close(fd);
+      return nullptr;
+    }
+    map_size = static_cast<size_t>(st.st_size);
+  }
+  void* mem = mmap(nullptr, map_size, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Ring* r = new Ring;
+  r->hdr = static_cast<RingHdr*>(mem);
+  r->data = static_cast<char*>(mem) + sizeof(RingHdr);
+  r->map_size = map_size;
+  r->owner = create;
+  std::snprintf(r->name, sizeof(r->name), "%s", name);
+  if (create) {
+    r->hdr->head.store(0, std::memory_order_relaxed);
+    r->hdr->tail.store(0, std::memory_order_relaxed);
+    r->hdr->capacity = capacity;
+    r->hdr->closed.store(0, std::memory_order_relaxed);
+  }
+  return r;
+}
+
+inline void ring_copy_in(Ring* r, uint64_t pos, const void* src, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  std::memcpy(r->data + off, src, first);
+  if (n > first) std::memcpy(r->data, static_cast<const char*>(src) + first,
+                             n - first);
+}
+
+inline void ring_copy_out(Ring* r, uint64_t pos, void* dst, uint64_t n) {
+  uint64_t cap = r->hdr->capacity;
+  uint64_t off = pos % cap;
+  uint64_t first = n < cap - off ? n : cap - off;
+  std::memcpy(dst, r->data + off, first);
+  if (n > first) std::memcpy(static_cast<char*>(dst) + first, r->data,
+                             n - first);
+}
+
+void sleep_us(long us) {
+  struct timespec ts{0, us * 1000L};
+  nanosleep(&ts, nullptr);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_ring_create(const char* name, uint64_t capacity) {
+  return map_ring(name, capacity, /*create=*/true);
+}
+
+void* pt_ring_attach(const char* name) {
+  return map_ring(name, 0, /*create=*/false);
+}
+
+// returns 0 ok, -1 message larger than ring, -2 timeout, -3 closed
+int pt_ring_push(void* rv, const void* buf, uint64_t n, int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(rv);
+  uint64_t need = kLenSize + n;
+  uint64_t cap = r->hdr->capacity;
+  if (need > cap) return -1;
+  int64_t waited_us = 0;
+  for (;;) {
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    if (cap - (head - tail) >= need) {
+      ring_copy_in(r, head, &n, kLenSize);
+      ring_copy_in(r, head + kLenSize, buf, n);
+      r->hdr->head.store(head + need, std::memory_order_release);
+      return 0;
+    }
+    if (r->hdr->closed.load(std::memory_order_relaxed)) return -3;
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -2;
+    sleep_us(200);
+    waited_us += 200;
+  }
+}
+
+// peek size of next message; -1 empty, -3 closed-and-drained
+int64_t pt_ring_next_size(void* rv) {
+  Ring* r = static_cast<Ring*>(rv);
+  uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+  uint64_t head = r->hdr->head.load(std::memory_order_acquire);
+  if (head == tail) {
+    return r->hdr->closed.load(std::memory_order_relaxed) ? -3 : -1;
+  }
+  uint64_t n;
+  ring_copy_out(r, tail, &n, kLenSize);
+  return static_cast<int64_t>(n);
+}
+
+// pop into buf (must hold next_size bytes); returns bytes or -1/-2/-3
+int64_t pt_ring_pop(void* rv, void* buf, uint64_t bufsize,
+                    int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(rv);
+  int64_t waited_us = 0;
+  for (;;) {
+    int64_t sz = pt_ring_next_size(rv);
+    if (sz >= 0) {
+      if (static_cast<uint64_t>(sz) > bufsize) return -1;
+      uint64_t tail = r->hdr->tail.load(std::memory_order_relaxed);
+      ring_copy_out(r, tail + kLenSize, buf, static_cast<uint64_t>(sz));
+      r->hdr->tail.store(tail + kLenSize + static_cast<uint64_t>(sz),
+                         std::memory_order_release);
+      return sz;
+    }
+    if (sz == -3) return -3;
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -2;
+    sleep_us(200);
+    waited_us += 200;
+  }
+}
+
+void pt_ring_close(void* rv) {
+  static_cast<Ring*>(rv)->hdr->closed.store(1, std::memory_order_release);
+}
+
+void pt_ring_destroy(void* rv) {
+  Ring* r = static_cast<Ring*>(rv);
+  bool owner = r->owner;
+  char name[256];
+  std::memcpy(name, r->name, sizeof(name));
+  munmap(r->hdr, r->map_size);
+  if (owner) shm_unlink(name);
+  delete r;
+}
+
+}  // extern "C"

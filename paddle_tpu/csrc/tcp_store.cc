@@ -1,0 +1,278 @@
+// TCPStore: key-value rendezvous for multi-host bootstrap.
+//
+// Counterpart of the reference's TCPStore
+// (paddle/phi/core/distributed/store/tcp_store.h, tcp_utils.cc): rank 0
+// runs the server thread; every rank connects as a client and uses
+// set/get/add/wait to exchange addresses and barrier before
+// jax.distributed.initialize-style setup. Wire protocol: 1-byte op,
+// u32 key length, key bytes, u32 value length, value bytes; replies are
+// u32-length-prefixed blobs (add replies i64).
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t { kSet = 0, kGet = 1, kAdd = 2, kWait = 3, kPing = 4 };
+
+bool read_full(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool read_blob(int fd, std::string* out) {
+  uint32_t n;
+  if (!read_full(fd, &n, 4)) return false;
+  out->resize(n);
+  return n == 0 || read_full(fd, &(*out)[0], n);
+}
+
+bool write_blob(int fd, const std::string& s) {
+  uint32_t n = static_cast<uint32_t>(s.size());
+  return write_full(fd, &n, 4) &&
+         (n == 0 || write_full(fd, s.data(), n));
+}
+
+class Server {
+ public:
+  explicit Server(int port) : port_(port) {}
+
+  bool Start() {
+    lfd_ = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (lfd_ < 0) return false;
+    int one = 1;
+    setsockopt(lfd_, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port_));
+    if (::bind(lfd_, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+        ::listen(lfd_, 128) != 0) {
+      ::close(lfd_);
+      return false;
+    }
+    accept_thread_ = std::thread([this] { AcceptLoop(); });
+    return true;
+  }
+
+  void Stop() {
+    stop_.store(true);
+    ::shutdown(lfd_, SHUT_RDWR);
+    ::close(lfd_);
+    if (accept_thread_.joinable()) accept_thread_.join();
+    for (auto& t : client_threads_)
+      if (t.joinable()) t.join();
+  }
+
+  ~Server() { if (!stop_.load()) Stop(); }
+
+ private:
+  void AcceptLoop() {
+    while (!stop_.load()) {
+      int cfd = ::accept(lfd_, nullptr, nullptr);
+      if (cfd < 0) break;
+      int one = 1;
+      setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      std::lock_guard<std::mutex> g(threads_mu_);
+      client_threads_.emplace_back([this, cfd] { Serve(cfd); });
+    }
+  }
+
+  void Serve(int fd) {
+    for (;;) {
+      uint8_t op;
+      if (!read_full(fd, &op, 1)) break;
+      std::string key, val;
+      if (!read_blob(fd, &key)) break;
+      if (op == kSet || op == kAdd) {
+        if (!read_blob(fd, &val)) break;
+      }
+      if (op == kSet) {
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          kv_[key] = val;
+        }
+        cv_.notify_all();
+        if (!write_blob(fd, "")) break;
+      } else if (op == kGet) {
+        std::string out;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          auto it = kv_.find(key);
+          if (it != kv_.end()) out = it->second;
+        }
+        if (!write_blob(fd, out)) break;
+      } else if (op == kAdd) {
+        int64_t delta;
+        std::memcpy(&delta, val.data(), 8);
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> g(mu_);
+          int64_t cur = 0;
+          auto it = kv_.find(key);
+          if (it != kv_.end()) std::memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string enc(8, '\0');
+          std::memcpy(&enc[0], &now, 8);
+          kv_[key] = enc;
+        }
+        cv_.notify_all();
+        if (!write_full(fd, &now, 8)) break;
+      } else if (op == kWait) {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [&] {
+          return stop_.load() || kv_.count(key) > 0;
+        });
+        lk.unlock();
+        if (!write_blob(fd, "")) break;
+      } else if (op == kPing) {
+        if (!write_blob(fd, "pong")) break;
+      }
+    }
+    ::close(fd);
+  }
+
+  int port_;
+  int lfd_ = -1;
+  std::atomic<bool> stop_{false};
+  std::thread accept_thread_;
+  std::mutex threads_mu_;
+  std::vector<std::thread> client_threads_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::map<std::string, std::string> kv_;
+};
+
+struct Client {
+  int fd;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* pt_store_server_start(int port) {
+  Server* s = new (std::nothrow) Server(port);
+  if (s && !s->Start()) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void pt_store_server_stop(void* s) {
+  Server* srv = static_cast<Server*>(s);
+  srv->Stop();
+  delete srv;
+}
+
+void* pt_store_connect(const char* host, int port, int timeout_ms) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return nullptr;
+  }
+  // retry loop: server may come up later (reference tcp_utils retries too)
+  int waited = 0;
+  while (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                   sizeof(addr)) != 0) {
+    ::close(fd);
+    if (waited >= timeout_ms) return nullptr;
+    usleep(50 * 1000);
+    waited += 50;
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  Client* c = new Client{fd};
+  return c;
+}
+
+void pt_store_disconnect(void* cv) {
+  Client* c = static_cast<Client*>(cv);
+  ::close(c->fd);
+  delete c;
+}
+
+int pt_store_set(void* cv, const char* key, const uint8_t* val, uint32_t n) {
+  Client* c = static_cast<Client*>(cv);
+  uint8_t op = kSet;
+  std::string k(key), v(reinterpret_cast<const char*>(val), n), reply;
+  if (!write_full(c->fd, &op, 1) || !write_blob(c->fd, k) ||
+      !write_blob(c->fd, v) || !read_blob(c->fd, &reply))
+    return -1;
+  return 0;
+}
+
+// returns length (>=0) into out (caller-sized), -1 missing/short buffer
+int64_t pt_store_get(void* cv, const char* key, uint8_t* out,
+                     uint32_t out_cap) {
+  Client* c = static_cast<Client*>(cv);
+  uint8_t op = kGet;
+  std::string k(key), reply;
+  if (!write_full(c->fd, &op, 1) || !write_blob(c->fd, k) ||
+      !read_blob(c->fd, &reply))
+    return -1;
+  if (reply.size() > out_cap) return -1;
+  std::memcpy(out, reply.data(), reply.size());
+  return static_cast<int64_t>(reply.size());
+}
+
+int64_t pt_store_add(void* cv, const char* key, int64_t delta) {
+  Client* c = static_cast<Client*>(cv);
+  uint8_t op = kAdd;
+  std::string k(key), v(8, '\0');
+  std::memcpy(&v[0], &delta, 8);
+  int64_t result;
+  if (!write_full(c->fd, &op, 1) || !write_blob(c->fd, k) ||
+      !write_blob(c->fd, v) || !read_full(c->fd, &result, 8))
+    return INT64_MIN;
+  return result;
+}
+
+int pt_store_wait(void* cv, const char* key) {
+  Client* c = static_cast<Client*>(cv);
+  uint8_t op = kWait;
+  std::string k(key), reply;
+  if (!write_full(c->fd, &op, 1) || !write_blob(c->fd, k) ||
+      !read_blob(c->fd, &reply))
+    return -1;
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,58 @@
+"""paddle.device namespace (reference: python/paddle/device/ — set_device,
+get_device, cuda.* memory stats).
+
+Device memory on TPU is XLA-managed; per-device HBM numbers come from
+jax's memory_stats(). Host staging memory is the native allocator's
+(core/allocator.py).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..framework import (  # noqa: F401
+    get_device, set_device, get_default_device, device_count,
+    is_compiled_with_tpu,
+)
+from ..core.allocator import (  # noqa: F401
+    memory_stats as host_memory_stats,
+    max_memory_allocated as host_max_memory_allocated,
+)
+
+
+def memory_stats(device_id: int = 0) -> dict:
+    """Device HBM stats from the XLA backend (empty dict on backends that
+    don't report)."""
+    d = jax.devices()[device_id]
+    return dict(d.memory_stats() or {}) if hasattr(d, "memory_stats") else {}
+
+
+def max_memory_allocated(device_id: int = 0) -> int:
+    return int(memory_stats(device_id).get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device_id: int = 0) -> int:
+    return int(memory_stats(device_id).get("bytes_in_use", 0))
+
+
+def max_memory_reserved(device_id: int = 0) -> int:
+    return int(memory_stats(device_id).get("bytes_limit", 0))
+
+
+def synchronize(device_id=None) -> None:
+    """Block until pending device work finishes (paddle.device.synchronize).
+    XLA's async dispatch drains via a tiny blocking transfer."""
+    import jax.numpy as jnp
+    jnp.zeros(()).block_until_ready()
+
+
+class cuda:
+    """Compat shim: paddle.device.cuda.* maps to the TPU device stats."""
+    memory_stats = staticmethod(memory_stats)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+    synchronize = staticmethod(synchronize)
+
+    @staticmethod
+    def device_count() -> int:
+        return device_count()

@@ -1,0 +1,222 @@
+"""TCPStore rendezvous (reference: paddle/phi/core/distributed/store/
+tcp_store.h + python/paddle/distributed/parallel.py init rendezvous).
+
+Backed by the native server/client in csrc/tcp_store.cc; a pure-Python
+socketserver fallback keeps single-machine flows working without g++.
+Used for multi-host bootstrap before jax.distributed / coordination
+service takes over collective wiring.
+"""
+from __future__ import annotations
+
+import ctypes
+import pickle
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Any, Optional
+
+from ..core import native
+
+
+class _PyKV(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+    def __init__(self, addr):
+        self.kv = {}
+        self.cv = threading.Condition()
+        super().__init__(addr, _PyHandler)
+
+
+class _PyHandler(socketserver.BaseRequestHandler):
+    def _read(self, n):
+        data = b""
+        while len(data) < n:
+            chunk = self.request.recv(n - len(data))
+            if not chunk:
+                raise ConnectionError
+            data += chunk
+        return data
+
+    def _read_blob(self):
+        (n,) = struct.unpack("<I", self._read(4))
+        return self._read(n) if n else b""
+
+    def _write_blob(self, b: bytes):
+        self.request.sendall(struct.pack("<I", len(b)) + b)
+
+    def handle(self):
+        srv: _PyKV = self.server
+        try:
+            while True:
+                op = self._read(1)[0]
+                key = self._read_blob().decode()
+                if op == 0:  # set
+                    val = self._read_blob()
+                    with srv.cv:
+                        srv.kv[key] = val
+                        srv.cv.notify_all()
+                    self._write_blob(b"")
+                elif op == 1:  # get
+                    with srv.cv:
+                        self._write_blob(srv.kv.get(key, b""))
+                elif op == 2:  # add
+                    (delta,) = struct.unpack("<q", self._read_blob())
+                    with srv.cv:
+                        cur = struct.unpack(
+                            "<q", srv.kv.get(key, b"\0" * 8))[0]
+                        now = cur + delta
+                        srv.kv[key] = struct.pack("<q", now)
+                        srv.cv.notify_all()
+                    self.request.sendall(struct.pack("<q", now))
+                elif op == 3:  # wait
+                    with srv.cv:
+                        srv.cv.wait_for(lambda: key in srv.kv)
+                    self._write_blob(b"")
+                elif op == 4:  # ping
+                    self._write_blob(b"pong")
+        except (ConnectionError, OSError):
+            pass
+
+
+class TCPStore:
+    """paddle.distributed TCPStore-compatible client (+server on rank 0).
+
+    API: set/get (bytes), add (int counter), wait, barrier helpers.
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 120.0):
+        self._lib = native.lib()
+        self._srv = None
+        self._pysrv = None
+        self.world_size = world_size
+        if port == 0:
+            assert is_master, "port=0 (auto) only valid for the master"
+            port = _free_port()
+        self.host, self.port = host, port
+        if is_master:
+            if self._lib is not None:
+                self._srv = self._lib.pt_store_server_start(port)
+                if not self._srv:
+                    raise OSError(f"TCPStore: cannot bind port {port}")
+            else:
+                self._pysrv = _PyKV(("0.0.0.0", port))
+                threading.Thread(target=self._pysrv.serve_forever,
+                                 daemon=True).start()
+        ip = socket.gethostbyname(host)
+        if self._lib is not None:
+            self._cli = self._lib.pt_store_connect(
+                ip.encode(), port, int(timeout * 1000))
+            if not self._cli:
+                raise TimeoutError(f"TCPStore: cannot reach {host}:{port}")
+            self._sock = None
+        else:
+            self._cli = None
+            self._sock = _py_connect(ip, port, timeout)
+
+    # -- raw kv -------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, (bytes, bytearray)) else \
+            pickle.dumps(value)
+        if self._cli is not None:
+            buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
+            rc = self._lib.pt_store_set(self._cli, key.encode(), buf,
+                                        len(data))
+            if rc != 0:
+                raise ConnectionError("TCPStore set failed")
+        else:
+            _py_req(self._sock, 0, key, data)
+
+    def get(self, key: str, decode: bool = True) -> Any:
+        if self._cli is not None:
+            cap = 1 << 20
+            out = (ctypes.c_char * cap)()
+            n = self._lib.pt_store_get(self._cli, key.encode(), out, cap)
+            if n < 0:
+                raise KeyError(key)
+            raw = bytes(out[:n])
+        else:
+            raw = _py_req(self._sock, 1, key)
+        if not raw:
+            raise KeyError(key)
+        return pickle.loads(raw) if decode else raw
+
+    def add(self, key: str, delta: int = 1) -> int:
+        if self._cli is not None:
+            v = self._lib.pt_store_add(self._cli, key.encode(), delta)
+            if v == -(2 ** 63):
+                raise ConnectionError("TCPStore add failed")
+            return int(v)
+        return struct.unpack("<q", _py_req(self._sock, 2, key,
+                                           struct.pack("<q", delta),
+                                           raw_reply=8))[0]
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        if self._cli is not None:
+            if self._lib.pt_store_wait(self._cli, key.encode()) != 0:
+                raise ConnectionError("TCPStore wait failed")
+        else:
+            _py_req(self._sock, 3, key)
+
+    # -- conveniences -------------------------------------------------------
+    def barrier(self, name: str = "barrier") -> None:
+        n = self.add(f"__{name}_in", 1)
+        if n == self.world_size:
+            self.set(f"__{name}_go", b"1")
+        self.wait(f"__{name}_go")
+
+    def close(self):
+        if self._cli is not None:
+            self._lib.pt_store_disconnect(self._cli)
+            self._cli = None
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+        if self._srv:
+            self._lib.pt_store_server_stop(self._srv)
+            self._srv = None
+        if self._pysrv is not None:
+            self._pysrv.shutdown()
+            self._pysrv = None
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _py_connect(ip, port, timeout):
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return socket.create_connection((ip, port), timeout=5)
+        except OSError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def _py_req(sock, op: int, key: str, payload: bytes = b"",
+            raw_reply: int = 0) -> bytes:
+    msg = bytes([op]) + struct.pack("<I", len(key)) + key.encode()
+    if op in (0, 2):
+        msg += struct.pack("<I", len(payload)) + payload
+    sock.sendall(msg)
+    if raw_reply:
+        data = b""
+        while len(data) < raw_reply:
+            data += sock.recv(raw_reply - len(data))
+        return data
+    hdr = b""
+    while len(hdr) < 4:
+        hdr += sock.recv(4 - len(hdr))
+    (n,) = struct.unpack("<I", hdr)
+    data = b""
+    while len(data) < n:
+        data += sock.recv(n - len(data))
+    return data

@@ -56,6 +56,44 @@ def default_collate_fn(batch: List[Any]):
     raise TypeError(f"cannot collate type {type(sample)}")
 
 
+def _numpy_collate(batch: List[Any]):
+    """Worker-side collate staying in numpy (no jax in forked children;
+    the parent re-wraps with _tree_to_tensor)."""
+    sample = batch[0]
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, float, np.number)):
+        return np.asarray(batch)
+    if isinstance(sample, (str, bytes)):
+        return batch
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([s[k] for s in batch]) for k in sample}
+    if isinstance(sample, (list, tuple)):
+        return type(sample)(_numpy_collate(list(items))
+                            for items in zip(*batch))
+    raise TypeError(f"cannot collate type {type(sample)}")
+
+
+def _tree_to_numpy(obj):
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _tree_to_numpy(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_numpy(v) for v in obj)
+    return obj
+
+
+def _tree_to_tensor(obj):
+    if isinstance(obj, np.ndarray):
+        return Tensor(obj)
+    if isinstance(obj, dict):
+        return {k: _tree_to_tensor(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_tree_to_tensor(v) for v in obj)
+    return obj
+
+
 class DataLoader:
     def __init__(self, dataset: Dataset, feed_list=None, places=None,
                  return_list: bool = True, batch_sampler=None, batch_size=1,
@@ -68,6 +106,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = max(0, num_workers)
+        self.use_shared_memory = use_shared_memory
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
         self._iterable = isinstance(dataset, IterableDataset)
@@ -92,6 +131,10 @@ class DataLoader:
             return self._iter_iterable()
         if self.num_workers == 0:
             return self._iter_single()
+        if self.use_shared_memory:
+            from .shm_channel import ShmChannel
+            if ShmChannel.available():
+                return self._iter_multiprocess()
         return self._iter_threaded()
 
     def _iter_iterable(self):
@@ -107,6 +150,68 @@ class DataLoader:
     def _iter_single(self):
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
+
+    def _iter_multiprocess(self):
+        """True multiprocess workers (reference: dataloader_iter.py
+        _DataLoaderIterMultiProcess + worker.py): forked processes run
+        __getitem__ + collate and push numpy batches through the native
+        shared-memory ring (io/shm_channel.py), one SPSC ring per worker;
+        the array payload crosses processes via one mmap copy. Batch i is
+        produced by worker i % W and consumed round-robin, preserving
+        order; full rings give natural backpressure (prefetch =
+        ring capacity)."""
+        import multiprocessing as mp
+        from .shm_channel import ShmChannel
+
+        batches = list(self.batch_sampler)
+        W = min(self.num_workers, max(len(batches), 1))
+        channels = [ShmChannel.create() for _ in range(W)]
+        numpy_collate = (self.collate_fn is not default_collate_fn)
+        ctx = mp.get_context("fork")
+
+        def worker_main(wid, ring_name):
+            import traceback
+            ch = ShmChannel.attach(ring_name)
+            try:
+                _worker_info.info = WorkerInfo(wid, W, self.dataset)
+                if self.worker_init_fn:
+                    self.worker_init_fn(wid)
+                for i in range(wid, len(batches), W):
+                    samples = [self.dataset[j] for j in batches[i]]
+                    if numpy_collate:
+                        batch = _tree_to_numpy(self.collate_fn(samples))
+                    else:
+                        batch = _numpy_collate(samples)
+                    ch.put(batch)
+            except Exception:
+                try:
+                    ch.put({"__dataloader_error__":
+                            traceback.format_exc()})
+                except Exception:
+                    pass
+            finally:
+                ch.close()
+
+        procs = [ctx.Process(target=worker_main, args=(w, channels[w].name),
+                             daemon=True)
+                 for w in range(W)]
+        for p in procs:
+            p.start()
+        try:
+            for i in range(len(batches)):
+                batch = channels[i % W].get()
+                if isinstance(batch, dict) and "__dataloader_error__" in batch:
+                    raise RuntimeError(
+                        "DataLoader worker failed:\n"
+                        + batch["__dataloader_error__"])
+                yield _tree_to_tensor(batch)
+        finally:
+            for ch in channels:
+                ch.destroy()
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
 
     def _iter_threaded(self):
         """Prefetching pipeline: worker threads collate; a bounded queue

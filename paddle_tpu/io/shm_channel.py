@@ -1,0 +1,143 @@
+"""Shared-memory batch channel for multiprocess DataLoader workers.
+
+Python face of csrc/shm_ring.cc (reference counterpart: the shared-memory
+tensor transfer between DataLoader worker processes and the trainer,
+python/paddle/io/dataloader/flat.py + multiprocess_utils.py): numpy batches
+are flattened to (header-pickle, raw-bytes) and pushed through a
+single-producer single-consumer shm ring — the array payload crosses the
+process boundary through one mmap'd copy, not a pipe.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import pickle
+import uuid
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import native
+
+
+def _flatten(obj: Any, arrays: List[np.ndarray]):
+    """Replace ndarrays with placeholders; collect raw arrays."""
+    if isinstance(obj, np.ndarray):
+        arrays.append(np.ascontiguousarray(obj))
+        a = arrays[-1]
+        return ("__nd__", a.shape, a.dtype.str, a.nbytes)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_flatten(x, arrays) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _flatten(v, arrays) for k, v in obj.items()}
+    return obj
+
+
+def _unflatten(obj: Any, bufs: List[np.ndarray]):
+    if isinstance(obj, tuple) and len(obj) == 4 and obj[0] == "__nd__":
+        _, shape, dtype, _ = obj
+        return bufs.pop(0).view(dtype).reshape(shape)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unflatten(x, bufs) for x in obj)
+    if isinstance(obj, dict):
+        return {k: _unflatten(v, bufs) for k, v in obj.items()}
+    return obj
+
+
+class ShmChannel:
+    """SPSC channel over the native shm ring. The creating (consumer)
+    process calls ``create``; the worker attaches by name and pushes."""
+
+    def __init__(self, handle, name: str, lib):
+        self._h = handle
+        self.name = name
+        self._lib = lib
+
+    @staticmethod
+    def available() -> bool:
+        return native.available()
+
+    @classmethod
+    def create(cls, capacity: int = 64 << 20) -> "ShmChannel":
+        lib = native.lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        name = f"/pt_ring_{os.getpid()}_{uuid.uuid4().hex[:8]}"
+        h = lib.pt_ring_create(name.encode(), capacity)
+        if not h:
+            raise OSError(f"shm ring create failed ({name})")
+        return cls(h, name, lib)
+
+    @classmethod
+    def attach(cls, name: str) -> "ShmChannel":
+        lib = native.lib()
+        if lib is None:
+            raise RuntimeError("native runtime unavailable")
+        h = lib.pt_ring_attach(name.encode())
+        if not h:
+            raise OSError(f"shm ring attach failed ({name})")
+        return cls(h, name, lib)
+
+    # -- producer -----------------------------------------------------------
+    def put(self, obj: Any, timeout_ms: int = -1) -> None:
+        arrays: List[np.ndarray] = []
+        tree = _flatten(obj, arrays)
+        header = pickle.dumps((tree, len(arrays)))
+        self._push(header, timeout_ms)
+        for a in arrays:
+            self._push_raw(a, timeout_ms)
+
+    def _push(self, data: bytes, timeout_ms: int) -> None:
+        buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
+        self._check(self._lib.pt_ring_push(self._h, buf, len(data),
+                                           timeout_ms))
+
+    def _push_raw(self, a: np.ndarray, timeout_ms: int) -> None:
+        ptr = a.ctypes.data_as(ctypes.c_void_p)
+        self._check(self._lib.pt_ring_push(self._h, ptr, a.nbytes,
+                                           timeout_ms))
+
+    # -- consumer -----------------------------------------------------------
+    def get(self, timeout_ms: int = -1) -> Any:
+        header = self._pop(timeout_ms)
+        tree, n_arrays = pickle.loads(bytes(header))
+        bufs = [self._pop(timeout_ms) for _ in range(n_arrays)]
+        return _unflatten(tree, bufs)
+
+    def _pop(self, timeout_ms: int) -> np.ndarray:
+        # wait for a message, then size the buffer exactly
+        while True:
+            sz = self._lib.pt_ring_next_size(self._h)
+            if sz >= 0:
+                break
+            if sz == -3:
+                raise EOFError("shm ring closed")
+            if timeout_ms == 0:
+                raise TimeoutError
+            import time
+            time.sleep(0.0002)
+        out = np.empty(sz, np.uint8)
+        got = self._lib.pt_ring_pop(
+            self._h, out.ctypes.data_as(ctypes.c_void_p), sz, timeout_ms)
+        if got == -3:
+            raise EOFError("shm ring closed")
+        if got < 0:
+            raise TimeoutError("shm ring pop timed out")
+        return out
+
+    def _check(self, rc: int) -> None:
+        if rc == -1:
+            raise ValueError("message larger than ring capacity")
+        if rc == -2:
+            raise TimeoutError("shm ring push timed out")
+        if rc == -3:
+            raise EOFError("shm ring closed")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.pt_ring_close(self._h)
+
+    def destroy(self) -> None:
+        if self._h:
+            self._lib.pt_ring_destroy(self._h)
+            self._h = None
