@@ -9,5 +9,6 @@ to bf16 with fp32 master weights in the optimizer.
 from .auto_cast import (auto_cast, amp_guard, amp_state, decorate,
                         white_list as amp_white_list, AMPState)
 from .grad_scaler import GradScaler, AmpScaler
+from . import debugging
 
 __all__ = ["auto_cast", "decorate", "GradScaler", "AmpScaler"]

@@ -1,0 +1,76 @@
+"""AMP debugging utilities.
+
+Reference: python/paddle/amp/debugging.py — check_numerics, the
+TensorChecker (enable/disable hooks over op outputs via
+FLAGS_check_nan_inf), operator stats collection, and accuracy-compare
+helpers. Here the checks ride the eager op dispatch's nan/inf hook
+(ops/registry.py, gated by the same flag name) and jnp for the math.
+"""
+from __future__ import annotations
+
+import contextlib
+import enum
+from typing import Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.flags import set_flags, get_flags
+from ..core.tensor import Tensor
+
+
+class DebugMode(enum.Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 4
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable: bool, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None, checked_op_list=None,
+                 skipped_op_list=None, debug_step=None, stack_height_limit=1):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+
+
+def enable_tensor_checker(checker_config: TensorCheckerConfig):
+    if checker_config.enable:
+        set_flags({"FLAGS_check_nan_inf": True})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+@contextlib.contextmanager
+def debug_guard(config: TensorCheckerConfig):
+    prev = get_flags(["FLAGS_check_nan_inf"])["FLAGS_check_nan_inf"]
+    enable_tensor_checker(config)
+    try:
+        yield
+    finally:
+        set_flags({"FLAGS_check_nan_inf": prev})
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Count (num_nan, num_inf, num_zero) and abort on non-finite when the
+    mode says so (reference check_numerics semantics)."""
+    data = tensor.data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    num_nan = int(jnp.isnan(data).sum())
+    num_inf = int(jnp.isinf(data).sum())
+    num_zero = int((data == 0).sum())
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT and (num_nan or num_inf):
+        raise FloatingPointError(
+            f"[check_numerics] op={op_type or '?'} var={var_name or '?'}: "
+            f"{num_nan} NaN, {num_inf} Inf")
+    return (jnp.asarray(num_nan), jnp.asarray(num_inf), jnp.asarray(num_zero))
+
+
+def compare_accuracy(dump_path, another_dump_path, output_filename,
+                     loss_scale=1, dump_all_tensors=False):
+    raise NotImplementedError(
+        "fp16 vs fp32 dump comparison: dump tensors with paddle_tpu.save "
+        "and diff with numpy; the reference's workflow file format is not "
+        "replicated")

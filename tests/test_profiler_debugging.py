@@ -1,0 +1,65 @@
+"""Profiler (RecordEvent spans, scheduler windows, step timing) and AMP
+debugging (tensor checker over the eager nan hook).
+
+Mirrors the reference's test/legacy_test/test_profiler.py and
+test_nan_inf / amp debugging tests.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import profiler as prof
+from paddle_tpu.amp import debugging as dbg
+
+
+def test_make_scheduler_windows():
+    fn = prof.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [fn(i) for i in range(5)]
+    S = prof.ProfilerState
+    assert states[0] == S.CLOSED
+    assert states[1] == S.READY
+    assert states[2] == S.RECORD
+    assert states[3] == S.RECORD_AND_RETURN
+    assert states[4] == S.CLOSED  # repeat exhausted
+
+
+def test_record_event_and_host_stats():
+    prof.reset_host_statistics()
+    for _ in range(3):
+        with prof.RecordEvent("my_span"):
+            x = pt.ones([64, 64])
+            (x @ x).numpy()
+    st = prof.host_statistics()
+    assert st["my_span"]["calls"] == 3
+    assert st["my_span"]["total_ms"] > 0
+
+
+def test_profiler_timer_only_summary(capsys):
+    p = prof.Profiler(timer_only=True)
+    p.start()
+    for _ in range(4):
+        pt.ones([8]).numpy()
+        p.step()
+    p.stop()
+    out = p.summary()
+    assert "steps: 4" in out
+
+
+def test_check_numerics_counts_and_abort():
+    t = pt.to_tensor(np.array([1.0, np.nan, np.inf, 0.0], np.float32))
+    nan, inf, zero = dbg.check_numerics(t, debug_mode=dbg.DebugMode.CHECK_ALL)
+    assert int(nan) == 1 and int(inf) == 1 and int(zero) == 1
+    with pytest.raises(FloatingPointError):
+        dbg.check_numerics(t, op_type="mul", var_name="x")
+
+
+def test_tensor_checker_catches_nan_in_eager_op():
+    cfg = dbg.TensorCheckerConfig(enable=True)
+    with dbg.debug_guard(cfg):
+        a = pt.to_tensor(np.array([1.0, 0.0], np.float32))
+        b = pt.to_tensor(np.array([0.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            _ = a / b  # 1/0 = inf
+    # disabled again outside the guard
+    c = (a / b).numpy()
+    assert np.isinf(c).any()
