@@ -1,0 +1,136 @@
+"""paddle_tpu.inference — deployment predictor.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.cc + the
+paddle.inference python API (Config / create_predictor / Predictor with
+named IO handles). The reference runs IR passes + optional TensorRT; here
+the saved artifact is already one optimized XLA module (StableHLO from
+jit.save), so "analysis" = XLA compilation at load time. No separate
+engine offload exists or is needed — XLA is the engine.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from .. import jit as _jit
+
+
+class PlaceType:
+    CPU = "cpu"
+    GPU = "gpu"
+    TPU = "tpu"
+    XPU = "xpu"
+
+
+class Config:
+    """Reference AnalysisConfig surface (the knobs that matter here)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # jit.save writes one "<prefix>.pdmodel" blob; accept either the
+        # prefix or the full file name
+        self.model_path = prog_file
+        self._device = "tpu"
+        self._memory_pool_mb = 0
+        self._enabled_passes: List[str] = []
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self.model_path = prog_file
+
+    def model_dir(self) -> Optional[str]:
+        return self.model_path
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0):
+        self._device = "tpu"  # device placement is jax's; accept + map
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, x: bool = True):
+        pass  # XLA buffer assignment already does liveness-based reuse
+
+    def switch_ir_optim(self, x: bool = True):
+        pass  # XLA passes always run
+
+    def enable_tensorrt_engine(self, *a, **kw):
+        raise NotImplementedError(
+            "no TensorRT on TPU; the XLA module is already the fused engine")
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        os.environ.setdefault("XLA_FLAGS", "")
+
+
+class Predictor:
+    """Named-handle predictor over a jit.save'd StableHLO artifact."""
+
+    def __init__(self, config: Config):
+        if config.model_path is None:
+            raise ValueError("Config.set_model(path) first")
+        path = config.model_path
+        if path.endswith(".pdmodel"):
+            path = path[:-len(".pdmodel")]
+        self._loaded = _jit.load(path)
+        self._n_inputs = self._loaded.num_inputs
+        self._inputs: Dict[str, np.ndarray] = {}
+        self._outputs: List[np.ndarray] = []
+
+    def get_input_names(self) -> List[str]:
+        return [f"input_{i}" for i in range(self._n_inputs)]
+
+    def get_input_handle(self, name: str) -> "IOHandle":
+        return IOHandle(self._inputs, name)
+
+    def get_output_names(self) -> List[str]:
+        return [f"output_{i}" for i in range(len(self._outputs))]
+
+    def get_output_handle(self, name: str) -> "IOHandle":
+        idx = int(name.split("_")[-1])
+        return IOHandle({"v": self._outputs[idx]}, "v")
+
+    def run(self, inputs: Optional[Sequence[np.ndarray]] = None):
+        if inputs is None:
+            inputs = [self._inputs[n] for n in self.get_input_names()]
+        outs = self._loaded(*[jnp.asarray(a) for a in inputs])
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        self._outputs = [np.asarray(o.data if isinstance(o, Tensor) else o)
+                         for o in outs]
+        return self._outputs
+
+    # convenience eager API (paddle.inference's newer run signature)
+    def __call__(self, *args):
+        return self.run(list(args))
+
+
+class IOHandle:
+    """input/output tensor handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, store: Dict, key: str):
+        self._store = store
+        self._key = key
+
+    def copy_from_cpu(self, arr: np.ndarray):
+        self._store[self._key] = np.asarray(arr)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        return np.asarray(self._store[self._key])
+
+    def reshape(self, shape):
+        self._store[self._key] = self._store[self._key].reshape(shape)
+
+    def shape(self):
+        return list(np.asarray(self._store[self._key]).shape)
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__

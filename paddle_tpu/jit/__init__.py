@@ -187,6 +187,7 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
         blob = {
             "stablehlo": exported.serialize(),
             "params": [np.asarray(p.data) for p in params],
+            "num_inputs": len(args_shape),
         }
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path + ".pdmodel", "wb") as f:
@@ -198,9 +199,10 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
 class TranslatedLayer:
     """Loaded inference program (reference: translated_layer.py)."""
 
-    def __init__(self, exported, params):
+    def __init__(self, exported, params, num_inputs=None):
         self._exported = exported
         self._params = params
+        self.num_inputs = num_inputs
 
     def __call__(self, *args):
         arrs = [a.data if isinstance(a, Tensor) else jnp.asarray(a)
@@ -221,4 +223,4 @@ def load(path: str, **configs) -> TranslatedLayer:
         blob = pickle.load(f)
     exported = jexport.deserialize(blob["stablehlo"])
     params = [jnp.asarray(p) for p in blob["params"]]
-    return TranslatedLayer(exported, params)
+    return TranslatedLayer(exported, params, blob.get("num_inputs"))

@@ -1,0 +1,19 @@
+"""paddle.onnx namespace (reference: python/paddle/onnx/export.py via
+paddle2onnx). In this framework the portable deployment artifact is
+StableHLO (jit.save), which ONNX runtimes do not consume; export() saves
+the StableHLO artifact and says so rather than silently produce nothing.
+"""
+from __future__ import annotations
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = 9,
+           **configs):
+    from .. import jit
+
+    jit.save(layer, path, input_spec=input_spec)
+    import warnings
+    warnings.warn(
+        "paddle_tpu has no paddle2onnx; exported StableHLO to "
+        f"{path}.pdmodel instead (load with paddle_tpu.inference or "
+        "jit.load)")
+    return path + ".pdmodel"

@@ -1,0 +1,49 @@
+"""Inference predictor over jit.save'd StableHLO artifacts.
+
+Mirrors the reference's inference API tests (test/cpp/inference/api,
+python predictor tests) minus TRT.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import inference as infer
+from paddle_tpu.jit import InputSpec
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    net = pt.models.LeNet()
+    net.eval()
+    path = str(tmp_path / "lenet")
+    pt.jit.save(net, path, input_spec=[InputSpec([1, 1, 28, 28], "float32")])
+    x = np.random.RandomState(0).randn(1, 1, 28, 28).astype(np.float32)
+    ref = np.asarray(net(pt.to_tensor(x)).numpy())
+    return path, x, ref
+
+
+def test_predictor_run_matches_eager(saved_model):
+    path, x, ref = saved_model
+    cfg = infer.Config(path)
+    pred = infer.create_predictor(cfg)
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_named_handles(saved_model):
+    path, x, ref = saved_model
+    pred = infer.create_predictor(infer.Config(path))
+    names = pred.get_input_names()
+    assert names == ["input_0"]
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_rejects_trt(saved_model):
+    path, _, _ = saved_model
+    cfg = infer.Config(path)
+    with pytest.raises(NotImplementedError):
+        cfg.enable_tensorrt_engine()
