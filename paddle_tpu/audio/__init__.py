@@ -1,0 +1,4 @@
+"""paddle.audio namespace (reference: python/paddle/audio/ — spectrogram
+features + window functions). STFT math rides paddle_tpu.signal."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401

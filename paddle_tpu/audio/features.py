@@ -1,0 +1,81 @@
+"""audio.features layers (reference: python/paddle/audio/features/layers.py
+— Spectrogram / MelSpectrogram / LogMelSpectrogram / MFCC)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+from ..signal import stft
+from . import functional as AF
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length)
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    window=self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        return Tensor(jnp.abs(spec.data) ** self.power)
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = AF.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max,
+                                             htk, norm, dtype)
+
+    def forward(self, x):
+        s = self.spectrogram(x)
+        return Tensor(jnp.einsum("mf,...ft->...mt", self.fbank.data, s.data))
+
+
+class LogMelSpectrogram(MelSpectrogram):
+    def __init__(self, *args, ref_value: float = 1.0, amin: float = 1e-10,
+                 top_db: Optional[float] = None, **kw):
+        super().__init__(*args, **kw)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = super().forward(x)
+        return AF.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, **mel_kw):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(sr=sr, **mel_kw)
+        self.n_mfcc = n_mfcc
+
+    def forward(self, x):
+        logmel = self.log_mel(x).data          # [..., n_mels, T]
+        n = logmel.shape[-2]
+        k = jnp.arange(self.n_mfcc)[:, None]
+        m = jnp.arange(n)[None, :]
+        dct = jnp.cos(jnp.pi * k * (2 * m + 1) / (2 * n)) * jnp.sqrt(2.0 / n)
+        dct = dct.at[0].multiply(1.0 / jnp.sqrt(2.0))
+        return Tensor(jnp.einsum("km,...mt->...kt", dct, logmel))

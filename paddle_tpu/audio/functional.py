@@ -1,0 +1,89 @@
+"""audio.functional (reference: python/paddle/audio/functional/ —
+window functions, mel scale conversions)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def get_window(window: str, win_length: int, fftbins: bool = True,
+               dtype="float32") -> Tensor:
+    n = win_length
+    sym = not fftbins
+    m = n - 1 if sym else n
+    k = jnp.arange(n)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * jnp.pi * k / m)
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * jnp.pi * k / m)
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * jnp.pi * k / m)
+             + 0.08 * jnp.cos(4 * jnp.pi * k / m))
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones((n,))
+    else:
+        raise ValueError(f"unknown window {window!r}")
+    return Tensor(w.astype(dtype))
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = jnp.asarray(freq, jnp.float32)
+    if htk:
+        return 2595.0 * jnp.log10(1.0 + f / 700.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    mels = (f - f_min) / f_sp
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(f / min_log_hz) / logstep, mels)
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = jnp.asarray(mel, jnp.float32)
+    if htk:
+        return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    f_min, f_sp = 0.0, 200.0 / 3
+    freqs = f_min + f_sp * m
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = np.log(6.4) / 27.0
+    return jnp.where(m >= min_log_mel,
+                     min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                     freqs)
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm="slaney",
+                         dtype="float32") -> Tensor:
+    f_max = f_max or sr / 2.0
+    n_freqs = n_fft // 2 + 1
+    fft_freqs = jnp.linspace(0, sr / 2.0, n_freqs)
+    mel_pts = jnp.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                           n_mels + 2)
+    hz_pts = mel_to_hz(mel_pts, htk)
+    lower = hz_pts[:-2][:, None]
+    center = hz_pts[1:-1][:, None]
+    upper = hz_pts[2:][:, None]
+    up = (fft_freqs[None, :] - lower) / jnp.maximum(center - lower, 1e-8)
+    down = (upper - fft_freqs[None, :]) / jnp.maximum(upper - center, 1e-8)
+    fb = jnp.maximum(0.0, jnp.minimum(up, down))
+    if norm == "slaney":
+        enorm = 2.0 / (hz_pts[2:] - hz_pts[:-2])
+        fb = fb * enorm[:, None]
+    return Tensor(fb.astype(dtype))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    s = spect.data if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(s, amin))
+    log_spec = log_spec - 10.0 * jnp.log10(jnp.maximum(ref_value, amin))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)

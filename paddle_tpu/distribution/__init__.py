@@ -1,0 +1,383 @@
+"""paddle.distribution namespace.
+
+Reference: python/paddle/distribution/ (20+ distributions with
+sample/rsample/log_prob/entropy/kl_divergence over a Distribution base,
+kl.py registration).
+
+TPU-native: math in jnp (traceable under jit), sampling via jax.random
+with an internal key threaded from the global generator (core/generator.py)
+so eager sampling stays reproducible under paddle_tpu.seed().
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core.generator import default_generator
+
+
+def _u(x):
+    if isinstance(x, Tensor):
+        return x.data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) else x
+
+
+def _key():
+    return default_generator().next_key()
+
+
+def _shape(sample_shape) -> tuple:
+    if sample_shape is None:
+        return ()
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_u(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other) -> Tensor:
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _u(loc)
+        self.scale = _u(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        eps = jax.random.normal(_key(), shp)
+        return Tensor(self.loc + self.scale * eps)
+
+    def log_prob(self, value):
+        v = _u(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(
+            0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale),
+            self.batch_shape))
+
+    def cdf(self, value):
+        v = _u(value)
+        return Tensor(0.5 * (1 + jax.scipy.special.erf(
+            (v - self.loc) / (self.scale * math.sqrt(2)))))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _u(low)
+        self.high = _u(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        u = jax.random.uniform(_key(), shp)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def log_prob(self, value):
+        v = _u(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.broadcast_to(jnp.log(self.high - self.low),
+                                       self.batch_shape))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if (probs is None) == (logits is None):
+            raise ValueError("pass exactly one of probs/logits")
+        if probs is not None:
+            self.probs = _u(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _u(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(
+            _key(), self.probs, shp).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _u(value)
+        return Tensor(v * jax.nn.log_sigmoid(self.logits)
+                      + (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = jax.nn.log_softmax(_u(logits), axis=-1)
+        else:
+            self.logits = jnp.log(_u(probs) /
+                                  jnp.sum(_u(probs), -1, keepdims=True))
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.categorical(_key(), self.logits,
+                                             shape=shp))
+
+    def log_prob(self, value):
+        v = _u(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self.logits, v[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        return Tensor(-jnp.sum(self.probs * self.logits, axis=-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _u(rate)
+        super().__init__(self.rate.shape)
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.exponential(_key(), shp) / self.rate)
+
+    def log_prob(self, value):
+        v = _u(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _u(concentration)
+        self.rate = _u(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.gamma(_key(), self.concentration, shp)
+                      / self.rate)
+
+    def log_prob(self, value):
+        v = _u(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                      + (1 - a) * jax.scipy.special.digamma(a))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _u(alpha)
+        self.beta = _u(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.beta(_key(), self.alpha, self.beta, shp))
+
+    def log_prob(self, value):
+        v = _u(value)
+        a, b = self.alpha, self.beta
+        lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _u(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(_key(), self.concentration, shp))
+
+    def log_prob(self, value):
+        v = _u(value)
+        a = self.concentration
+        lognorm = (jnp.sum(jax.scipy.special.gammaln(a), -1)
+                   - jax.scipy.special.gammaln(jnp.sum(a, -1)))
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1) - lognorm)
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _u(loc)
+        self.scale = _u(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.laplace(_key(), shp))
+
+    def log_prob(self, value):
+        v = _u(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _u(loc)
+        self.scale = _u(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def rsample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        return Tensor(self.loc + self.scale * jax.random.gumbel(_key(), shp))
+
+    def log_prob(self, value):
+        z = (_u(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self._normal = Normal(loc, scale)
+        super().__init__(self._normal.batch_shape)
+
+    def rsample(self, shape=()):
+        return Tensor(jnp.exp(_u(self._normal.rsample(shape))))
+
+    def log_prob(self, value):
+        v = _u(value)
+        return Tensor(_u(self._normal.log_prob(jnp.log(v))) - jnp.log(v))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _u(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self.batch_shape
+        logits = jnp.log(self.probs)
+        draws = jax.random.categorical(
+            _key(), logits, shape=(self.total_count,) + shp)
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = _u(value)
+        logfact = jax.scipy.special.gammaln(v + 1)
+        return Tensor(jax.scipy.special.gammaln(
+            jnp.asarray(self.total_count + 1.0))
+            - jnp.sum(logfact, -1)
+            + jnp.sum(v * jnp.log(self.probs), -1))
+
+
+# ---------------------------------------------------------------------------
+# KL divergence registry (reference: distribution/kl.py)
+# ---------------------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    def deco(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+    return deco
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    fn = _KL_REGISTRY.get((type(p), type(q)))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_cat_cat(p, q):
+    return Tensor(jnp.sum(p.probs * (p.logits - q.logits), -1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bern_bern(p, q):
+    a = p.probs * (jnp.log(p.probs) - jnp.log(q.probs))
+    b = (1 - p.probs) * (jnp.log1p(-p.probs) - jnp.log1p(-q.probs))
+    return Tensor(a + b)
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exp_exp(p, q):
+    r = q.rate / p.rate
+    return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)

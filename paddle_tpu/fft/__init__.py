@@ -1,0 +1,47 @@
+"""paddle.fft namespace (reference: python/paddle/fft.py — jnp.fft carries
+the math; XLA lowers FFTs natively on TPU)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _u(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _w(fn):
+    def wrapped(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        return Tensor(fn(_u(x), *args, **kwargs))
+    wrapped.__name__ = fn.__name__
+    return wrapped
+
+
+fft = _w(jnp.fft.fft)
+ifft = _w(jnp.fft.ifft)
+fft2 = _w(jnp.fft.fft2)
+ifft2 = _w(jnp.fft.ifft2)
+fftn = _w(jnp.fft.fftn)
+ifftn = _w(jnp.fft.ifftn)
+rfft = _w(jnp.fft.rfft)
+irfft = _w(jnp.fft.irfft)
+rfft2 = _w(jnp.fft.rfft2)
+irfft2 = _w(jnp.fft.irfft2)
+rfftn = _w(jnp.fft.rfftn)
+irfftn = _w(jnp.fft.irfftn)
+hfft = _w(jnp.fft.hfft)
+ihfft = _w(jnp.fft.ihfft)
+fftshift = _w(jnp.fft.fftshift)
+ifftshift = _w(jnp.fft.ifftshift)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)

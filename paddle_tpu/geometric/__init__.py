@@ -1,0 +1,88 @@
+"""paddle.geometric namespace (reference: python/paddle/geometric/ —
+message passing send_u_recv/send_ue_recv, segment ops, sampling).
+
+TPU-native: segment reductions are jax.ops.segment_* (XLA scatter), the
+natural fit — no CSR kernels needed.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _u(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def segment_sum(data, segment_ids, name=None):
+    d, s = _u(data), _u(segment_ids).astype(jnp.int32)
+    n = int(s.max()) + 1 if s.size else 0
+    return Tensor(jax.ops.segment_sum(d, s, num_segments=n))
+
+
+def segment_mean(data, segment_ids, name=None):
+    d, s = _u(data), _u(segment_ids).astype(jnp.int32)
+    n = int(s.max()) + 1 if s.size else 0
+    tot = jax.ops.segment_sum(d, s, num_segments=n)
+    cnt = jax.ops.segment_sum(jnp.ones_like(d), s, num_segments=n)
+    return Tensor(tot / jnp.maximum(cnt, 1))
+
+
+def segment_max(data, segment_ids, name=None):
+    d, s = _u(data), _u(segment_ids).astype(jnp.int32)
+    n = int(s.max()) + 1 if s.size else 0
+    return Tensor(jax.ops.segment_max(d, s, num_segments=n))
+
+
+def segment_min(data, segment_ids, name=None):
+    d, s = _u(data), _u(segment_ids).astype(jnp.int32)
+    n = int(s.max()) + 1 if s.size else 0
+    return Tensor(jax.ops.segment_min(d, s, num_segments=n))
+
+
+_POOLS = {"sum": jax.ops.segment_sum, "mean": None,
+          "max": jax.ops.segment_max, "min": jax.ops.segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size: Optional[int] = None, name=None):
+    """Graph message passing: gather x[src] then segment-reduce onto dst
+    (geometric/message_passing/send_recv.py)."""
+    xd = _u(x)
+    src = _u(src_index).astype(jnp.int32)
+    dst = _u(dst_index).astype(jnp.int32)
+    n = int(out_size) if out_size is not None else xd.shape[0]
+    msgs = xd[src]
+    if reduce_op == "mean":
+        tot = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((msgs.shape[0],) + (1,) *
+                                           (msgs.ndim - 1)), dst,
+                                  num_segments=n)
+        return Tensor(tot / jnp.maximum(cnt, 1))
+    fn = _POOLS[reduce_op]
+    return Tensor(fn(msgs, dst, num_segments=n))
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size: Optional[int] = None,
+                 name=None):
+    """Node+edge messages: combine x[src] with edge features y, reduce."""
+    xd = _u(x)
+    yd = _u(y)
+    src = _u(src_index).astype(jnp.int32)
+    msgs = xd[src]
+    if message_op == "add":
+        msgs = msgs + yd
+    elif message_op == "mul":
+        msgs = msgs * yd
+    else:
+        raise ValueError(f"unknown message_op {message_op}")
+    return send_u_recv(Tensor(msgs),
+                       jnp.arange(msgs.shape[0]), dst_index,
+                       reduce_op=reduce_op,
+                       out_size=out_size if out_size is not None
+                       else xd.shape[0])

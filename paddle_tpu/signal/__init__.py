@@ -1,0 +1,104 @@
+"""paddle.signal namespace (reference: python/paddle/signal.py — stft/istft
++ frame/overlap_add)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def _u(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def frame(x, frame_length: int, hop_length: int, axis: int = -1, name=None):
+    """Slice overlapping frames along ``axis`` (signal.py frame)."""
+    a = _u(x)
+    if axis not in (-1, a.ndim - 1):
+        a = jnp.moveaxis(a, axis, -1)
+    n = a.shape[-1]
+    num = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :] +
+           hop_length * jnp.arange(num)[:, None])       # [num, frame_length]
+    out = a[..., idx]                                   # [..., num, L]
+    out = jnp.swapaxes(out, -1, -2)                     # [..., L, num]
+    if axis not in (-1, a.ndim - 1):
+        out = jnp.moveaxis(out, -1, axis)
+    return Tensor(out)
+
+
+def overlap_add(x, hop_length: int, axis: int = -1, name=None):
+    a = _u(x)  # [..., frame_length, num_frames]
+    L, num = a.shape[-2], a.shape[-1]
+    n = L + hop_length * (num - 1)
+    out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+    for f in range(num):  # static small loop, unrolled at trace time
+        out = out.at[..., f * hop_length:f * hop_length + L].add(a[..., f])
+    return Tensor(out)
+
+
+def stft(x, n_fft: int, hop_length: Optional[int] = None,
+         win_length: Optional[int] = None, window=None, center: bool = True,
+         pad_mode: str = "reflect", normalized: bool = False,
+         onesided: bool = True, name=None):
+    """Short-time Fourier transform over [B, T] or [T] (signal.py stft)."""
+    a = _u(x)
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,), a.dtype)
+    else:
+        win = _u(window).astype(a.dtype)
+    if win_length < n_fft:  # center-pad window to n_fft
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+    if center:
+        pad = n_fft // 2
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(pad, pad)],
+                    mode=pad_mode)
+    frames = frame(a, n_fft, hop_length).data       # [..., n_fft, num]
+    spec = jnp.fft.fft(frames * win[:, None], axis=-2)
+    if onesided:
+        spec = spec[..., : n_fft // 2 + 1, :]
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    return Tensor(spec)
+
+
+def istft(x, n_fft: int, hop_length: Optional[int] = None,
+          win_length: Optional[int] = None, window=None, center: bool = True,
+          normalized: bool = False, onesided: bool = True,
+          length: Optional[int] = None, return_complex: bool = False,
+          name=None):
+    a = _u(x)  # [..., freq, num_frames]
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        win = jnp.ones((win_length,))
+    else:
+        win = _u(window)
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        win = jnp.pad(win, (pad, n_fft - win_length - pad))
+    win = win.astype(jnp.float32)
+    if normalized:
+        a = a * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+    if onesided:
+        frames = jnp.fft.irfft(a, n=n_fft, axis=-2)
+    else:
+        frames = jnp.fft.ifft(a, axis=-2).real
+    frames = frames * win[:, None]
+    sig = overlap_add(frames, hop_length).data
+    # window envelope normalization
+    env = overlap_add(
+        jnp.broadcast_to((win * win)[:, None], frames.shape[-2:]),
+        hop_length).data
+    sig = sig / jnp.maximum(env, 1e-10)
+    if center:
+        sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    return Tensor(sig)

@@ -1,0 +1,170 @@
+"""paddle.sparse namespace.
+
+Reference: python/paddle/sparse/ (COO/CSR tensors + unary/binary/matmul/nn
+ops over paddle/phi/kernels/sparse/).
+
+TPU-native: backed by jax.experimental.sparse BCOO/BCSR — XLA lowers
+sparse ops to gather/scatter/segment-sum programs. The TPU MXU has no
+sparse units, so genuinely-sparse compute is only a win at high sparsity;
+to_dense() is always available to fall back onto the dense path.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor(Tensor):
+    """Tensor subclass carrying a BCOO; dense ops see .data densified
+    lazily only when an op needs it."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._sp = bcoo
+        super().__init__(jnp.zeros((), jnp.float32))  # placeholder
+        self._data = None  # densified on demand
+
+    @property
+    def data(self):
+        if self._data is None:
+            self._data = self._sp.todense()
+        return self._data
+
+    @data.setter
+    def data(self, v):
+        self._data = v
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    @property
+    def shape(self):
+        return tuple(self._sp.shape)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._sp.indices.T)
+
+    def values(self) -> Tensor:
+        return Tensor(self._sp.data)
+
+    def nnz(self) -> int:
+        return int(self._sp.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._sp.todense())
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._sp))
+
+
+class SparseCsrTensor(Tensor):
+    def __init__(self, bcsr):
+        self._sp = bcsr
+        super().__init__(jnp.zeros((), jnp.float32))
+        self._data = None
+
+    @property
+    def data(self):
+        if self._data is None:
+            self._data = self._sp.todense()
+        return self._data
+
+    @data.setter
+    def data(self, v):
+        self._data = v
+
+    def is_sparse(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    @property
+    def shape(self):
+        return tuple(self._sp.shape)
+
+    def crows(self) -> Tensor:
+        return Tensor(self._sp.indptr)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._sp.indices)
+
+    def values(self) -> Tensor:
+        return Tensor(self._sp.data)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._sp.todense())
+
+
+def sparse_coo_tensor(indices, values, shape: Optional[Sequence[int]] = None,
+                      dtype=None, place=None, stop_gradient=True):
+    """indices [ndim, nnz] + values [nnz] -> COO (python/paddle/sparse/
+    creation.py)."""
+    idx = jnp.asarray(indices.data if isinstance(indices, Tensor)
+                      else indices, jnp.int32).T      # BCOO wants [nnz, ndim]
+    vals = jnp.asarray(values.data if isinstance(values, Tensor) else values)
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=tuple(shape)))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    crows = jnp.asarray(crows.data if isinstance(crows, Tensor) else crows,
+                        jnp.int32)
+    cols = jnp.asarray(cols.data if isinstance(cols, Tensor) else cols,
+                       jnp.int32)
+    vals = jnp.asarray(values.data if isinstance(values, Tensor) else values)
+    return SparseCsrTensor(
+        jsparse.BCSR((vals, cols, crows), shape=tuple(shape)))
+
+
+def _sp(x):
+    return x._sp if isinstance(x, (SparseCooTensor, SparseCsrTensor)) else x
+
+
+def matmul(x, y, name=None) -> Tensor:
+    """sparse @ dense (phi sparse matmul kernels)."""
+    a = _sp(x)
+    b = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(a @ b)
+
+
+def add(x, y, name=None):
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        merged = jsparse.BCOO(
+            (jnp.concatenate([x._sp.data, y._sp.data]),
+             jnp.concatenate([x._sp.indices, y._sp.indices])),
+            shape=x._sp.shape).sum_duplicates(nse=x._sp.nse + y._sp.nse)
+        return SparseCooTensor(merged)
+    return Tensor(x.to_dense().data + y.to_dense().data)
+
+
+def relu(x, name=None) -> SparseCooTensor:
+    sp = _sp(x)
+    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(sp.data), sp.indices),
+                                        shape=sp.shape))
+
+
+def sqrt(x, name=None) -> SparseCooTensor:
+    sp = _sp(x)
+    return SparseCooTensor(jsparse.BCOO((jnp.sqrt(sp.data), sp.indices),
+                                        shape=sp.shape))
+
+
+def sin(x, name=None) -> SparseCooTensor:
+    sp = _sp(x)
+    return SparseCooTensor(jsparse.BCOO((jnp.sin(sp.data), sp.indices),
+                                        shape=sp.shape))
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)

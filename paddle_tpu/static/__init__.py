@@ -1,0 +1,40 @@
+"""paddle.static namespace.
+
+Reference: python/paddle/static/ — the legacy static-graph API. This
+framework has no separate static graph: program capture is jax tracing
+(paddle_tpu.jit.to_static compiles to one XLA module). What is kept:
+InputSpec (shared with jit) and nn re-exports; Program/Executor raise
+with guidance instead of silently half-working.
+"""
+from ..jit import InputSpec  # noqa: F401
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Program:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "no legacy static graphs in paddle_tpu; use jit.to_static "
+            "(whole-program XLA capture) or the functional models")
+
+
+class Executor:
+    def __init__(self, *a, **kw):
+        raise NotImplementedError(
+            "no StandaloneExecutor; jitted functions execute as one XLA "
+            "module — see paddle_tpu.jit")
+
+
+def default_main_program():
+    raise NotImplementedError("no legacy static graphs; see paddle_tpu.jit")
+
+
+def default_startup_program():
+    raise NotImplementedError("no legacy static graphs; see paddle_tpu.jit")
+
+
+def name_scope(prefix=None):
+    import contextlib
+    return contextlib.nullcontext()

@@ -1,0 +1,88 @@
+"""paddle.text namespace (reference: python/paddle/text/ — viterbi decode
++ dataset loaders). Datasets need downloads (zero egress here), so they
+raise with guidance; the ops are live."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF viterbi decoding (reference text/viterbi_decode.py) via
+    lax.scan over time — [B, T, N] potentials, [N, N] transitions."""
+    emis = potentials.data if isinstance(potentials, Tensor) \
+        else jnp.asarray(potentials)
+    trans = transition_params.data if isinstance(transition_params, Tensor) \
+        else jnp.asarray(transition_params)
+    B, T, N = emis.shape
+
+    def step(carry, e_t):
+        score = carry                                     # [B, N]
+        cand = score[:, :, None] + trans[None, :, :]      # [B, from, to]
+        best = jnp.max(cand, axis=1) + e_t                # [B, N]
+        back = jnp.argmax(cand, axis=1)                   # [B, N]
+        return best, back
+
+    init = emis[:, 0]
+    final, backs = jax.lax.scan(step, init,
+                                jnp.moveaxis(emis[:, 1:], 1, 0))
+    scores = jnp.max(final, axis=-1)
+    last = jnp.argmax(final, axis=-1)                     # [B]
+
+    def backtrack(carry, back_t):
+        tag = carry
+        prev = jnp.take_along_axis(back_t, tag[:, None], axis=1)[:, 0]
+        return prev, tag
+
+    _, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+    paths = jnp.concatenate([jnp.moveaxis(path_rev, 0, 1),
+                             last[:, None]], axis=1)      # [B, T]
+    return Tensor(scores), Tensor(paths)
+
+
+class ViterbiDecoder:
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        self.transitions = transitions
+
+    def __call__(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths)
+
+
+def _no_dataset(name):
+    raise FileNotFoundError(
+        f"paddle.text dataset {name!r} requires downloads; this environment "
+        "has no network access. Provide local files via paddle_tpu.io.Dataset.")
+
+
+class Imdb:
+    def __init__(self, *a, **kw):
+        _no_dataset("Imdb")
+
+
+class Conll05st:
+    def __init__(self, *a, **kw):
+        _no_dataset("Conll05st")
+
+
+class Movielens:
+    def __init__(self, *a, **kw):
+        _no_dataset("Movielens")
+
+
+class UCIHousing:
+    def __init__(self, *a, **kw):
+        _no_dataset("UCIHousing")
+
+
+class WMT14:
+    def __init__(self, *a, **kw):
+        _no_dataset("WMT14")
+
+
+class WMT16:
+    def __init__(self, *a, **kw):
+        _no_dataset("WMT16")
