@@ -22,7 +22,10 @@ class Generator:
     def manual_seed(self, seed: int):
         with getattr(self, "_lock", threading.Lock()):
             self._seed = int(seed)
-            self._key = jax.random.PRNGKey(int(seed))
+            # lazy: PRNGKey materialises a device array, which would
+            # initialise the JAX backend at import time (the default
+            # generator is created when paddle_tpu is imported)
+            self._key = None
         return self
 
     def seed(self):
@@ -30,11 +33,16 @@ class Generator:
 
     def next_key(self):
         with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
             self._key, sub = jax.random.split(self._key)
             return sub
 
     def get_state(self):
-        return np.asarray(self._key)
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
+            return np.asarray(self._key)
 
     def set_state(self, state):
         import jax.numpy as jnp

@@ -5,28 +5,16 @@ custom_cpu plugin masquerading as a device, test/custom_runtime/): here the
 fake devices are XLA host-platform devices, so multi-chip sharding code paths
 (pjit/shard_map/collectives) execute for real without TPUs.
 """
-import os
-
 # force CPU: the session env pins JAX_PLATFORMS to the TPU tunnel, which
 # must not be grabbed by the test suite (single-chip lock + slow compiles).
-# NOTE: the sandbox's sitecustomize pre-imports jax, so env vars are read
-# too late — the platform must be set via jax.config before the (lazy)
-# backend initialisation; XLA_FLAGS is still read at client creation.
-import re
+from paddle_tpu.testing import force_host_cpu_devices
 
-xla_flags = os.environ.get("XLA_FLAGS", "")
-xla_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
-                   xla_flags)  # the suite needs exactly 8 virtual devices
-os.environ["XLA_FLAGS"] = (
-    xla_flags + " --xla_force_host_platform_device_count=8").strip()
+force_host_cpu_devices(8)
 
 import numpy as np
 import pytest
 
 import jax
-
-jax.config.update("jax_platforms", "cpu")
-assert jax.default_backend() == "cpu" and len(jax.devices()) == 8
 
 # numeric tests compare against float64 numpy; use full-precision dots
 # (production/bench keeps JAX's default TPU-friendly precision)
