@@ -28,6 +28,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
+from jax.ad_checkpoint import checkpoint_name
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..parallel.pipeline_spmd import pipeline_spmd, microbatch
@@ -49,8 +50,10 @@ class LlamaConfig:
     pp_stages: int = 1
     num_microbatches: int = 1
     remat: bool = True
-    # kernels
-    use_flash_attention: bool = True
+    # kernels: True/"auto" (pallas when shapes allow), "pallas" (strict:
+    # error instead of silently falling back to dense — the bench runs
+    # this), False/"dense"
+    use_flash_attention: Any = True
     # context parallelism: "none" | "ring" | "ulysses" — shards the
     # sequence dim over the mesh cp axis (parallel/context_parallel.py)
     context_parallel: str = "none"
@@ -200,8 +203,13 @@ def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
                                        impl=cfg.context_parallel)
     else:
         from ..ops.pallas.flash_attention import flash_attention as _fa
-        o = _fa(q, k, v, causal=True,
-                impl="auto" if cfg.use_flash_attention else "dense")
+        fa = cfg.use_flash_attention
+        impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
+        o = _fa(q, k, v, causal=True, impl=impl)
+    # tag for remat policies: lets a save_only_these_names policy keep the
+    # kernel output so backward recompute skips the flash forward (the
+    # default bench path uses plain per-layer remat, measured faster)
+    o = checkpoint_name(o, "attn_out")
     h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
     if sp_spec is not None:
         # sequence-parallel residual stream: reduce-scatter the row-parallel
@@ -219,6 +227,9 @@ def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False,
                  mesh=None):
     fn = partial(decoder_layer, cfg=cfg, sp_spec=sp_spec, mesh=mesh)
     if remat:
+        # measured on-chip: plain full per-layer remat beats
+        # save_only_these_names("attn_out") by ~2% step time at bench
+        # shapes (the saved flash recompute is outweighed by HBM pressure)
         fn = jax.checkpoint(fn)
 
     def body(carry, lp):
@@ -274,10 +285,13 @@ def forward_pipelined(params, tokens, cfg: LlamaConfig, mesh: Mesh):
     def stage_fn(sp, x):
         inner_sp = sp_spec.spec if sp_spec is not None else None
         inner = NamedSharding(mesh, P(*inner_sp[1:])) if sp_spec is not None else None
-        return _scan_layers(sp, x, cfg, inner, remat=False)
+        # per-layer remat inside the stage (same recompute FLOPs as
+        # checkpointing the whole stage, but backward peak memory is one
+        # layer's internals, not one stage's)
+        return _scan_layers(sp, x, cfg, inner, remat=cfg.remat)
 
     h = pipeline_spmd(stage_fn, stage_params, h,
-                      num_stages=cfg.pp_stages, remat=cfg.remat)
+                      num_stages=cfg.pp_stages, remat=False)
     h = h.reshape((-1,) + h.shape[2:])                     # [B, T, D]
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h @ params["lm_head"]
@@ -288,18 +302,19 @@ def forward_pipelined(params, tokens, cfg: LlamaConfig, mesh: Mesh):
 # ---------------------------------------------------------------------------
 
 def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
-    """Next-token cross entropy. Logits stay vocab-sharded (tp) — the
-    softmax over a sharded axis is GSPMD's ParallelCrossEntropy
-    (mp_ops.py _c_softmax_with_cross_entropy equivalent)."""
+    """Next-token cross entropy via the fused op (ops/fused/cross_entropy):
+    logits stay in model dtype and vocab-sharded (tp) end to end — no f32
+    [B, T, V] log-softmax is materialised, and under GSPMD the reductions
+    lower to the reference's _c_softmax_with_cross_entropy collective
+    pattern (mp_ops.py:414), never a logits all-gather
+    (tests/test_fused_ce.py checks the HLO)."""
+    from ..ops.fused import fused_softmax_cross_entropy
     tokens, labels = batch["tokens"], batch["labels"]
     if mesh is not None and cfg.pp_stages > 1:
         logits = forward_pipelined(params, tokens, cfg, mesh)
     else:
         logits = forward(params, tokens, cfg, mesh)
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    nll = -jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    return nll.mean()
+    return fused_softmax_cross_entropy(logits, labels).mean()
 
 
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):

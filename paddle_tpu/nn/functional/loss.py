@@ -26,20 +26,35 @@ def cross_entropy(input, label, weight=None, ignore_index=-100,
         if soft_label:
             label = jnp.moveaxis(label, axis, -1)
     n_classes = logits.shape[-1]
-    logp = jax.nn.log_softmax(logits, axis=-1) if use_softmax else jnp.log(
-        jnp.maximum(logits, 1e-30))
     if soft_label:
+        logp = (jax.nn.log_softmax(logits, axis=-1) if use_softmax
+                else jnp.log(jnp.maximum(logits, 1e-30)))
         tgt = label
         if label_smoothing:
             tgt = tgt * (1 - label_smoothing) + label_smoothing / n_classes
         loss = -jnp.sum(tgt * logp, axis=-1)
         return _reduce(loss, reduction)
     lbl = label
-    if lbl.ndim == logp.ndim:
+    if lbl.ndim == logits.ndim:
         lbl = jnp.squeeze(lbl, axis=-1)
     lbl = lbl.astype(jnp.int32)
     valid = lbl != ignore_index
     safe = jnp.where(valid, lbl, 0)
+    if use_softmax and weight is None and not label_smoothing:
+        # fused path: no [..., V] log-softmax materialised, sharding-safe
+        # (ops/fused/cross_entropy — the _c_softmax_with_cross_entropy
+        # equivalent, mp_ops.py:414); cast back so the API keeps the
+        # paddle-parity dtype contract (loss dtype == logits dtype)
+        from ...ops.fused import fused_softmax_cross_entropy
+        loss = fused_softmax_cross_entropy(
+            logits, lbl, ignore_index=ignore_index).astype(logits.dtype)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(jnp.float32)), 1.0)
+            return (jnp.sum(loss.astype(jnp.float32)) / denom).astype(
+                logits.dtype)
+        return _reduce(loss, reduction)
+    logp = (jax.nn.log_softmax(logits, axis=-1) if use_softmax
+            else jnp.log(jnp.maximum(logits, 1e-30)))
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
     if label_smoothing:
         smooth = jnp.mean(logp, axis=-1)

@@ -1,0 +1,7 @@
+"""Fused ops: TPU-first counterparts of the reference's fused kernel zoo
+(paddle/phi/kernels/fusion/). Each op here is either a Pallas kernel or a
+custom-vjp composition shaped so XLA keeps it fused and sharded."""
+from .cross_entropy import (
+    fused_softmax_cross_entropy,
+    vocab_parallel_cross_entropy,
+)
