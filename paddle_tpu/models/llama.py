@@ -49,6 +49,14 @@ class LlamaConfig:
     # parallelism
     pp_stages: int = 1
     num_microbatches: int = 1
+    # "gpipe": autodiff through the SPMD pipeline (pipeline_spmd) — all
+    # forwards then all backwards, O(M) live microbatch activations.
+    # "1f1b": explicit fused fwd+bwd schedule (pipeline_1f1b) — O(S)
+    # live activations, matching pipeline_parallel.py:565.
+    pp_schedule: str = "gpipe"
+    # interleaved VPP: chunks per device under the 1f1b schedule
+    # (pipeline_parallel.py:1372 round-robin model partition)
+    vpp_chunks: int = 1
     remat: bool = True
     # kernels: True/"auto" (pallas when shapes allow), "pallas" (strict:
     # error instead of silently falling back to dense — the bench runs
@@ -317,6 +325,51 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
     return fused_softmax_cross_entropy(logits, labels).mean()
 
 
+def grads_1f1b(params, batch, cfg: LlamaConfig, mesh: Mesh):
+    """(loss, grads) via the explicit 1F1B / interleaved-VPP schedule
+    (parallel/pipeline_1f1b.py). Embedding forward+pullback bracket the
+    pipeline; the loss head (final norm + lm_head + fused CE) runs
+    per-microbatch as each one exits the last stage."""
+    from ..ops.fused import fused_softmax_cross_entropy
+    from ..parallel.pipeline_1f1b import (pipeline_train_1f1b,
+                                          split_chunks_round_robin)
+    S, V, M = cfg.pp_stages, cfg.vpp_chunks, cfg.num_microbatches
+    tokens, labels = batch["tokens"], batch["labels"]
+    tp_on = mesh is not None and mesh.shape.get("tp", 1) > 1
+    inner_sp = (NamedSharding(mesh, P("dp", "tp", None)) if tp_on else None)
+    mb_spec = P("dp", "tp" if tp_on else None, None)
+
+    def stage_fn(chunk_params, xm):
+        return _scan_layers(chunk_params, xm, cfg, inner_sp,
+                            remat=cfg.remat)
+
+    def head_fn(hp, y, y_labels):
+        h = rms_norm(y, hp["final_norm"], cfg.rms_norm_eps)
+        logits = h @ hp["lm_head"]
+        return fused_softmax_cross_entropy(logits, y_labels).mean()
+
+    def embed_fwd(emb):
+        h = emb.astype(cfg.dtype)[tokens]
+        return microbatch(h, M)
+
+    x_mb, embed_pull = jax.vjp(embed_fwd, params["embed"])
+    labels_mb = microbatch(labels, M)
+    chunks = split_chunks_round_robin(
+        params["layers"], cfg.num_hidden_layers, S, V)
+    head_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+    loss, gchunks, ghead, dx = pipeline_train_1f1b(
+        stage_fn, head_fn, chunks, head_params, x_mb, labels_mb,
+        num_stages=S, virtual_chunks=V, mesh=mesh, mb_spec=mb_spec)
+    glayers = jax.tree_util.tree_map(
+        lambda g, p: g.reshape(p.shape), gchunks, params["layers"])
+    (dembed,) = embed_pull(dx)
+    grads = {"embed": dembed, "layers": glayers,
+             "final_norm": ghead["final_norm"],
+             "lm_head": ghead["lm_head"]}
+    return loss, grads
+
+
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
     """Build the jitted SPMD train step (fwd+bwd+adamw) over ``mesh``.
 
@@ -329,6 +382,11 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
     if optimizer is None:
         optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
 
+    use_1f1b = cfg.pp_stages > 1 and cfg.pp_schedule == "1f1b"
+    if cfg.pp_schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"pp_schedule must be 'gpipe' or '1f1b', "
+                         f"got {cfg.pp_schedule!r}")
+
     def init_fn(key):
         params = init_params(cfg, key)
         params = shard_params(params, cfg, mesh)
@@ -337,8 +395,11 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
 
     @partial(jax.jit, donate_argnums=(0,))
     def step_fn(state, batch):
-        loss, grads = jax.value_and_grad(loss_fn)(
-            state["params"], batch, cfg, mesh)
+        if use_1f1b:
+            loss, grads = grads_1f1b(state["params"], batch, cfg, mesh)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(
+                state["params"], batch, cfg, mesh)
         updates, opt = optimizer.update(grads, state["opt"], state["params"])
         params = optax.apply_updates(state["params"], updates)
         return {"params": params, "opt": opt,

@@ -1,0 +1,213 @@
+"""1F1B and interleaved (VPP) pipeline schedules, traced SPMD-style.
+
+TPU-native counterpart of the reference's schedule library
+(python/paddle/distributed/fleet/meta_parallel/pipeline_parallel.py:565
+``forward_backward_pipeline`` = 1F1B, :1372 interleaved VPP, and
+pipeline_zero_bubble.py): there, per-rank Python loops issue NCCL
+isend/irecv and hold explicit activation queues. Here the entire
+schedule is ONE traced ``lax.scan`` whose carried buffers have a
+``pp``-sharded stage axis, so the per-tick neighbour exchange lowers to
+an XLA ``collective_permute`` over the ICI ring.
+
+Why not ``jax.grad`` through the GPipe scan (parallel/pipeline_spmd.py)?
+Autodiff of a scan replays ALL forward iterations, then ALL backward
+iterations — the GPipe memory profile: every stage holds residuals for
+all M microbatches (O(M) activation memory). The defining property of
+1F1B is that a microbatch's backward starts as soon as its forward
+leaves the last stage, bounding each stage's live activations at O(S)
+regardless of M. That cannot be expressed *through* autodiff of the
+forward schedule; it must be written as an explicit fused
+forward+backward program. This module does that with per-stage
+``jax.vjp`` calls inside the scan body (stage recompute in backward =
+the reference's recompute pass; per-layer remat inside ``stage_fn``
+still applies and bounds the recompute's own peak).
+
+Schedule layout (S pipeline slots, M microbatches, tick t = scan step):
+  fwd   : slot s computes microbatch m = t - s
+  head  : loss head runs on microbatch m = t - (S-1) as it exits
+  bwd   : slot s back-props microbatch m = t - (2S-1) + s
+  total : M + 2S - 1 ticks; each tick every slot does one fwd AND one
+          bwd (on different microbatches) — the steady state of 1F1B.
+Stage inputs live in a circular buffer of depth 2S (the lifetime of a
+saved input is 2(S-s)-1 ticks), which is the O(S)-not-O(M) bound
+(tests/test_pipeline_1f1b.py compares compiled peak memory vs GPipe).
+
+Interleaved VPP (``virtual_chunks=V > 1``): the layer stack is split
+into V*S chunks and chunk v*S+s is placed on device s (round-robin,
+exactly the reference's VPP partitioning) by laying the slot axis out
+as ``[V, S]`` with only the second dim pp-sharded. The ring wraps: a
+microbatch leaving chunk (v, S-1) re-enters chunk (v+1, 0). Honest
+note on cost: in a lockstep traced program every device computes its V
+chunks every tick, so VPP here does NOT shrink the fill bubble the way
+the reference's per-rank dispatch does (it cannot skip idle chunks);
+what it preserves is the reference's model partitioning (parameter
+round-robin for checkpoint/layout parity) and the 1F1B memory bound.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def _tree_zeros_f32(t):
+    return jax.tree_util.tree_map(
+        lambda x: jnp.zeros(x.shape, jnp.float32), t)
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(
+        lambda x, y: x + y.astype(x.dtype), a, b)
+
+
+def _tree_scale_cast(t, s, like):
+    return jax.tree_util.tree_map(
+        lambda x, l: (x * s).astype(l.dtype), t, like)
+
+
+def pipeline_train_1f1b(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    head_fn: Callable[[Any, jax.Array, Any], jax.Array],
+    stage_params: Any,
+    head_params: Any,
+    x: jax.Array,
+    aux: Any,
+    *,
+    num_stages: int,
+    virtual_chunks: int = 1,
+    mesh=None,
+    mb_spec: Optional[P] = None,
+):
+    """One fused forward+backward pass under the 1F1B schedule.
+
+    Args:
+      stage_fn: ``(chunk_params, x_mb) -> y_mb`` for one chunk (= one
+        stage when ``virtual_chunks == 1``).
+      head_fn: ``(head_params, y_mb, aux_mb) -> scalar`` per-microbatch
+        loss (mean over the microbatch's tokens).
+      stage_params: pytree with leading dim ``num_stages*virtual_chunks``
+        ordered chunk-major ``v*S + s`` (shard over pp; see
+        ``split_chunks_round_robin`` for the [V,S] layout helper).
+      head_params: pytree for the loss head (final norm / lm_head / ...).
+      x: ``[M, mb, ...]`` microbatched stage-0 inputs (already embedded).
+      aux: pytree of per-microbatch extras (labels), leaves ``[M, ...]``.
+      mesh/mb_spec: when given, stage buffers get
+        ``with_sharding_constraint`` to ``P(("pp",) + mb_spec)`` laid out
+        round-robin for VPP.
+
+    Returns ``(loss, grads_stage_params, grads_head_params, dx)``:
+    ``loss`` is the mean over microbatches; grads are averaged the same
+    way (accumulated in f32, cast back to param dtype); ``dx`` is
+    ``[M, mb, ...]`` — the cotangent of ``x`` for the embedding pullback.
+    """
+    V = virtual_chunks
+    S_dev = num_stages
+    S = S_dev * V  # virtual pipeline depth (slots)
+    M = x.shape[0]
+    if stage_params is None or M < 1:
+        raise ValueError("need stage_params and at least 1 microbatch")
+    R = 2 * S  # circular saved-input buffer depth
+    mb_shape = x.shape[1:]
+
+    def constrain(t):
+        """Shard the slot axis round-robin over pp: [V*S_dev, ...] viewed
+        as [V, S_dev, ...] with the device dim sharded."""
+        if mesh is None or mb_spec is None:
+            return t
+        extra = t.ndim - 1 - len(mb_spec)
+        spec = P(None, "pp", *mb_spec, *([None] * extra))
+        vs = t.reshape((V, S_dev) + t.shape[1:])
+        vs = lax.with_sharding_constraint(vs, NamedSharding(mesh, spec))
+        return vs.reshape(t.shape)
+
+    def stage_bwd(p_s, x_in, ct):
+        _, pull = jax.vjp(stage_fn, p_s, x_in)
+        dp, dx = pull(ct)
+        return dp, dx
+
+    def shift_ring(state, inject):
+        """slot k takes slot k-1's value; slot 0 takes ``inject``.
+        With the [V, S_dev] round-robin layout this is a
+        collective_permute between neighbouring devices at chunk
+        boundaries and a local move otherwise."""
+        return jnp.concatenate([inject[None], state[:-1]], axis=0)
+
+    fstate0 = jnp.zeros((S,) + mb_shape, x.dtype)
+    bstate0 = jnp.zeros((S,) + mb_shape, x.dtype)
+    saved0 = jnp.zeros((S, R) + mb_shape, x.dtype)
+    gacc0 = _tree_zeros_f32(stage_params)
+    ghead0 = _tree_zeros_f32(head_params)
+
+    def tick(carry, t):
+        fstate, bstate, saved, gacc, ghead, loss_acc = carry
+
+        # ---- forward: slot s consumes microbatch t-s -------------------
+        m_in = t
+        x_next = lax.dynamic_index_in_dim(
+            x, jnp.clip(m_in, 0, M - 1), 0, keepdims=False)
+        x_in = jnp.where(m_in < M, x_next, jnp.zeros_like(x_next))
+        fin = constrain(shift_ring(fstate, x_in))
+        # save this tick's slot inputs: slot s -> ring slot (t - s) mod R
+        slots = jnp.mod(t - jnp.arange(S), R)
+        saved = jax.vmap(
+            lambda buf, idx, val: lax.dynamic_update_index_in_dim(
+                buf, val, idx, 0))(saved, slots, fin)
+        fstate = constrain(jax.vmap(stage_fn)(stage_params, fin))
+
+        # ---- loss head on the microbatch exiting the last slot ---------
+        m_h = t - (S - 1)
+        head_valid = jnp.logical_and(m_h >= 0, m_h < M)
+        aux_mh = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(
+                a, jnp.clip(m_h, 0, M - 1), 0, keepdims=False), aux)
+        loss_m, head_pull = jax.vjp(
+            lambda hp, y: head_fn(hp, y, aux_mh), head_params, fstate[-1])
+        dhead, dout = head_pull(
+            jnp.where(head_valid, 1.0, 0.0).astype(loss_m.dtype))
+        loss_acc = loss_acc + jnp.where(head_valid, loss_m, 0.0)
+        ghead = _tree_add(ghead, dhead)
+
+        # ---- backward: slot s back-props microbatch t-(2S-1)+s ---------
+        # bstate[s] holds the cotangent produced last tick by slot s+1
+        # (or, for the top slot, the head's dout from last tick's exit).
+        bwd_x = jax.vmap(
+            lambda buf, idx: lax.dynamic_index_in_dim(
+                buf, idx, 0, keepdims=False))(
+            saved, jnp.mod(t - (2 * S - 1) + jnp.arange(S), R))
+        dparams, dxs = jax.vmap(stage_bwd)(stage_params, bwd_x, bstate)
+        gacc = _tree_add(gacc, dparams)
+        bstate = constrain(
+            jnp.concatenate([dxs[1:], dout[None].astype(x.dtype)], axis=0))
+        return ((fstate, bstate, saved, gacc, ghead, loss_acc),
+                dxs[0])  # stage-0 dx stream
+
+    carry0 = (fstate0, bstate0, saved0, gacc0, ghead0,
+              jnp.zeros((), jnp.float32))
+    (carry_out, dx_stream) = lax.scan(tick, carry0,
+                                      jnp.arange(M + 2 * S - 1))
+    _, _, _, gacc, ghead, loss_sum = carry_out
+
+    # stage-0 dx for microbatch m emerges at tick m + (2S-1)
+    dx = dx_stream[2 * S - 1:]
+    inv_m = 1.0 / M
+    return (loss_sum * inv_m,
+            _tree_scale_cast(gacc, inv_m, stage_params),
+            _tree_scale_cast(ghead, inv_m, head_params),
+            dx * inv_m)
+
+
+def split_chunks_round_robin(layer_params, num_layers: int,
+                             num_stages: int, virtual_chunks: int = 1):
+    """[L, ...] stacked layers -> [V*S, L/(V*S), ...] chunk-major order
+    (chunk k = v*S + s holds layers [k*L/(VS), ...)) — the reference's
+    VPP round-robin model partition (pipeline_parallel.py:1372)."""
+    VS = num_stages * virtual_chunks
+    if num_layers % VS:
+        raise ValueError(f"layers {num_layers} not divisible by "
+                         f"stages*chunks {VS}")
+    return jax.tree_util.tree_map(
+        lambda p: p.reshape((VS, num_layers // VS) + p.shape[1:]),
+        layer_params)

@@ -66,8 +66,13 @@ def main():
                 @jax.jit
                 def g(p, t):
                     lg = L.forward(p, t, cfg, hm.mesh)
-                    # scalar feedback so successive calls chain device-side
-                    return (lg[0, 0, 0] * 0).astype(jnp.int32)
+                    # full-reduction feedback so successive calls chain
+                    # device-side AND nothing can be dead-code-eliminated
+                    # or narrowed (a single-element chain lets XLA slice
+                    # the whole lm_head matmul down to one element)
+                    s = lg.astype(jnp.float32).sum()
+                    return (s * 0).astype(jnp.int32) + (s > 1e30).astype(
+                        jnp.int32)
 
                 def run_n(n):
                     d = jnp.int32(0)
@@ -91,7 +96,13 @@ def main():
                 @jax.jit
                 def g(p, b):
                     l, grads = jax.value_and_grad(lf)(p, b)
-                    return (l * 0).astype(jnp.int32)
+                    # fold every grad leaf into the chained scalar so the
+                    # backward pass cannot be dead-code-eliminated
+                    gs = sum(x.astype(jnp.float32).sum()
+                             for x in jax.tree_util.tree_leaves(grads))
+                    s = l + gs
+                    return (s * 0).astype(jnp.int32) + (s > 1e30).astype(
+                        jnp.int32)
 
                 def run_n(n):
                     d = jnp.int32(0)
