@@ -60,6 +60,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         "pt_ring_next_size": ([vp], i64),
         "pt_ring_pop": ([vp, vp, u64, i64], i64),
         "pt_ring_close": ([vp], None),
+        "pt_ring_capacity": ([vp], u64),
+        "pt_ring_wait_space": ([vp, u64, i64], ctypes.c_int),
         "pt_ring_destroy": ([vp], None),
         "pt_store_server_start": ([ctypes.c_int], vp),
         "pt_store_server_stop": ([vp], None),

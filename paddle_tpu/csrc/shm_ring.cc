@@ -176,6 +176,32 @@ void pt_ring_close(void* rv) {
   static_cast<Ring*>(rv)->hdr->closed.store(1, std::memory_order_release);
 }
 
+// data capacity in bytes — producers size-check whole multi-part messages
+// against this BEFORE pushing any part (a partial push would desync the
+// header/payload framing)
+uint64_t pt_ring_capacity(void* rv) {
+  return static_cast<Ring*>(rv)->hdr->capacity;
+}
+
+// block until the ring has >= need free bytes (0), or timeout (-2) /
+// closed (-3). Lets a producer reserve room for a whole multi-part
+// message so the subsequent pushes cannot block mid-message (SPSC:
+// free space only grows while the producer is idle).
+int pt_ring_wait_space(void* rv, uint64_t need, int64_t timeout_ms) {
+  Ring* r = static_cast<Ring*>(rv);
+  if (need > r->hdr->capacity) return -1;
+  int64_t waited_us = 0;
+  for (;;) {
+    uint64_t head = r->hdr->head.load(std::memory_order_relaxed);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    if (r->hdr->capacity - (head - tail) >= need) return 0;
+    if (r->hdr->closed.load(std::memory_order_relaxed)) return -3;
+    if (timeout_ms >= 0 && waited_us / 1000 >= timeout_ms) return -2;
+    sleep_us(200);
+    waited_us += 200;
+  }
+}
+
 void pt_ring_destroy(void* rv) {
   Ring* r = static_cast<Ring*>(rv);
   bool owner = r->owner;

@@ -86,10 +86,27 @@ class Server {
   }
 
   void Stop() {
-    stop_.store(true);
+    {
+      // store stop_ under mu_ so a kWait handler cannot check the
+      // predicate (false), lose the race to this store+notify, and then
+      // park forever — the lost-wakeup window
+      std::lock_guard<std::mutex> g(mu_);
+      stop_.store(true);
+    }
+    // wake kWait handlers blocked on the condition variable (their
+    // predicate checks stop_)
+    cv_.notify_all();
     ::shutdown(lfd_, SHUT_RDWR);
     ::close(lfd_);
     if (accept_thread_.joinable()) accept_thread_.join();
+    // unblock Serve() threads parked in read() on live client sockets —
+    // without this, Stop() deadlocks in join while a client is still
+    // connected. Serve() deregisters each fd under threads_mu_ *before*
+    // closing it, so every fd in the set is still open here.
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      for (int fd : client_fds_) ::shutdown(fd, SHUT_RDWR);
+    }
     for (auto& t : client_threads_)
       if (t.joinable()) t.join();
   }
@@ -104,6 +121,7 @@ class Server {
       int one = 1;
       setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
       std::lock_guard<std::mutex> g(threads_mu_);
+      client_fds_.push_back(cfd);
       client_threads_.emplace_back([this, cfd] { Serve(cfd); });
     }
   }
@@ -159,6 +177,12 @@ class Server {
         if (!write_blob(fd, "pong")) break;
       }
     }
+    {
+      std::lock_guard<std::mutex> g(threads_mu_);
+      for (auto it = client_fds_.begin(); it != client_fds_.end(); ++it) {
+        if (*it == fd) { client_fds_.erase(it); break; }
+      }
+    }
     ::close(fd);
   }
 
@@ -168,6 +192,7 @@ class Server {
   std::thread accept_thread_;
   std::mutex threads_mu_;
   std::vector<std::thread> client_threads_;
+  std::vector<int> client_fds_;
   std::mutex mu_;
   std::condition_variable cv_;
   std::map<std::string, std::string> kv_;
@@ -239,7 +264,10 @@ int pt_store_set(void* cv, const char* key, const uint8_t* val, uint32_t n) {
   return 0;
 }
 
-// returns length (>=0) into out (caller-sized), -1 missing/short buffer
+// returns length (>=0) into out (caller-sized); -1 on connection error;
+// -(size)-2 when the reply needs a bigger buffer (caller reallocs and
+// retries — the protocol is stateless request/response, so a retry simply
+// re-requests the key)
 int64_t pt_store_get(void* cv, const char* key, uint8_t* out,
                      uint32_t out_cap) {
   Client* c = static_cast<Client*>(cv);
@@ -248,7 +276,8 @@ int64_t pt_store_get(void* cv, const char* key, uint8_t* out,
   if (!write_full(c->fd, &op, 1) || !write_blob(c->fd, k) ||
       !read_blob(c->fd, &reply))
     return -1;
-  if (reply.size() > out_cap) return -1;
+  if (reply.size() > out_cap)
+    return -static_cast<int64_t>(reply.size()) - 2;
   std::memcpy(out, reply.data(), reply.size());
   return static_cast<int64_t>(reply.size());
 }

@@ -136,8 +136,16 @@ class TCPStore:
             cap = 1 << 20
             out = (ctypes.c_char * cap)()
             n = self._lib.pt_store_get(self._cli, key.encode(), out, cap)
+            while n <= -2:
+                # reply larger than the buffer: -(size)-2; re-request with
+                # a bigger buffer (stateless protocol; loop because the
+                # value can grow again between the two requests)
+                cap = -int(n) - 2
+                out = (ctypes.c_char * cap)()
+                n = self._lib.pt_store_get(self._cli, key.encode(), out,
+                                           cap)
             if n < 0:
-                raise KeyError(key)
+                raise ConnectionError(f"TCPStore get({key!r}) failed")
             raw = bytes(out[:n])
         else:
             raw = _py_req(self._sock, 1, key)

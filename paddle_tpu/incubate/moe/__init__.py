@@ -3,6 +3,17 @@ from .gate import NaiveGate, GShardGate, SwitchGate
 from .layer import MoELayer, ExpertLayer
 
 __all__ = [
-    "moe_ffn", "top_k_gating", "default_capacity",
+    "moe_ffn", "top_k_gating", "default_capacity", "moe_mlp_dropless",
     "NaiveGate", "GShardGate", "SwitchGate", "MoELayer", "ExpertLayer",
 ]
+
+
+def __getattr__(name):
+    # dropless token-choice MoE over the authored Pallas grouped-matmul
+    # kernel (fused_moe_kernel.cu counterpart) — imported lazily so the
+    # einsum capacity path keeps working on installs where
+    # jax.experimental.pallas is unavailable
+    if name == "moe_mlp_dropless":
+        from ...ops.pallas.grouped_matmul import moe_mlp_dropless
+        return moe_mlp_dropless
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -78,14 +78,43 @@ class ShmChannel:
             raise OSError(f"shm ring attach failed ({name})")
         return cls(h, name, lib)
 
+    _FRAME_OVERHEAD = 8  # ring's per-message length prefix (shm_ring.cc)
+
+    def capacity(self) -> int:
+        return int(self._lib.pt_ring_capacity(self._h))
+
     # -- producer -----------------------------------------------------------
     def put(self, obj: Any, timeout_ms: int = -1) -> None:
         arrays: List[np.ndarray] = []
         tree = _flatten(obj, arrays)
         header = pickle.dumps((tree, len(arrays)))
+        # all-or-nothing framing: a mid-message failure (size OR timeout)
+        # would leave the consumer holding a header whose arrays never
+        # arrive, and it would misparse the next batch's header as array
+        # bytes. So (1) reject parts that can never fit, (2) when the
+        # whole message fits at once, reserve the space up front so no
+        # later part can time out, (3) for messages that only fit by
+        # streaming, the parts after the header wait without timeout
+        # (a closed ring still raises EOFError).
+        cap = self.capacity()
+        sizes = [len(header)] + [a.nbytes for a in arrays]
+        worst = max(sizes)
+        if worst + self._FRAME_OVERHEAD > cap:
+            raise ValueError(
+                f"batch part of {worst} bytes exceeds ring capacity "
+                f"{cap}; raise ShmChannel.create(capacity=...) or shrink "
+                f"the batch")
+        total = sum(s + self._FRAME_OVERHEAD for s in sizes)
+        if total <= cap:
+            self._check(self._lib.pt_ring_wait_space(self._h, total,
+                                                     timeout_ms))
+            timeout_ms = -1  # reserved: the pushes below cannot block
+            rest_timeout = -1
+        else:
+            rest_timeout = -1  # stream; only the header respects timeout
         self._push(header, timeout_ms)
         for a in arrays:
-            self._push_raw(a, timeout_ms)
+            self._push_raw(a, rest_timeout)
 
     def _push(self, data: bytes, timeout_ms: int) -> None:
         buf = (ctypes.c_char * len(data)).from_buffer_copy(data)
@@ -105,16 +134,22 @@ class ShmChannel:
         return _unflatten(tree, bufs)
 
     def _pop(self, timeout_ms: int) -> np.ndarray:
-        # wait for a message, then size the buffer exactly
+        # wait for a message, then size the buffer exactly; the wait
+        # respects timeout_ms so a dead producer raises instead of
+        # spinning forever
+        import time
+        deadline = (time.monotonic() + timeout_ms / 1000.0
+                    if timeout_ms > 0 else None)
         while True:
             sz = self._lib.pt_ring_next_size(self._h)
             if sz >= 0:
                 break
             if sz == -3:
                 raise EOFError("shm ring closed")
-            if timeout_ms == 0:
-                raise TimeoutError
-            import time
+            if timeout_ms == 0 or (deadline is not None
+                                   and time.monotonic() > deadline):
+                raise TimeoutError(
+                    f"no batch within {timeout_ms} ms (worker dead?)")
             time.sleep(0.0002)
         out = np.empty(sz, np.uint8)
         got = self._lib.pt_ring_pop(

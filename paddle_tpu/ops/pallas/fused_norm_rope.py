@@ -1,0 +1,237 @@
+"""Fused RMSNorm and rotary-embedding Pallas kernels.
+
+Counterparts of the reference's fused epilogue kernels
+(paddle/phi/kernels/fusion/gpu/fused_rope_kernel.cu and
+fused_rms_norm_kernel): one pass over HBM instead of the several
+materialised intermediates the unfused formulation costs (cos/sin tables,
+half-splits, concats).
+
+TPU-shape notes:
+  * rope is computed roll-based: ``out = x*cos' + roll(x, Dh/2)*sign*sin'``
+    where cos'/sin' repeat over both halves and ``sign`` is -1 on the first
+    half. This keeps every op full-lane (no Dh/2 slicing, which would
+    break the 128-lane tiling).
+  * the backward of a rotation is the rotation by the negated angle, so
+    the same kernel serves the VJP with ``positions`` negated.
+  * rms_norm's dw is accumulated across row tiles directly in the f32
+    output window (the TPU grid is sequential).
+
+Both kernels run in interpreter mode off-TPU so CPU tests exercise the
+same code (tests/test_fused_norm_rope.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# fused rope (q and k in one pass)
+# ---------------------------------------------------------------------------
+
+def _rope_kernel(pos_ref, q_ref, k_ref, oq_ref, ok_ref, *, theta):
+    TT = q_ref.shape[1]
+    Dh = q_ref.shape[-1]
+    half = Dh // 2
+    b, t = pl.program_id(0), pl.program_id(1)
+    # positions ref is the whole [B, T] array (tiny; a (1, TT) block
+    # would violate Mosaic's (8, 128) block-divisibility rule)
+    pos = pos_ref[b, pl.ds(t * TT, TT)].astype(jnp.float32)   # [TT]
+    j = jax.lax.broadcasted_iota(jnp.int32, (TT, Dh), 1)
+    exponent = (j % half).astype(jnp.float32) / half
+    inv_freq = jnp.exp(-jnp.log(theta) * exponent)            # [TT, Dh]
+    ang = pos[:, None] * inv_freq
+    cos = jnp.cos(ang)[None, :, None, :]                      # [1,TT,1,Dh]
+    sin = jnp.sin(ang)[None, :, None, :]
+    sign = jnp.where(j < half, -1.0, 1.0)[None, :, None, :]
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        rolled = pltpu.roll(xf, half, axis=3)
+        return (xf * cos + rolled * sign * sin).astype(x.dtype)
+
+    oq_ref[...] = rot(q_ref[...])
+    ok_ref[...] = rot(k_ref[...])
+
+
+@functools.partial(jax.jit, static_argnames=("theta", "tile_t",
+                                             "interpret"))
+def _rope_call(q, k, positions, theta, tile_t, interpret):
+    B, T, H, Dh = q.shape
+    Hkv = k.shape[2]
+    assert T % tile_t == 0 and Dh % 2 == 0
+    grid = (B, T // tile_t)
+    kern = functools.partial(_rope_kernel, theta=theta)
+    return pl.pallas_call(
+        kern,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((B, T), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, tile_t, H, Dh), lambda b, t: (b, t, 0, 0)),
+            pl.BlockSpec((1, tile_t, Hkv, Dh), lambda b, t: (b, t, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, tile_t, H, Dh), lambda b, t: (b, t, 0, 0)),
+            pl.BlockSpec((1, tile_t, Hkv, Dh), lambda b, t: (b, t, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q.dtype),
+            jax.ShapeDtypeStruct(k.shape, k.dtype),
+        ],
+        interpret=interpret,
+    )(positions, q, k)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_rope(q, k, positions, theta: float = 10000.0, tile_t: int = 256):
+    """Rotary embedding applied to q ``[B,T,H,Dh]`` and k ``[B,T,Hkv,Dh]``
+    in one fused pass. positions: int ``[B, T]``."""
+    tt = tile_t if q.shape[1] % tile_t == 0 else q.shape[1]
+    return tuple(_rope_call(q, k, positions, float(theta), tt,
+                            interpret=not _on_tpu()))
+
+
+def _rope_fwd(q, k, positions, theta, tile_t):
+    return fused_rope(q, k, positions, theta, tile_t), positions
+
+
+def _rope_bwd(theta, tile_t, positions, g):
+    gq, gk = g
+    # rotation transpose == rotation by -angle
+    tt = tile_t if gq.shape[1] % tile_t == 0 else gq.shape[1]
+    dq, dk = _rope_call(gq, gk, -positions, float(theta), tt,
+                        interpret=not _on_tpu())
+    return dq, dk, None
+
+
+fused_rope.defvjp(_rope_fwd, _rope_bwd)
+
+
+# ---------------------------------------------------------------------------
+# fused rms_norm
+# ---------------------------------------------------------------------------
+
+def _rms_fwd_kernel(x_ref, w_ref, o_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(x * x, axis=-1, keepdims=True) + eps)
+    rstd_ref[...] = rstd  # [tile_n, 1] — 1-D outputs trip XLA's f32
+    #                        1024-element tiling, so rstd stays 2-D
+    o_ref[...] = (x * rstd * w_ref[...].astype(jnp.float32)).astype(
+        o_ref.dtype)
+
+
+def _rms_bwd_kernel(x_ref, w_ref, rstd_ref, g_ref, dx_ref, dw_ref, *, eps):
+    del eps
+    i = pl.program_id(0)
+    x = x_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    w = w_ref[...].astype(jnp.float32)
+    rstd = rstd_ref[...]  # [tile_n, 1]
+    xhat = x * rstd
+    gw = g * w
+    dx = (gw - xhat * jnp.mean(gw * xhat, axis=-1, keepdims=True)) * rstd
+
+    @pl.when(i == 0)
+    def _():
+        dw_ref[...] = jnp.zeros_like(dw_ref)
+
+    dw_ref[...] += jnp.sum(g * xhat, axis=0)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "tile_n", "interpret"))
+def _rms_fwd_call(x, w, eps, tile_n, interpret):
+    N, D = x.shape
+    kern = functools.partial(_rms_fwd_kernel, eps=eps)
+    return pl.pallas_call(
+        kern,
+        grid=(N // tile_n,),
+        in_specs=[
+            pl.BlockSpec((tile_n, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_n, D), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), x.dtype),
+            jax.ShapeDtypeStruct((N, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "tile_n", "interpret"))
+def _rms_bwd_call(x, w, rstd, g, eps, tile_n, interpret):
+    N, D = x.shape
+    kern = functools.partial(_rms_bwd_kernel, eps=eps)
+    return pl.pallas_call(
+        kern,
+        grid=(N // tile_n,),
+        in_specs=[
+            pl.BlockSpec((tile_n, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+            pl.BlockSpec((tile_n, 1), lambda i: (i, 0)),
+            pl.BlockSpec((tile_n, D), lambda i: (i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile_n, D), lambda i: (i, 0)),
+            pl.BlockSpec((D,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((N, D), x.dtype),
+            jax.ShapeDtypeStruct((D,), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x, w, rstd, g)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def fused_rms_norm(x, weight, eps: float = 1e-5, tile_n: int = 256):
+    """RMSNorm over the last dim of ``x [..., D]``, fused fwd+bwd."""
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    tn = tile_n if x2.shape[0] % tile_n == 0 else _row_tile(x2.shape[0])
+    out, _ = _rms_fwd_call(x2, weight, float(eps), tn,
+                           interpret=not _on_tpu())
+    return out.reshape(shape)
+
+
+def _row_tile(n: int) -> int:
+    for t in (256, 128, 64, 32, 16, 8, 4, 2):
+        if n % t == 0:
+            return t
+    return 1
+
+
+def _rms_fwd(x, weight, eps, tile_n):
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    tn = tile_n if x2.shape[0] % tile_n == 0 else _row_tile(x2.shape[0])
+    out, rstd = _rms_fwd_call(x2, weight, float(eps), tn,
+                              interpret=not _on_tpu())
+    return out.reshape(shape), (x2, weight, rstd, shape)
+
+
+def _rms_bwd(eps, tile_n, res, g):
+    x2, weight, rstd, shape = res
+    g2 = g.reshape(-1, shape[-1])
+    tn = tile_n if x2.shape[0] % tile_n == 0 else _row_tile(x2.shape[0])
+    dx, dw = _rms_bwd_call(x2, weight, rstd, g2, float(eps), tn,
+                           interpret=not _on_tpu())
+    return dx.reshape(shape), dw.astype(weight.dtype)
+
+
+fused_rms_norm.defvjp(_rms_fwd, _rms_bwd)

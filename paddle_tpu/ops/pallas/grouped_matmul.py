@@ -1,0 +1,290 @@
+"""Grouped (per-expert) matmul Pallas kernels + dropless MoE glue.
+
+Counterpart of the reference's fused MoE GEMM
+(paddle/phi/kernels/fusion/cutlass/fused_moe_kernel.cu and the dispatch in
+python/paddle/incubate/distributed/models/moe/moe_layer.py:119-190): there,
+tokens are scattered to experts and each expert runs a CUTLASS grouped GEMM.
+
+TPU-native version: ``gmm`` — one Pallas kernel over row tiles of the
+token-sorted activation matrix, where each 128-row tile belongs to exactly
+one expert (callers pad each expert's rows to the tile size). The expert id
+per tile is a *scalar-prefetched* array, so the weight block for the right
+expert is DMA'd from HBM before each tile's compute — the kernel reads
+``lhs[tile] @ rhs[expert_of_tile]`` with zero gather/scatter inside.
+
+This is the *dropless* MoE formulation (no capacity factor, no dropped
+tokens): the fixed-capacity einsum path in incubate/moe stays as the
+GShard-style alternative; ``moe_mlp_dropless`` below is the glue that
+sorts/pads tokens by expert, runs the three FFN gmms, and combines with
+router weights. Also used as the building block for grad-of-weights via
+``tgmm`` (per-expert X^T G accumulation).
+
+All kernels run in interpreter mode off-TPU so the CPU test mesh exercises
+identical semantics (tests/test_grouped_matmul.py).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# gmm: out[i*TM:(i+1)*TM] = lhs[i*TM:(i+1)*TM] @ rhs[tile_expert[i]]
+# ---------------------------------------------------------------------------
+
+def _fit_tile_n(K: int, tile_m: int, tile_n: int, N: int,
+                itemsize: int = 2, budget: int = 10 << 20) -> int:
+    """Shrink tile_n until the kernel's VMEM working set (double-buffered
+    lhs tile + weight block + out tile) fits the ~16MB/core VMEM."""
+    tn = min(tile_n, N)
+    while tn > 128:
+        need = 2 * itemsize * (tile_m * K + K * tn + tile_m * tn)
+        if need <= budget and N % tn == 0:
+            return tn
+        tn //= 2
+    return tn if N % tn == 0 else N
+
+
+def _gmm_kernel(tile_expert_ref, lhs_ref, rhs_ref, out_ref):
+    del tile_expert_ref  # consumed by the index maps
+    out_ref[...] = jnp.dot(
+        lhs_ref[...], rhs_ref[0],
+        preferred_element_type=jnp.float32).astype(out_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_m", "tile_n",
+                                             "interpret"))
+def _gmm_call(lhs, rhs, tile_expert, tile_m, tile_n, interpret):
+    M, K = lhs.shape
+    E, K2, N = rhs.shape
+    assert K == K2 and M % tile_m == 0 and N % tile_n == 0
+    grid = (M // tile_m, N // tile_n)
+    return pl.pallas_call(
+        _gmm_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tile_m, K), lambda i, j, te: (i, 0)),
+                pl.BlockSpec((1, K, tile_n), lambda i, j, te: (te[i], 0, j)),
+            ],
+            out_specs=pl.BlockSpec((tile_m, tile_n),
+                                   lambda i, j, te: (i, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((M, N), lhs.dtype),
+        interpret=interpret,
+    )(tile_expert, lhs, rhs)
+
+
+# ---------------------------------------------------------------------------
+# tgmm: drhs[e] = sum over expert-e row tiles of lhs_tile^T @ g_tile
+# (accumulates directly in the f32 output VMEM window; for a fixed n-tile
+# the expert index is non-decreasing over the sequential TPU grid, so each
+# output block is visited in one contiguous run)
+# ---------------------------------------------------------------------------
+
+def _tgmm_kernel(tile_expert_ref, lhs_ref, g_ref, out_ref):
+    j = pl.program_id(0)  # n tile (outer)
+    i = pl.program_id(1)  # m tile (inner, sequential over experts)
+    e = tile_expert_ref[i]
+    first_of_expert = jnp.logical_or(
+        i == 0, tile_expert_ref[jnp.maximum(i - 1, 0)] != e)
+    del j
+
+    @pl.when(first_of_expert)
+    def _():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    out_ref[...] += jnp.dot(
+        lhs_ref[...].T, g_ref[...],
+        preferred_element_type=jnp.float32)[None]
+
+
+@functools.partial(jax.jit, static_argnames=("num_experts", "tile_m",
+                                             "tile_n", "interpret"))
+def _tgmm_call(lhs, g, tile_expert, num_experts, tile_m, tile_n, interpret):
+    M, K = lhs.shape
+    M2, N = g.shape
+    assert M == M2 and M % tile_m == 0 and N % tile_n == 0
+    grid = (N // tile_n, M // tile_m)
+    out = pl.pallas_call(
+        _tgmm_kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tile_m, K), lambda j, i, te: (i, 0)),
+                pl.BlockSpec((tile_m, tile_n), lambda j, i, te: (i, j)),
+            ],
+            out_specs=pl.BlockSpec((1, K, tile_n),
+                                   lambda j, i, te: (te[i], 0, j)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((num_experts, K, N), jnp.float32),
+        interpret=interpret,
+    )(tile_expert, lhs, g)
+    # experts owning no row tile never have their output block written —
+    # zero them instead of returning uninitialised memory
+    present = jnp.zeros((num_experts,), jnp.bool_).at[tile_expert].set(True)
+    return jnp.where(present[:, None, None], out, 0.0)
+
+
+# ---------------------------------------------------------------------------
+# public op with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def gmm(lhs, rhs, tile_expert, tile_m: int = 128, tile_n: int = 128):
+    """Grouped matmul: rows are token tiles, each tile owned by one expert.
+
+    lhs: ``[M, K]`` token-sorted activations, M % tile_m == 0; every row
+      tile must belong to a single expert (pad groups to tile_m — see
+      ``sort_and_pad_by_expert``).
+    rhs: ``[E, K, N]`` per-expert weights.
+    tile_expert: int32 ``[M // tile_m]`` expert id per row tile.
+      PRECONDITION for gradients: must be NON-DECREASING (sorted by
+      expert). The forward pass is correct for any order, but the
+      weight-gradient kernel accumulates each expert's output block in
+      one contiguous run of tiles — an out-of-order tile_expert (e.g.
+      [0, 1, 0]) silently drops earlier contributions.
+      ``sort_and_pad_by_expert`` always produces a sorted layout; the
+      precondition is checked here when the value is concrete.
+
+    Returns ``[M, N]`` in lhs dtype.
+    """
+    _check_sorted_tiles(tile_expert)
+    tn = _fit_tile_n(lhs.shape[1], tile_m, tile_n, rhs.shape[2],
+                     lhs.dtype.itemsize)
+    return _gmm_call(lhs, rhs, tile_expert, tile_m, tn,
+                     interpret=not _on_tpu())
+
+
+def _check_sorted_tiles(tile_expert):
+    """Best-effort static check of the non-decreasing precondition (only
+    possible when the value is concrete, i.e. outside jit)."""
+    try:
+        import numpy as _np
+        te = _np.asarray(tile_expert)
+    except Exception:
+        return  # traced — caller guarantees (sort_and_pad_by_expert does)
+    if te.size > 1 and _np.any(_np.diff(te) < 0):
+        raise ValueError(
+            "gmm: tile_expert must be non-decreasing (sorted by expert) "
+            "for correct weight gradients; use sort_and_pad_by_expert")
+
+
+def _gmm_fwd(lhs, rhs, tile_expert, tile_m, tile_n):
+    _check_sorted_tiles(tile_expert)
+    tn = _fit_tile_n(lhs.shape[1], tile_m, tile_n, rhs.shape[2],
+                     lhs.dtype.itemsize)
+    out = _gmm_call(lhs, rhs, tile_expert, tile_m, tn,
+                    interpret=not _on_tpu())
+    return out, (lhs, rhs, tile_expert)
+
+
+def _gmm_bwd(tile_m, tile_n, res, g):
+    lhs, rhs, tile_expert = res
+    interp = not _on_tpu()
+    g = g.astype(lhs.dtype)
+    # dlhs = g @ rhs[e]^T — same kernel with swapped weight dims (the
+    # output dim is K here, re-fitted to VMEM by _fit_tile_n)
+    tn_k = _fit_tile_n(rhs.shape[2], tile_m, tile_n, rhs.shape[1],
+                       g.dtype.itemsize)
+    dlhs = _gmm_call(g, jnp.swapaxes(rhs, 1, 2), tile_expert, tile_m,
+                     tn_k, interpret=interp)
+    tn_d = _fit_tile_n(rhs.shape[1], tile_m, tile_n, rhs.shape[2],
+                       g.dtype.itemsize)
+    drhs = _tgmm_call(lhs, g, tile_expert, rhs.shape[0], tile_m, tn_d,
+                      interpret=interp).astype(rhs.dtype)
+    return dlhs, drhs, None
+
+
+gmm.defvjp(_gmm_fwd, _gmm_bwd)
+
+
+# ---------------------------------------------------------------------------
+# dropless MoE glue
+# ---------------------------------------------------------------------------
+
+def sort_and_pad_by_expert(expert_ids: jax.Array, num_experts: int,
+                           tile_m: int) -> Tuple[jax.Array, jax.Array,
+                                                 jax.Array, int]:
+    """Stable-sort assignment indices by expert and compute tile-aligned
+    destination slots.
+
+    expert_ids: int32 ``[A]`` expert per (token, k) assignment.
+    Returns ``(order, dest, tile_expert, m_pad)``:
+      order: ``[A]`` identity permutation (see note below);
+      dest: ``[A]`` destination row of assignment ``order[i]`` in the
+        padded ``[m_pad, ...]`` buffer (each expert's rows start at a
+        tile_m-aligned offset; padding rows stay zero);
+      tile_expert: ``[m_pad // tile_m]`` owning expert per row tile;
+      m_pad: static padded row count = A rounded up + worst-case per-expert
+        padding (shape must be static under jit).
+    Implementation note: this is a counting sort, not ``argsort`` —
+    sorting networks are slow on TPU, and with tiny E the stable sort is
+    one cumsum over the one-hot assignment matrix. ``order`` is the
+    identity (``dest[i]`` is where assignment ``i`` lands).
+    """
+    A = expert_ids.shape[0]
+    m_pad = ((A + tile_m - 1) // tile_m + (num_experts - 1)) * tile_m
+    order = jnp.arange(A, dtype=jnp.int32)
+    onehot = (expert_ids[:, None]
+              == jnp.arange(num_experts, dtype=expert_ids.dtype))
+    incl = jnp.cumsum(onehot.astype(jnp.int32), axis=0)       # [A, E]
+    counts = incl[-1]                                         # [E]
+    # stable rank of assignment i within its expert group
+    rank = jnp.take_along_axis(
+        incl, expert_ids[:, None].astype(jnp.int32), axis=1)[:, 0] - 1
+    padded_counts = ((counts + tile_m - 1) // tile_m) * tile_m
+    starts = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32),
+         jnp.cumsum(padded_counts)[:-1].astype(jnp.int32)])
+    dest = starts[expert_ids] + rank
+    tile_starts = jnp.arange(m_pad // tile_m, dtype=jnp.int32) * tile_m
+    tile_expert = (jnp.searchsorted(
+        jnp.cumsum(padded_counts), tile_starts, side="right")
+        .astype(jnp.int32))
+    # trailing all-padding tiles (rows past the last expert's block) get
+    # clipped to a valid expert id; their lhs rows are zero so they only
+    # produce zeros
+    tile_expert = jnp.minimum(tile_expert, num_experts - 1)
+    return order, dest, tile_expert, m_pad
+
+
+def moe_mlp_dropless(x, expert_ids, combine_weights, w_gate, w_up, w_down,
+                     *, tile_m: int = 128, tile_n: int = 128):
+    """Dropless token-choice MoE FFN (SwiGLU experts) via grouped matmul.
+
+    x: ``[S, D]`` tokens; expert_ids/combine_weights: ``[S, k]`` top-k
+    routing (no capacity, nothing dropped); w_gate/w_up: ``[E, D, F]``;
+    w_down: ``[E, F, D]``. Returns ``[S, D]``.
+    """
+    S, D = x.shape
+    k = expert_ids.shape[1]
+    E = w_gate.shape[0]
+    flat_e = expert_ids.reshape(-1).astype(jnp.int32)
+    order, dest, tile_expert, m_pad = sort_and_pad_by_expert(
+        flat_e, E, tile_m)
+    token_of = order // k  # source token for each sorted assignment
+    xs = jnp.zeros((m_pad, D), x.dtype).at[dest].set(x[token_of])
+
+    h = jax.nn.silu(gmm(xs, w_gate, tile_expert, tile_m, tile_n)) * \
+        gmm(xs, w_up, tile_expert, tile_m, tile_n)
+    ys = gmm(h.astype(x.dtype), w_down, tile_expert, tile_m,
+             tile_n if D % tile_n == 0 else D)
+
+    w = combine_weights.reshape(-1)[order].astype(ys.dtype)
+    return (jnp.zeros((S, D), ys.dtype)
+            .at[token_of].add(ys[dest] * w[:, None]))

@@ -169,9 +169,14 @@ class ToTensor(BaseTransform):
         img = np.asarray(img)
         if img.ndim == 2:
             img = img[:, :, None]
+        # scale by the ORIGINAL dtype, not the values: integer images are
+        # always /255, float images are passed through (deciding by
+        # img.max() would scale the same uint8 image differently
+        # depending on its content)
+        was_int = np.issubdtype(img.dtype, np.integer)
         img = img.astype(np.float32)
-        if np.issubdtype(np.asarray(img).dtype, np.floating):
-            img = img / 255.0 if img.max() > 1.5 else img
+        if was_int:
+            img = img / 255.0
         if self.data_format == "CHW":
             img = img.transpose(2, 0, 1)
         return img

@@ -1,0 +1,125 @@
+"""Regression tests for the round-1 advisor findings (ADVICE.md):
+
+1. TCPStore server Stop() deadlock with a still-connected client
+   (csrc/tcp_store.cc Stop).
+2. ShmChannel protocol desync on oversized batches (io/shm_channel.py put
+   must reject the whole message before pushing any part).
+3. ShmChannel unbounded spin when the producer dies (io/shm_channel.py
+   _pop must honour timeout_ms while waiting for a header).
+4. ToTensor scaling decided by value range instead of dtype
+   (vision/transforms.py).
+5. TCPStore.get false KeyError for values over the 1 MB client buffer
+   (distributed/store.py + csrc/tcp_store.cc pt_store_get).
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.core import native
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.io.shm_channel import ShmChannel
+from paddle_tpu.vision.transforms import ToTensor
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="no C++ toolchain")
+
+
+@needs_native
+def test_store_stop_with_connected_client_does_not_deadlock():
+    master = TCPStore(is_master=True, world_size=2)
+    client = TCPStore(host="127.0.0.1", port=master.port, world_size=2)
+    client.set("k", b"v")
+
+    done = threading.Event()
+
+    def closer():
+        master.close()  # joins server threads; used to deadlock here
+        done.set()
+
+    t = threading.Thread(target=closer, daemon=True)
+    t.start()
+    assert done.wait(timeout=10), "TCPStore.close() deadlocked with a " \
+                                  "connected client"
+    client.close()
+
+
+@needs_native
+def test_store_get_value_larger_than_1mb():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        big = b"x" * ((1 << 20) + 12345)  # > the 1 MB first-try buffer
+        master.set("big", big)
+        got = master.get("big", decode=False)
+        assert got == big
+        # missing keys still raise KeyError (not ConnectionError)
+        with pytest.raises(KeyError):
+            master.get("nope")
+    finally:
+        master.close()
+
+
+@needs_native
+def test_shm_put_oversized_batch_leaves_channel_consistent():
+    ch = ShmChannel.create(capacity=1 << 16)  # 64 KB ring
+    rx = ShmChannel.attach(ch.name)
+    try:
+        with pytest.raises(ValueError, match="capacity"):
+            ch.put({"x": np.zeros(1 << 20, np.uint8)})  # 1 MB > ring
+        # the failed put must not have pushed a header: the next good
+        # batch parses cleanly
+        ch.put({"x": np.arange(10, dtype=np.int32)})
+        out = rx.get(timeout_ms=2000)
+        np.testing.assert_array_equal(out["x"], np.arange(10))
+    finally:
+        rx.close()
+        ch.destroy()
+
+
+@needs_native
+def test_shm_put_timeout_on_full_ring_is_all_or_nothing():
+    """A put that times out waiting for space must push NOTHING — a
+    half-pushed message desyncs the header/payload framing."""
+    ch = ShmChannel.create(capacity=1 << 16)
+    rx = ShmChannel.attach(ch.name)
+    try:
+        a = np.arange(10000, dtype=np.int32)  # ~40 KB of the 64 KB ring
+        ch.put({"x": a})
+        with pytest.raises(TimeoutError):
+            ch.put({"x": a}, timeout_ms=150)  # no room, must not push
+        out = rx.get(timeout_ms=2000)  # first batch still parses clean
+        np.testing.assert_array_equal(out["x"], a)
+        with pytest.raises(TimeoutError):
+            rx.get(timeout_ms=150)  # and nothing half-pushed after it
+    finally:
+        rx.close()
+        ch.destroy()
+
+
+@needs_native
+def test_shm_get_times_out_instead_of_spinning():
+    ch = ShmChannel.create(capacity=1 << 16)
+    rx = ShmChannel.attach(ch.name)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(TimeoutError):
+            rx.get(timeout_ms=300)  # nothing was ever produced
+        elapsed = time.monotonic() - t0
+        assert elapsed < 5, f"timeout not honoured ({elapsed:.1f}s)"
+    finally:
+        rx.close()
+        ch.destroy()
+
+
+def test_totensor_scales_by_dtype_not_values():
+    tt = ToTensor()
+    dark_u8 = np.ones((4, 4, 3), np.uint8)  # max==1: used to skip /255
+    bright_u8 = np.full((4, 4, 3), 255, np.uint8)
+    np.testing.assert_allclose(tt(dark_u8), np.full((3, 4, 4), 1 / 255.0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(tt(bright_u8), np.ones((3, 4, 4)),
+                               rtol=1e-6)
+    # float input passes through unscaled regardless of range
+    f = np.full((2, 2, 1), 3.0, np.float32)
+    np.testing.assert_allclose(tt(f), np.full((1, 2, 2), 3.0))
