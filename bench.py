@@ -52,6 +52,7 @@ def main():
                                  use_flash_attention=False, remat=False)
         B, T, iters = 4, 64, 4
 
+    decode_tok_s = None
     hm = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
     with hm.mesh:
         step, init = L.make_train_step(cfg, hm.mesh)
@@ -74,6 +75,22 @@ def main():
         t_long = time.perf_counter() - t0
         dt = (t_long - t_short) / (n1 - n0)
 
+        if on_tpu:
+            # decode throughput on the same model (KV-cache generate path)
+            from functools import partial
+            gen_new = 64
+            prompt = jax.random.randint(jax.random.PRNGKey(1), (1, 128),
+                                        0, cfg.vocab_size, dtype=jnp.int32)
+            gen = jax.jit(partial(L.generate, cfg=cfg,
+                                  max_new_tokens=gen_new))
+            out = gen(state["params"], prompt)
+            int(out[0, -1])  # block_until_ready does not block through
+            #                  the tunnelled runtime; force a host read
+            t0 = time.perf_counter()
+            out = gen(state["params"], prompt)
+            int(out[0, -1])  # host sync
+            decode_tok_s = gen_new / (time.perf_counter() - t0)
+
     # PaLM-style MFU accounting: per-token train FLOPs = 6N + 6*L*D*T
     # (causal attention term); remat recompute is NOT credited (MFU, not HFU)
     D, L_, V = cfg.hidden_size, cfg.num_hidden_layers, cfg.vocab_size
@@ -93,6 +110,8 @@ def main():
         "unit": "fraction_of_peak_bf16",
         "vs_baseline": round(mfu / 0.40, 4),
         "tokens_per_sec": round(tok_s, 1),
+        "decode_tokens_per_sec": (round(decode_tok_s, 1)
+                                  if decode_tok_s else None),
         "step_ms": round(dt * 1e3, 2),
         "params_b": round(n_params / 1e9, 3),
         "loss": float(loss),

@@ -127,6 +127,58 @@ class IOHandle:
         return list(np.asarray(self._store[self._key]).shape)
 
 
+class GenerationPredictor:
+    """Serving-side autoregressive decoder with a KV cache.
+
+    Wraps a Llama-family params pytree + config into a jitted
+    prefill+decode program (models/llama.py generate) — the deployment
+    counterpart of the reference's fused generation predictors
+    (block_multi_head_attention / masked_multihead_attention kernels
+    behind paddle.inference).
+
+    Compilation caching: one compile per distinct
+    (prompt_shape, max_new_tokens, temperature, top_p) combination —
+    there is NO automatic prompt-length bucketing, so serving callers
+    should pad prompts to a small set of bucket lengths themselves to
+    avoid a fresh XLA compile per natural prompt length.
+    """
+
+    def __init__(self, params, cfg, max_len: int = 2048):
+        from ..models import llama as L
+        self._params = params
+        self._cfg = cfg
+        self._max_len = max_len
+        self._L = L
+        self._compiled = {}
+
+    def _fn(self, max_new_tokens: int, temperature: float, top_p: float):
+        import jax
+        from functools import partial
+        key_ = (max_new_tokens, temperature, top_p)
+        if key_ not in self._compiled:
+            self._compiled[key_] = jax.jit(partial(
+                self._L.generate, cfg=self._cfg,
+                max_new_tokens=max_new_tokens, temperature=temperature,
+                top_p=top_p))
+        return self._compiled[key_]
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 seed: int = 0):
+        import jax
+        import jax.numpy as jnp
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        prompt = jnp.asarray(prompt, jnp.int32)
+        if prompt.shape[1] + max_new_tokens > self._max_len:
+            raise ValueError(
+                f"prompt+continuation {prompt.shape[1] + max_new_tokens} "
+                f"exceeds max_len {self._max_len}")
+        out = self._fn(max_new_tokens, temperature, top_p)(
+            self._params, prompt, key=jax.random.PRNGKey(seed))
+        return np.asarray(out)
+
+
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 

@@ -191,29 +191,20 @@ def attention(q, k, v, cfg: LlamaConfig):
     return _fa(q, k, v, causal=True, impl="dense")
 
 
-def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
-    """One transformer block on [B, T, D]. ``lp`` holds this layer's
-    (unstacked) weights."""
+def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None):
+    """The transformer block math shared by the training path
+    (decoder_layer) and the KV-cache decode path (forward_with_cache):
+    rms_norm -> QKV -> rope -> ``attn_fn(q, k, v)`` -> o-proj+residual ->
+    rms_norm -> SwiGLU+residual. One source of truth — attention strategy
+    is the only thing the two paths vary."""
     B, T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-
     x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
     q = (x @ lp["wq"]).reshape(B, T, H, Dh)
     k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
     v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
     q, k = rope(q, k, positions, cfg.rope_theta, Dh)
-    cp_on = (cfg.context_parallel != "none" and mesh is not None
-             and mesh.shape.get("cp", 1) > 1)
-    if cp_on:
-        from ..parallel.context_parallel import context_parallel_attention
-        o = context_parallel_attention(q, k, v, mesh,
-                                       impl=cfg.context_parallel)
-    else:
-        from ..ops.pallas.flash_attention import flash_attention as _fa
-        fa = cfg.use_flash_attention
-        impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
-        o = _fa(q, k, v, causal=True, impl=impl)
+    o = attn_fn(q, k, v)
     # tag for remat policies: lets a save_only_these_names policy keep the
     # kernel output so backward recompute skips the flash forward (the
     # default bench path uses plain per-layer remat, measured faster)
@@ -229,6 +220,30 @@ def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
     if sp_spec is not None:
         h = lax.with_sharding_constraint(h, sp_spec)
     return h
+
+
+def _train_attn_fn(cfg: LlamaConfig, mesh):
+    """Attention callable for the training path: context-parallel when a
+    cp axis is live, otherwise the flash kernel per cfg."""
+    cp_on = (cfg.context_parallel != "none" and mesh is not None
+             and mesh.shape.get("cp", 1) > 1)
+    if cp_on:
+        from ..parallel.context_parallel import context_parallel_attention
+        return lambda q, k, v: context_parallel_attention(
+            q, k, v, mesh, impl=cfg.context_parallel)
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    fa = cfg.use_flash_attention
+    impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
+    return lambda q, k, v: _fa(q, k, v, causal=True, impl=impl)
+
+
+def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
+    """One transformer block on [B, T, D]. ``lp`` holds this layer's
+    (unstacked) weights."""
+    B, T, _ = h.shape
+    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    return _block(lp, h, positions, cfg, _train_attn_fn(cfg, mesh),
+                  sp_spec=sp_spec)
 
 
 def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False,
@@ -406,6 +421,148 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
                 "step": state["step"] + 1}, loss
 
     return step_fn, init_fn
+
+
+# ---------------------------------------------------------------------------
+# decode: KV cache + generate
+# ---------------------------------------------------------------------------
+# Reference capability: the fused decode attention + cache machinery
+# (paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu,
+# masked_multihead_attention_kernel.cu) behind paddle.incubate fused
+# generation. TPU-native shape: the cache is a [L, B, S_max, Hkv, Dh]
+# pytree updated with lax.dynamic_update_slice inside one jitted step;
+# prefill reuses the flash kernel on the un-padded prompt, decode steps
+# run a masked dense attention over the cache (T=1 queries cannot fill
+# the MXU; the op is bandwidth-bound either way).
+
+
+def init_kv_cache(cfg: LlamaConfig, batch_size: int, max_len: int):
+    """Empty per-layer K/V cache, layers stacked on a leading axis."""
+    L, Hkv, Dh = cfg.num_hidden_layers, cfg.num_key_value_heads, cfg.head_dim
+    shape = (L, batch_size, max_len, Hkv, Dh)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def _cached_attention(q, ck, cv, pos0, cfg: LlamaConfig):
+    """q [B,T,H,Dh] against the full cache [B,S,Hkv,Dh]; query at
+    position pos0+t attends to keys at positions <= pos0+t.
+
+    GQA is a grouped einsum against the UN-repeated cache — decode is
+    bandwidth-bound, so materialising an H-head copy of the cache would
+    amplify its traffic H/Hkv-fold per step."""
+    B, T, H, Dh = q.shape
+    S, Hkv = ck.shape[1], ck.shape[2]
+    G = H // Hkv
+    qg = q.reshape(B, T, Hkv, G, Dh)
+    scores = jnp.einsum("btkgd,bskd->bkgts", qg, ck) / np.sqrt(Dh)
+    key_pos = jnp.arange(S)[None, :]                       # [1, S]
+    q_pos = pos0 + jnp.arange(T)[:, None]                  # [T, 1]
+    mask = key_pos <= q_pos                                # [T, S]
+    scores = jnp.where(mask, scores.astype(jnp.float32), -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    o = jnp.einsum("bkgts,bskd->btkgd", probs, cv)
+    return o.reshape(B, T, H, Dh)
+
+
+def forward_with_cache(params, tokens, cache, pos0, cfg: LlamaConfig):
+    """tokens [B, T] at absolute positions pos0..pos0+T-1 -> (logits of
+    the LAST position [B, V], updated cache). Used for both prefill
+    (T = prompt length, pos0 = 0) and decode steps (T = 1)."""
+    B, T = tokens.shape
+    h = params["embed"].astype(cfg.dtype)[tokens]
+    positions = pos0 + jnp.broadcast_to(jnp.arange(T), (B, T))
+    is_prefill = isinstance(pos0, int) and pos0 == 0
+
+    def body(h, xs):
+        lp, ck, cv = xs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            ck2 = lax.dynamic_update_slice(
+                ck, k.astype(ck.dtype), (0, pos0, 0, 0))
+            cv2 = lax.dynamic_update_slice(
+                cv, v.astype(cv.dtype), (0, pos0, 0, 0))
+            cell["ck"], cell["cv"] = ck2, cv2
+            if is_prefill:
+                # prompt: plain causal attention over the fresh keys —
+                # the flash kernel path, no cache-length masking needed
+                from ..ops.pallas.flash_attention import (
+                    flash_attention as _fa)
+                fa = cfg.use_flash_attention
+                impl = (fa if isinstance(fa, str)
+                        else ("auto" if fa else "dense"))
+                return _fa(q, k, v, causal=True, impl=impl)
+            return _cached_attention(q, ck2, cv2, pos0, cfg)
+
+        h = _block(lp, h, positions, cfg, attn_fn)
+        return h, (cell["ck"], cell["cv"])
+
+    h, (ck_new, cv_new) = lax.scan(
+        body, h, (params["layers"], cache["k"], cache["v"]))
+    h = rms_norm(h[:, -1], params["final_norm"], cfg.rms_norm_eps)
+    logits = h @ params["lm_head"]
+    return logits.astype(jnp.float32), {"k": ck_new, "v": cv_new}
+
+
+def sample_logits(logits, key, temperature: float = 1.0,
+                  top_p: float = 1.0, top_k: int = 0):
+    """[B, V] logits -> [B] token ids (greedy when temperature == 0)."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix with mass >= top_p; the top-1 token is
+        # always kept (top_p=0.0 must degrade to greedy, not to
+        # full-distribution sampling)
+        keep = (cum - probs) < top_p
+        keep = keep.at[:, 0].set(True)
+        cutoff = jnp.max(jnp.where(keep, sorted_logits, -jnp.inf), axis=-1)
+        logits = jnp.where(logits < cutoff[:, None], -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
+             *, temperature: float = 0.0, top_p: float = 1.0,
+             top_k: int = 0, key=None, eos_token_id: Optional[int] = None):
+    """Autoregressive decode with a KV cache.
+
+    prompt: int32 [B, T0]. Returns [B, T0 + max_new_tokens] (prompt +
+    continuation; positions after EOS repeat EOS when eos_token_id set).
+    """
+    B, T0 = prompt.shape
+    key = key if key is not None else jax.random.PRNGKey(0)
+    max_len = T0 + max_new_tokens
+    cache = init_kv_cache(cfg, B, max_len)
+    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    key, sub = jax.random.split(key)
+    tok = sample_logits(logits, sub, temperature, top_p, top_k)
+    done = (jnp.zeros((B,), bool) if eos_token_id is None
+            else tok == eos_token_id)
+
+    def step(carry, _):
+        tok, cache, pos, key, done = carry
+        logits, cache = forward_with_cache(
+            params, tok[:, None], cache, pos, cfg)
+        key, sub = jax.random.split(key)
+        nxt = sample_logits(logits, sub, temperature, top_p, top_k)
+        if eos_token_id is not None:
+            nxt = jnp.where(done, eos_token_id, nxt)
+            done = done | (nxt == eos_token_id)
+        return (nxt, cache, pos + 1, key, done), tok
+
+    (last, _, _, _, _), toks = lax.scan(
+        step, (tok, cache, jnp.int32(T0), key, done),
+        None, length=max_new_tokens - 1)
+    out = jnp.concatenate(
+        [prompt, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
+    return out
 
 
 def make_batch(cfg: LlamaConfig, batch_size: int, seq_len: int, mesh: Mesh,

@@ -1,0 +1,109 @@
+"""KV-cache decode path (models/llama.py forward_with_cache/generate,
+inference.GenerationPredictor).
+
+Reference capability: fused decode attention + generation
+(paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu,
+masked_multihead_attention_kernel.cu behind paddle.inference).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama as L
+
+
+CFG = L.LlamaConfig.tiny(dtype=jnp.float32, use_flash_attention=False,
+                         remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return L.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def test_prefill_logits_match_full_forward(params):
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                              CFG.vocab_size)
+    cache = L.init_kv_cache(CFG, 2, 16)
+    logits, cache2 = L.forward_with_cache(params, toks, cache, 0, CFG)
+    full = L.forward(params, toks, CFG)[:, -1].astype(jnp.float32)
+    np.testing.assert_allclose(logits, full, rtol=2e-4, atol=2e-4)
+    # cache holds the prompt K/V
+    assert not np.allclose(np.asarray(cache2["k"][:, :, :12]), 0)
+    assert np.allclose(np.asarray(cache2["k"][:, :, 12:]), 0)
+
+
+def test_decode_step_matches_full_forward(params):
+    """Incremental decode at position T must equal the last-position
+    logits of a full forward over the T+1 tokens."""
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 9), 0,
+                              CFG.vocab_size)
+    cache = L.init_kv_cache(CFG, 2, 16)
+    _, cache = L.forward_with_cache(params, toks[:, :8], cache, 0, CFG)
+    step_logits, _ = L.forward_with_cache(
+        params, toks[:, 8:9], cache, jnp.int32(8), CFG)
+    full = L.forward(params, toks, CFG)[:, -1].astype(jnp.float32)
+    np.testing.assert_allclose(step_logits, full, rtol=2e-4, atol=2e-4)
+
+
+def test_greedy_generate_matches_stepwise_full_forward(params):
+    """The whole point: cached greedy decode == argmax chain of full
+    (uncached) forwards."""
+    prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 5), 0,
+                                CFG.vocab_size)
+    out = L.generate(params, prompt, CFG, max_new_tokens=6)
+    assert out.shape == (2, 11)
+    np.testing.assert_array_equal(out[:, :5], prompt)
+
+    seq = prompt
+    for _ in range(6):
+        logits = L.forward(params, seq, CFG)[:, -1]
+        nxt = jnp.argmax(logits.astype(jnp.float32), axis=-1)
+        seq = jnp.concatenate([seq, nxt[:, None].astype(jnp.int32)], 1)
+    np.testing.assert_array_equal(out, seq)
+
+
+def test_generate_eos_padding(params):
+    prompt = jax.random.randint(jax.random.PRNGKey(4), (1, 4), 0,
+                                CFG.vocab_size)
+    out = L.generate(params, prompt, CFG, max_new_tokens=8)
+    eos = int(out[0, 4])  # force EOS = the first generated token
+    out2 = L.generate(params, prompt, CFG, max_new_tokens=8,
+                      eos_token_id=eos)
+    assert np.all(np.asarray(out2[0, 4:]) == eos)
+
+
+def test_sampling_valid_and_greedy_limit(params):
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (2, 4), 0,
+                                CFG.vocab_size)
+    out = L.generate(params, prompt, CFG, max_new_tokens=5,
+                     temperature=0.8, top_p=0.9, top_k=16,
+                     key=jax.random.PRNGKey(7))
+    a = np.asarray(out[:, 4:])
+    assert a.min() >= 0 and a.max() < CFG.vocab_size
+    # temperature 0 through the sampling path == greedy
+    g1 = L.generate(params, prompt, CFG, max_new_tokens=5, temperature=0.0)
+    g2 = L.generate(params, prompt, CFG, max_new_tokens=5)
+    np.testing.assert_array_equal(g1, g2)
+
+
+def test_top_p_zero_degrades_to_greedy():
+    """top_p=0 must keep the top token, not disable filtering."""
+    logits = jnp.array([[1.0, 2.0, 3.0, 0.5]])
+    for seed in range(8):
+        tok = L.sample_logits(logits, jax.random.PRNGKey(seed),
+                              temperature=1.0, top_p=0.0)
+        assert int(tok[0]) == 2
+
+
+def test_generation_predictor(params):
+    from paddle_tpu.inference import GenerationPredictor
+    pred = GenerationPredictor(params, CFG, max_len=32)
+    prompt = np.array([[1, 2, 3]], np.int32)
+    out = pred.generate(prompt, max_new_tokens=4)
+    ref = L.generate(params, jnp.asarray(prompt), CFG, max_new_tokens=4)
+    np.testing.assert_array_equal(out, np.asarray(ref))
+    with pytest.raises(ValueError, match="max_len"):
+        pred.generate(np.zeros((1, 30), np.int32), max_new_tokens=4)
