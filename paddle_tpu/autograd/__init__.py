@@ -2,9 +2,11 @@
 from .tape import (backward, grad, no_grad, enable_grad, set_grad_enabled,
                    grad_enabled, GradNode)
 from .pylayer import PyLayer, PyLayerContext
+from .functional import jacobian, hessian, jvp, vjp
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
-           "is_grad_enabled", "PyLayer", "PyLayerContext"]
+           "is_grad_enabled", "PyLayer", "PyLayerContext",
+           "jacobian", "hessian", "jvp", "vjp"]
 
 
 def is_grad_enabled() -> bool:

@@ -1,0 +1,104 @@
+"""Functional higher-order autograd: jacobian / hessian / jvp / vjp.
+
+Reference: python/paddle/autograd/ (paddle.autograd.jacobian, hessian,
+incubate jvp/vjp). TPU-native: the framework's eager ops are pure JAX
+underneath, so a user function over Tensors can be re-traced as a pure
+array function and handed to jax.jacrev/jax.hessian — one compiled
+computation instead of the reference's row-by-row double-grad loops.
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _tensor_cls():
+    from ..core.tensor import Tensor  # deferred: tensor imports the tape
+    return Tensor
+
+
+def _as_pure(func: Callable, n: int) -> Callable:
+    """Wrap a Tensor->Tensor function as a pure array function (the eager
+    ops dispatch fine on traced arrays)."""
+
+    def pure(*arrays):
+        Tensor = _tensor_cls()
+        tensors = [Tensor(a) for a in arrays]
+        out = func(*tensors)
+        if isinstance(out, (list, tuple)):
+            return type(out)(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+        return out._data if isinstance(out, Tensor) else out
+
+    return pure
+
+
+def _unwrap(xs):
+    Tensor = _tensor_cls()
+    single = isinstance(xs, Tensor)
+    xs_list = [xs] if single else list(xs)
+    return single, [x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                    for x in xs_list]
+
+
+def _wrap_tree(tree):
+    Tensor = _tensor_cls()
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a, stop_gradient=True), tree)
+
+
+def jacobian(func: Callable, xs, create_graph: bool = False):
+    """d func / d xs via reverse mode. ``xs``: Tensor or sequence.
+
+    Returns the jacobian pytree (Tensor leaves); for a single input and
+    single output this is one Tensor of shape out_shape + in_shape.
+    """
+    if create_graph:
+        raise NotImplementedError(
+            "jacobian(create_graph=True) is not supported: the result is "
+            "computed functionally and returned detached; differentiate "
+            "a function of jacobian via hessian()/jax transforms instead")
+    single, arrays = _unwrap(xs)
+    jac = jax.jacrev(_as_pure(func, len(arrays)),
+                     argnums=tuple(range(len(arrays))))(*arrays)
+    jac = jac[0] if single else jac
+    return _wrap_tree(jac)
+
+
+def hessian(func: Callable, xs):
+    """d2 func / d xs2 (func must return a scalar)."""
+    single, arrays = _unwrap(xs)
+    hes = jax.hessian(_as_pure(func, len(arrays)),
+                      argnums=tuple(range(len(arrays))))(*arrays)
+    hes = hes[0][0] if single else hes
+    return _wrap_tree(hes)
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode JVP (paddle.incubate.autograd.jvp)."""
+    single, arrays = _unwrap(xs)
+    if v is None:
+        tangents = [jnp.ones_like(a) for a in arrays]
+    else:
+        _, tangents = _unwrap(v)
+    out, tangent_out = jax.jvp(_as_pure(func, len(arrays)),
+                               tuple(arrays), tuple(tangents))
+    return _wrap_tree(out), _wrap_tree(tangent_out)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode VJP (paddle.incubate.autograd.vjp)."""
+    single, arrays = _unwrap(xs)
+    out, pull = jax.vjp(_as_pure(func, len(arrays)), *arrays)
+    Tensor = _tensor_cls()
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = jax.tree_util.tree_map(
+            lambda t: t._data if isinstance(t, Tensor) else jnp.asarray(t),
+            v, is_leaf=lambda t: isinstance(t, Tensor))
+    grads = pull(cot)
+    grads = grads[0] if single else grads
+    return _wrap_tree(out), _wrap_tree(grads)

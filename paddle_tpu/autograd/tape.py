@@ -82,20 +82,25 @@ class GradNode:
     """
 
     __slots__ = ("op_name", "vjp_fn", "inputs", "out_avals", "out_treedef",
-                 "id", "__weakref__")
+                 "id", "pure_fn", "__weakref__")
 
     def __init__(self, op_name: str, vjp_fn: Callable, inputs: Sequence,
-                 out_avals: List, out_treedef):
+                 out_avals: List, out_treedef, pure_fn: Callable = None):
         self.op_name = op_name
         self.vjp_fn = vjp_fn
         self.inputs = list(inputs)  # Tensors (strong refs keep graph alive)
         self.out_avals = out_avals  # [(shape, dtype)] per flat output leaf
         self.out_treedef = out_treedef
+        # the forward closure (primals -> outputs); kept so create_graph
+        # backward can re-express this node's pullback as a fresh taped op
+        # over (primals, cotangents) — the second-order path
+        self.pure_fn = pure_fn
         self.id = next(_seq)
 
     def release(self):
         self.vjp_fn = None
         self.inputs = []
+        self.pure_fn = None
 
 
 def _accumulate(slot, idx, value):
@@ -103,14 +108,50 @@ def _accumulate(slot, idx, value):
     slot[idx] = value if cur is None else cur + value
 
 
-def backward(tensors, grad_tensors=None, retain_graph: bool = False):
+def _node_backward_taped(node, full_cots):
+    """create_graph path: express this node's pullback as a fresh eager op
+    over (primals, cotangents), dispatched through the registry so it is
+    itself recorded on the tape (enabling a further backward — any order).
+    """
+    from ..ops.registry import call_op
+
+    if node.pure_fn is None:
+        raise NotImplementedError(
+            f"create_graph=True cannot differentiate through op "
+            f"'{node.op_name}': it records no forward closure "
+            f"(custom PyLayer backwards are first-order only)")
+    n_in = len(node.inputs)
+    pure_fn = node.pure_fn
+    treedef = node.out_treedef
+
+    def bwd(*vals):
+        primals, cots = vals[:n_in], vals[n_in:]
+        cot_tree = jax.tree_util.tree_unflatten(treedef, list(cots))
+        _, vjp_fn = jax.vjp(pure_fn, *primals)
+        return tuple(vjp_fn(cot_tree))
+
+    out = call_op(f"grad[{node.op_name}]", bwd,
+                  (*node.inputs, *full_cots), {})
+    return out if isinstance(out, tuple) else (out,)
+
+
+def backward(tensors, grad_tensors=None, retain_graph: bool = False,
+             create_graph: bool = False):
     """Run backward from output tensor(s), accumulating into leaf ``.grad``.
 
     Mirrors the reference's ``egr::Backward`` semantics
     (paddle/fluid/eager/backward.cc:439): default cotangent of ones for
     scalar outputs, accumulation into leaves, optional graph retention.
+    With ``create_graph=True`` the backward computation is itself recorded
+    on the tape (higher-order autograd; implies graph retention).
     """
     from ..core.tensor import Tensor  # local import to avoid cycle
+
+    if create_graph:
+        retain_graph = True
+
+    def lift(arr):
+        return Tensor(arr, stop_gradient=True) if create_graph else arr
 
     if isinstance(tensors, Tensor):
         tensors = [tensors]
@@ -120,7 +161,7 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
         grad_tensors = [grad_tensors]
 
     # Seed cotangents.
-    pending = {}  # node -> list[Optional[array]] per output leaf
+    pending = {}  # node -> list[Optional[array-or-Tensor]] per output leaf
     roots = []
     for t, g in zip(tensors, grad_tensors):
         if t.stop_gradient and t._node is None:
@@ -130,12 +171,14 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                 raise RuntimeError(
                     "grad can be implicitly created only for scalar outputs; "
                     f"got shape {t.shape}")
-            g_arr = jnp.ones(t._data.shape, t._data.dtype)
+            g_arr = lift(jnp.ones(t._data.shape, t._data.dtype))
+        elif create_graph:
+            g_arr = g if isinstance(g, Tensor) else lift(jnp.asarray(g))
         else:
             g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
         node = t._node
         if node is None:
-            _leaf_accumulate(t, g_arr)
+            _leaf_accumulate(t, g_arr, create_graph)
             continue
         if node not in pending:
             pending[node] = [None] * len(node.out_avals)
@@ -169,30 +212,42 @@ def backward(tensors, grad_tensors=None, retain_graph: bool = False):
                 f"trying to backward through op '{node.op_name}' a second "
                 "time; set retain_graph=True if you need to")
         # Fill missing output cotangents with zeros.
-        full = [c if c is not None else jnp.zeros(shape, dtype)
+        full = [c if c is not None else lift(jnp.zeros(shape, dtype))
                 for c, (shape, dtype) in zip(cots, node.out_avals)]
-        cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, full)
-        in_grads = node.vjp_fn(cot_tree)
+        if create_graph:
+            in_grads = _node_backward_taped(node, full)
+        else:
+            cot_tree = jax.tree_util.tree_unflatten(node.out_treedef, full)
+            in_grads = node.vjp_fn(cot_tree)
         for inp, g in zip(node.inputs, in_grads):
             g = inp._apply_grad_hooks(g)
             child = inp._node
             if child is None:
-                _leaf_accumulate(inp, g)
+                _leaf_accumulate(inp, g, create_graph)
             else:
                 if child not in pending:
                     pending[child] = [None] * len(child.out_avals)
                 _accumulate(pending[child], inp._out_index, g)
                 if inp._retain_grads:
-                    _leaf_accumulate(inp, g)
+                    _leaf_accumulate(inp, g, create_graph)
         if not retain_graph:
             node.release()
         pending.pop(node, None)
 
 
-def _leaf_accumulate(t, g_arr):
+def _leaf_accumulate(t, g_arr, create_graph: bool = False):
     from ..core.tensor import Tensor
 
     if t.stop_gradient and not t._retain_grads:
+        return
+    if create_graph:
+        g_t = g_arr if isinstance(g_arr, Tensor) else Tensor(
+            g_arr, stop_gradient=True)
+        if g_t._data.dtype != t._data.dtype:
+            # same dtype contract as the first-order path; ops.cast keeps
+            # the grad-of-grad graph intact
+            g_t = g_t.astype(t._data.dtype)
+        t._grad = g_t if t._grad is None else t._grad + g_t
         return
     if g_arr.dtype != t._data.dtype:
         g_arr = g_arr.astype(t._data.dtype)
@@ -211,16 +266,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     """
     from ..core.tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (higher-order eager grad) is not supported yet; "
-            "use paddle_tpu.incubate.functional jax transforms instead")
     if isinstance(outputs, Tensor):
         outputs = [outputs]
     if isinstance(inputs, Tensor):
         inputs = [inputs]
     if retain_graph is None:
-        retain_graph = False
+        retain_graph = bool(create_graph)
 
     # Temporarily stash and clear .grad on the inputs, run backward with
     # retain_grads forced on inputs, then restore.
@@ -229,7 +280,8 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
         for t in inputs:
             t._grad = None
             t._retain_grads = True
-        backward(outputs, grad_outputs, retain_graph=retain_graph)
+        backward(outputs, grad_outputs, retain_graph=retain_graph,
+                 create_graph=create_graph)
         results = []
         for t in inputs:
             if t._grad is None and not allow_unused:

@@ -85,3 +85,4 @@ define_flag("amp_dtype", "bfloat16", "low-precision dtype used by amp.auto_cast 
 define_flag("allocator_strategy", "xla", "memory management is delegated to XLA on TPU")
 define_flag("jit_static_shapes", True, "pad/bucket dynamic batch shapes in jit capture")
 define_flag("use_pallas_kernels", True, "use Pallas kernels for hot ops (flash attention etc.) on TPU")
+define_flag("eager_vjp_cache", True, "cache jitted per-op fwd/vjp by (op, shapes, statics) instead of retracing jax.vjp on every eager call")

@@ -181,12 +181,15 @@ class Tensor:
     def _apply_grad_hooks(self, g_arr):
         if not self._grad_hooks:
             return g_arr
-        g = Tensor(g_arr, stop_gradient=True)
+        # under create_graph the cotangent is already a (taped) Tensor —
+        # keep it one so hooks stay differentiable
+        was_tensor = isinstance(g_arr, Tensor)
+        g = g_arr if was_tensor else Tensor(g_arr, stop_gradient=True)
         for hook in self._grad_hooks:
             out = hook(g)
             if out is not None:
                 g = out if isinstance(out, Tensor) else Tensor(out)
-        return g._data
+        return g if was_tensor else g._data
 
     # -- in-place-style APIs (functional rebind) ----------------------------
     def set_value(self, value):

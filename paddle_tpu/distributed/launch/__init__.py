@@ -1,0 +1,154 @@
+"""paddle_tpu.distributed.launch — multi-process / multi-host launcher.
+
+Reference: python/paddle/distributed/launch/main.py (the ``python -m
+paddle.distributed.launch`` CLI) + context/node/pod plumbing. TPU-native
+redesign: instead of the reference's pod/elastic controller managing
+gloo+NCCL rendezvous, the launcher spawns one process per
+node-or-local-rank, wires the jax.distributed coordination-service env
+(COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID — consumed by
+distributed/env.py init_parallel_env), detects TPU pod environments
+where the runtime already provides topology, and propagates failures:
+any child dying non-zero tears the whole job down (reference behaviour
+of launch's watchdog loop).
+
+Usage:
+    python -m paddle_tpu.distributed.launch --nproc 4 train.py [args...]
+    python -m paddle_tpu.distributed.launch --nnodes 2 --node_rank 0 \
+        --master 10.0.0.1:6379 --nproc 1 train.py
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+
+def tpu_pod_env() -> bool:
+    """True when the runtime already defines the pod topology (GKE/GCE
+    TPU pods): jax.distributed.initialize() then needs no explicit env."""
+    return any(k in os.environ for k in (
+        "TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS",
+        "CLOUD_TPU_TASK_ID"))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="spawn a multi-process job wired for "
+                    "jax.distributed / init_parallel_env")
+    p.add_argument("--nnodes", type=int, default=1,
+                   help="number of nodes (hosts) in the job")
+    p.add_argument("--node_rank", type=int, default=0,
+                   help="rank of this node in [0, nnodes)")
+    p.add_argument("--master", type=str, default=None,
+                   help="coordinator host:port (default: localhost:auto "
+                        "for single-node)")
+    p.add_argument("--nproc", "--nproc_per_node", dest="nproc", type=int,
+                   default=1, help="processes to spawn on this node")
+    p.add_argument("--log_dir", type=str, default=None,
+                   help="write per-rank stdout/stderr to "
+                        "<log_dir>/workerlog.<rank>")
+    p.add_argument("--env", action="append", default=[],
+                   help="extra KEY=VALUE env for the children")
+    p.add_argument("script", help="training script to run")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p
+
+
+def launch(args: Optional[List[str]] = None) -> int:
+    ns = build_parser().parse_args(args)
+    world = ns.nnodes * ns.nproc
+    master = ns.master
+    if master is None:
+        if ns.nnodes > 1:
+            raise SystemExit("--master host:port is required for "
+                             "multi-node jobs")
+        master = f"127.0.0.1:{_free_port()}"
+
+    procs: List[subprocess.Popen] = []
+    logs = []
+    base_rank = ns.node_rank * ns.nproc
+    for local_rank in range(ns.nproc):
+        rank = base_rank + local_rank
+        env = dict(os.environ)
+        # the launcher was invoked, so ITS topology wins — even on a TPU
+        # pod whose runtime env (tpu_pod_env()) could provide one; pod
+        # users who want the runtime topology run their script directly
+        env.update({
+            "COORDINATOR_ADDRESS": master,
+            "NUM_PROCESSES": str(world),
+            "PROCESS_ID": str(rank),
+        })
+        env.update({
+            # reference-compatible views (ParallelEnv reads these)
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_CURRENT_ENDPOINT": f"{socket.gethostname()}:{rank}",
+        })
+        for kv in ns.env:
+            k, _, v = kv.partition("=")
+            env[k] = v
+        out = None
+        if ns.log_dir:
+            os.makedirs(ns.log_dir, exist_ok=True)
+            out = open(os.path.join(ns.log_dir,
+                                    f"workerlog.{rank}"), "wb")
+            logs.append(out)
+        procs.append(subprocess.Popen(
+            [sys.executable, "-u", ns.script, *ns.script_args],
+            env=env, stdout=out, stderr=out))
+
+    rc = _watch(procs)
+    for f in logs:
+        f.close()
+    return rc
+
+
+def _watch(procs: List[subprocess.Popen]) -> int:
+    """Failure propagation (reference launch watchdog): first non-zero
+    exit kills every other worker and becomes the job's exit code."""
+    try:
+        while True:
+            alive = False
+            for p in procs:
+                code = p.poll()
+                if code is None:
+                    alive = True
+                elif code != 0:
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    deadline = time.time() + 10
+                    for q in procs:
+                        try:
+                            q.wait(timeout=max(0.1,
+                                               deadline - time.time()))
+                        except subprocess.TimeoutExpired:
+                            q.kill()
+                    return code
+            if not alive:
+                return 0
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        for q in procs:
+            if q.poll() is None:
+                q.send_signal(signal.SIGINT)
+        for q in procs:
+            q.wait()
+        return 130
+
+
+def main() -> None:
+    raise SystemExit(launch())

@@ -14,6 +14,7 @@ layout.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Optional
 
 import jax
@@ -22,15 +23,35 @@ from jax.sharding import NamedSharding, PartitionSpec
 from ..parallel.mesh import get_hybrid_mesh
 
 
-def _dp_shard(t) -> bool:
-    """Apply a dim-0 dp sharding to tensor ``t`` when divisible."""
+def zero_spec(base_spec, shape, dp: int, axis: str = "dp"):
+    """ZeRO layout for one array: shard the first dp-divisible,
+    not-already-sharded dim over the dp axis; None when no dim qualifies
+    (caller decides whether that is a warning or an error)."""
+    names = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    for i, (n, s) in enumerate(zip(names, shape)):
+        if n is None and s and s % dp == 0:
+            names[i] = axis
+            return PartitionSpec(*names)
+    return None
+
+
+def _dp_shard(t, strict: bool = False) -> bool:
+    """Apply a ZeRO dp sharding to tensor ``t``. Never a silent no-op:
+    an unshardable array warns (or raises with ``strict``) and stays
+    replicated."""
     hm = get_hybrid_mesh()
     if hm is None or hm.dp_degree <= 1 or t is None:
         return False
     shape = t.data.shape
-    if not shape or shape[0] % hm.dp_degree:
+    spec = zero_spec(PartitionSpec(), shape, hm.dp_degree)
+    if spec is None:
+        msg = (f"ZeRO: array of shape {shape} has no dim divisible by "
+               f"dp={hm.dp_degree}; it stays replicated on every device")
+        if strict:
+            raise ValueError(msg)
+        if shape:  # scalars replicate by design, no need to warn
+            warnings.warn(msg)
         return False
-    spec = PartitionSpec(*(["dp"] + [None] * (len(shape) - 1)))
     t.data = jax.device_put(t.data, NamedSharding(hm.mesh, spec))
     return True
 

@@ -385,27 +385,73 @@ def grads_1f1b(params, batch, cfg: LlamaConfig, mesh: Mesh):
     return loss, grads
 
 
-def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None):
+def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None,
+                    zero_stage: int = 0):
     """Build the jitted SPMD train step (fwd+bwd+adamw) over ``mesh``.
 
-    Returns (step_fn, init_fn). ``init_fn(key, lr)`` places params and
-    optimizer state sharded on the mesh (optimizer state inherits the param
-    sharding = ZeRO-style sharded state, dygraph_sharding_optimizer.py:48
-    equivalent comes free); ``step_fn(state, batch)`` is one update.
+    Returns (step_fn, init_fn). ``init_fn(key)`` places params and
+    optimizer state sharded on the mesh; ``step_fn(state, batch)`` is one
+    update.
+
+    zero_stage (reference: fleet group-sharded stages,
+    dygraph_sharding_optimizer.py:48 / group_sharded_stage3.py):
+      0 — optimizer state inherits the param (tp/pp) sharding only.
+      1 — optimizer moments additionally sharded over dp (ZeRO-1).
+      2 — same layout as 1; gradients arrive reduce-scattered into the
+          dp-sharded layout because the only consumer (the sharded
+          update) demands it — asserted on HLO in tests.
+      3 — parameters themselves dp-sharded too; GSPMD all-gathers at
+          use (ZeRO-3).
     """
     import optax
     if optimizer is None:
         optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+    if zero_stage not in (0, 1, 2, 3):
+        raise ValueError(f"zero_stage must be 0..3, got {zero_stage}")
 
     use_1f1b = cfg.pp_stages > 1 and cfg.pp_schedule == "1f1b"
     if cfg.pp_schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"pp_schedule must be 'gpipe' or '1f1b', "
                          f"got {cfg.pp_schedule!r}")
 
+    def _zero_place(tree, base_specs):
+        """dp-shard every leaf on its first free divisible dim, on top of
+        the existing tp/pp layout."""
+        from ..distributed.sharding import zero_spec
+
+        def place(x, spec):
+            zs = zero_spec(spec, x.shape, mesh.shape.get("dp", 1))
+            if zs is None:
+                return x  # scalars / unshardable: replicated
+            return jax.device_put(x, NamedSharding(mesh, zs))
+        return jax.tree_util.tree_map(place, tree, base_specs,
+                                      is_leaf=lambda x: isinstance(x, P))
+
     def init_fn(key):
         params = init_params(cfg, key)
         params = shard_params(params, cfg, mesh)
+        specs = param_specs(cfg)
+        if zero_stage >= 3:
+            params = _zero_place(params, specs)
         opt_state = optimizer.init(params)
+        if zero_stage >= 1 and mesh.shape.get("dp", 1) > 1:
+            # optimizer.init already gave every moment its param's (tp/pp)
+            # sharding; add the dp dim on top of each leaf's OWN current
+            # spec (matching params by shape would mis-place same-shape,
+            # differently-sharded weights)
+            from ..distributed.sharding import zero_spec
+
+            def place(x):
+                if not hasattr(x, "shape") or not x.shape:
+                    return x  # scalars (step counts) stay replicated
+                cur = (x.sharding.spec
+                       if isinstance(getattr(x, "sharding", None),
+                                     NamedSharding) else P())
+                zs = zero_spec(cur, x.shape, mesh.shape["dp"])
+                if zs is None:
+                    return x
+                return jax.device_put(x, NamedSharding(mesh, zs))
+            opt_state = jax.tree_util.tree_map(place, opt_state)
         return {"params": params, "opt": opt_state, "step": jnp.zeros((), jnp.int32)}
 
     @partial(jax.jit, donate_argnums=(0,))
@@ -523,7 +569,9 @@ def sample_logits(logits, key, temperature: float = 1.0,
         # full-distribution sampling)
         keep = (cum - probs) < top_p
         keep = keep.at[:, 0].set(True)
-        cutoff = jnp.max(jnp.where(keep, sorted_logits, -jnp.inf), axis=-1)
+        # cutoff = SMALLEST kept logit (min, not max — the max would mask
+        # everything below the argmax and silently degenerate to greedy)
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf), axis=-1)
         logits = jnp.where(logits < cutoff[:, None], -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 

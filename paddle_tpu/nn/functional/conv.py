@@ -199,12 +199,15 @@ def _pool(x, ksize, stride, padding, spatial, data_format, reducer, init,
             padding_cfg = [(0, 0), (0, 0)] + full
     if init == -jnp.inf:
         # floats must use -inf: reduce_window's VJP only recognises the
-        # max monoid with its identity as init
-        init_val = (jnp.asarray(-jnp.inf, x.dtype)
+        # max monoid with its identity as init. The init must be a
+        # CONCRETE numpy scalar — a jnp value becomes a tracer when this
+        # runs under an outer jit (e.g. the eager vjp cache's jitted
+        # backward) and reduce_window's linearization then rejects it
+        init_val = (np.asarray(-np.inf, x.dtype)
                     if jnp.issubdtype(x.dtype, jnp.floating)
-                    else jnp.iinfo(x.dtype).min)
+                    else np.asarray(jnp.iinfo(x.dtype).min, x.dtype))
     else:
-        init_val = jnp.asarray(init, x.dtype)
+        init_val = np.asarray(init, x.dtype)
     out = jax.lax.reduce_window(x, init_val, reducer, dims, strides,
                                 padding_cfg)
     if average:

@@ -78,6 +78,129 @@ def _collect_tensors(args, kwargs):
     return out
 
 
+# ---------------------------------------------------------------------------
+# eager vjp cache
+#
+# A fresh jax.vjp trace per eager op call costs hundreds of µs of pure
+# Python/tracing overhead (the reference's entire L3/L4 C++ design exists
+# to dodge the analogous cost). Caching key: (op, call structure, avals
+# of every tensor leaf, static leaf values). Hit => dispatch goes through
+# pre-jitted fwd/bwd callables whose own tracing happened once; the bwd
+# re-runs the (tiny, eager-sized) forward inside to rebuild residuals —
+# per-op remat, which is cheaper than per-call retracing for every eager
+# workload we measured (tools/eager_bench.py, docs/PERF.md).
+# ---------------------------------------------------------------------------
+
+_VJP_CACHE: Dict = {}
+_VJP_SEEN: set = set()
+_VJP_CACHE_MAX = 4096
+
+
+def _flatten_call(args, kwargs):
+    """Flatten (args, kwargs) into (treedef, tensor_leaves, static_leaves,
+    tensor_positions). Tensors are leaves; everything else is a static
+    leaf keyed by value."""
+    leaves, treedef = jax.tree_util.tree_flatten(
+        (args, kwargs), is_leaf=_is_tensor)
+    tensor_pos = [i for i, l in enumerate(leaves) if _is_tensor(l)]
+    tensors = [leaves[i] for i in tensor_pos]
+    statics = tuple(l for l in leaves if not _is_tensor(l))
+    return treedef, leaves, tensors, statics, tuple(tensor_pos)
+
+
+def _cache_key(name, fn, treedef, tensors, diff_mask, statics, tensor_pos):
+    """The key INCLUDES fn's identity: some APIs build a fresh closure
+    per call (dropout's PRNG key, interpolate's size, the create_graph
+    grad[...] closures) — keying on the name alone would replay the
+    first call's baked-in constants on every hit."""
+    try:
+        avals = tuple((t._data.shape, str(t._data.dtype)) for t in tensors)
+        return (name, fn, treedef, avals, diff_mask, statics, tensor_pos,
+                hash(statics))
+    except TypeError:
+        return None  # unhashable static arg: fall back to uncached path
+
+
+def _build_cached(name, fn, treedef, leaves_template, tensor_pos,
+                  diff_mask):
+    """Build jitted fwd / bwd for one (structure, avals, statics) class."""
+    n_tensors = len(tensor_pos)
+
+    def rebuild(tensor_arrays):
+        leaves = list(leaves_template)
+        for p, arr in zip(tensor_pos, tensor_arrays):
+            leaves[p] = arr
+        args, kwargs = jax.tree_util.tree_unflatten(treedef, leaves)
+        return fn(*args, **kwargs)
+
+    def fwd(tensor_arrays):
+        return rebuild(tensor_arrays)
+
+    def bwd(tensor_arrays, cot_tree):
+        def pure(*diff_arrays):
+            it = iter(diff_arrays)
+            full = [next(it) if d else a
+                    for d, a in zip(diff_mask, tensor_arrays)]
+            return rebuild(full)
+
+        primals = [a for d, a in zip(diff_mask, tensor_arrays) if d]
+        _, vjp_fn = jax.vjp(pure, *primals)
+        return vjp_fn(cot_tree)
+
+    return jax.jit(fwd), jax.jit(bwd)
+
+
+def _call_op_cached(name, fn, args, kwargs, diff, tensors):
+    treedef, leaves, tensors2, statics, tensor_pos = _flatten_call(
+        args, kwargs)
+    diff_ids = {id(t) for t in diff}
+    diff_mask = tuple(id(t) in diff_ids for t in tensors2)
+    key = _cache_key(name, fn, treedef, tensors2, diff_mask, statics,
+                     tensor_pos)
+    if key is None:
+        return None
+    entry = _VJP_CACHE.get(key)
+    if entry is None:
+        # build only on the SECOND occurrence of a key: per-call closure
+        # fns (fresh object every call) then never trigger a build, and
+        # stable keys amortise theirs from call 2 on
+        if key not in _VJP_SEEN:
+            if len(_VJP_SEEN) > _VJP_CACHE_MAX:
+                _VJP_SEEN.clear()
+            _VJP_SEEN.add(key)
+            return None
+        if len(_VJP_CACHE) > _VJP_CACHE_MAX:
+            _VJP_CACHE.clear()
+        # template: static leaves keep their values; tensor slots are
+        # None placeholders (storing first-call arrays would pin those
+        # device buffers for the cache entry's lifetime) — every tensor
+        # slot is overwritten by rebuild() before use
+        template = [None if _is_tensor(l) else l for l in leaves]
+        entry = _build_cached(name, fn, treedef, template, tensor_pos,
+                              diff_mask)
+        _VJP_CACHE[key] = entry
+    fwd_jit, bwd_jit = entry
+    arrays = [t._data for t in tensors2]
+    out = fwd_jit(arrays)
+
+    flat, treedef_out = jax.tree_util.tree_flatten(out)
+    avals = [(o.shape, o.dtype) for o in flat]
+    diff_list = [t for t, d in zip(tensors2, diff_mask) if d]
+
+    def vjp_fn(cot_tree, _arrays=arrays):
+        return bwd_jit(_arrays, cot_tree)
+
+    def pure_fn(*diff_arrays, _arrays=arrays):
+        it = iter(diff_arrays)
+        full = [next(it) if d else a
+                for d, a in zip(diff_mask, _arrays)]
+        return fwd_jit(full)
+
+    node = _tape.GradNode(name, vjp_fn, diff_list, avals, treedef_out,
+                          pure_fn=pure_fn)
+    return _wrap_outputs(name, out, node=node)
+
+
 def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
             differentiable: bool = True):
     """Eager-dispatch `fn` (pure JAX) over possibly-Tensor args."""
@@ -93,9 +216,18 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
         out = fn(*uw_args, **uw_kwargs)
         return _wrap_outputs(name, out, node=None)
 
-    # Differentiable path: inputs needing grad become vjp primals, the rest
-    # are closed over as constants.
     diff = [t for t in tensors if not t.stop_gradient or t._node is not None]
+
+    if get_flag("eager_vjp_cache"):
+        try:
+            res = _call_op_cached(name, fn, args, kwargs, diff, tensors)
+        except (TypeError, ValueError):
+            res = None  # untraceable structure: uncached fallback
+        if res is not None:
+            return res
+
+    # Uncached path: inputs needing grad become vjp primals, the rest
+    # are closed over as constants.
     diff_ids = {id(t): i for i, t in enumerate(diff)}
 
     def pure(*primals):
@@ -112,7 +244,8 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
 
     flat, treedef = jax.tree_util.tree_flatten(out)
     avals = [(o.shape, o.dtype) for o in flat]
-    node = _tape.GradNode(name, vjp_fn, diff, avals, treedef)
+    node = _tape.GradNode(name, vjp_fn, diff, avals, treedef,
+                          pure_fn=pure)
     return _wrap_outputs(name, out, node=node)
 
 

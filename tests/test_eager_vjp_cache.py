@@ -1,0 +1,71 @@
+"""Eager vjp cache (ops/registry.py FLAGS_eager_vjp_cache).
+
+Regression focus: the cache key must include the op's function identity
+— APIs that build a fresh closure per call (dropout's PRNG key) must
+never replay a cached first call's baked-in constants.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.ops import registry
+
+
+def test_cache_hits_for_registered_ops():
+    registry._VJP_CACHE.clear()
+    registry._VJP_SEEN.clear()
+    x = pt.to_tensor(np.random.randn(8, 8).astype("float32"),
+                     stop_gradient=False)
+    for _ in range(3):
+        y = (x * 2.0).sum()
+        y.backward()
+        x.clear_grad()
+    assert len(registry._VJP_CACHE) >= 1  # built on the 2nd occurrence
+
+
+def test_dropout_mask_changes_across_calls():
+    """The bug class the fn-identity key prevents: dropout closes over a
+    fresh PRNG key per call; a name+shape-keyed cache would freeze the
+    first mask (and silently disable regularization)."""
+    x = pt.to_tensor(np.ones((64, 64), np.float32), stop_gradient=False)
+    outs = [pt.nn.functional.dropout(x, p=0.5, training=True).numpy()
+            for _ in range(4)]
+    masks = [o != 0 for o in outs]
+    assert any(not np.array_equal(masks[0], m) for m in masks[1:]), \
+        "dropout produced the identical mask on every call"
+
+
+def test_grad_correct_with_cache_on_and_off():
+    vals = {}
+    for flag in (True, False):
+        pt.set_flags({"FLAGS_eager_vjp_cache": flag})
+        try:
+            x = pt.to_tensor(np.array([1.0, 2.0], np.float32),
+                             stop_gradient=False)
+            for _ in range(3):  # 3rd call exercises a cache hit
+                x.clear_grad()
+                y = (x * x * 3.0).sum()
+                y.backward()
+            vals[flag] = x.grad.numpy()
+        finally:
+            pt.set_flags({"FLAGS_eager_vjp_cache": True})
+    np.testing.assert_allclose(vals[True], vals[False], rtol=1e-6)
+    np.testing.assert_allclose(vals[True], 6 * np.array([1.0, 2.0]),
+                               rtol=1e-6)
+
+
+def test_top_p_is_a_distribution_not_greedy():
+    """top_p in (0, 1) must sample from the nucleus, not collapse to
+    argmax (the max-vs-min cutoff regression)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import sample_logits
+    # two strong tokens (p ~ .49/.45), one weak (p ~ .06)
+    logits = jnp.log(jnp.array([[0.49, 0.45, 0.06]]))
+    seen = set()
+    for seed in range(64):
+        tok = sample_logits(logits, jax.random.PRNGKey(seed),
+                            temperature=1.0, top_p=0.9)
+        seen.add(int(tok[0]))
+    assert 0 in seen and 1 in seen, f"nucleus collapsed: {seen}"
+    assert 2 not in seen, f"token outside the nucleus sampled: {seen}"

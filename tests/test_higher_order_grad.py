@@ -1,0 +1,120 @@
+"""Higher-order autograd: create_graph double backward + functional
+jacobian/hessian/jvp/vjp (autograd/tape.py, autograd/functional.py).
+
+Reference capability: paddle.grad(create_graph=True) (GeneralGrad,
+paddle/fluid/eager/backward.cc) and python/paddle/autograd functional
+transforms.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import autograd as AG
+
+
+def test_double_backward_cubic():
+    x = pt.to_tensor(np.array([2.0, 3.0], np.float32), stop_gradient=False)
+    y = (x * x * x).sum()  # y = sum(x^3)
+    (g1,) = AG.grad(y, [x], create_graph=True)
+    np.testing.assert_allclose(g1.numpy(), 3 * np.array([4.0, 9.0]),
+                               rtol=1e-6)
+    g1sum = g1.sum()
+    (g2,) = AG.grad(g1sum, [x])
+    np.testing.assert_allclose(g2.numpy(), 6 * np.array([2.0, 3.0]),
+                               rtol=1e-6)
+
+
+def test_double_backward_via_backward():
+    x = pt.to_tensor(np.array(1.5, np.float32), stop_gradient=False)
+    y = x * x * x * x  # x^4
+    AG.backward(y, create_graph=True)
+    g1 = x.grad  # 4x^3, carries graph
+    x.clear_grad()
+    AG.backward(g1.sum())
+    # d(4x^3)/dx = 12 x^2
+    np.testing.assert_allclose(x.grad.numpy(), 12 * 1.5 ** 2, rtol=1e-6)
+
+
+def test_third_order():
+    x = pt.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+    y = x * x * x * x          # x^4
+    (g1,) = AG.grad(y, [x], create_graph=True)     # 4x^3
+    (g2,) = AG.grad(g1, [x], create_graph=True)    # 12x^2
+    (g3,) = AG.grad(g2, [x])                       # 24x
+    np.testing.assert_allclose(g3.numpy(), 48.0, rtol=1e-6)
+
+
+def test_mixed_partials():
+    x = pt.to_tensor(np.array(2.0, np.float32), stop_gradient=False)
+    y = pt.to_tensor(np.array(5.0, np.float32), stop_gradient=False)
+    z = x * x * y
+    (gx,) = AG.grad(z, [x], create_graph=True)     # 2xy
+    (gxy,) = AG.grad(gx, [y])                      # d(2xy)/dy = 2x
+    np.testing.assert_allclose(gxy.numpy(), 4.0, rtol=1e-6)
+
+
+def test_jacobian_matches_closed_form():
+    def f(x):
+        return x * x * 3.0
+
+    x = pt.to_tensor(np.array([1.0, 2.0, -1.0], np.float32))
+    J = AG.jacobian(f, x)
+    np.testing.assert_allclose(J.numpy(),
+                               np.diag(6 * np.array([1.0, 2.0, -1.0])),
+                               rtol=1e-6)
+
+
+def test_jacobian_numeric_check():
+    def f(x):
+        return pt.tanh(x).sum() * pt.exp(x * 0.1).sum()
+
+    x0 = np.array([0.3, -0.7, 1.2], np.float32)
+    J = AG.jacobian(f, pt.to_tensor(x0)).numpy()
+    eps = 1e-3
+    for i in range(3):
+        xp, xm = x0.copy(), x0.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        fd = (float(f(pt.to_tensor(xp)).numpy())
+              - float(f(pt.to_tensor(xm)).numpy())) / (2 * eps)
+        np.testing.assert_allclose(J[i], fd, rtol=2e-3, atol=2e-3)
+
+
+def test_hessian_symmetric_and_correct():
+    def f(x):
+        return (x[0] ** 2) * x[1] + x[1] ** 3
+
+    x0 = np.array([1.0, 2.0], np.float32)
+    H = AG.hessian(f, pt.to_tensor(x0)).numpy()
+    want = np.array([[2 * 2.0, 2 * 1.0], [2 * 1.0, 6 * 2.0]])
+    np.testing.assert_allclose(H, want, rtol=1e-5)
+    np.testing.assert_allclose(H, H.T, rtol=1e-6)
+
+
+def test_jvp_vjp_consistency():
+    def f(x):
+        return x * x
+
+    x = pt.to_tensor(np.array([1.0, 4.0], np.float32))
+    v = pt.to_tensor(np.array([1.0, 0.5], np.float32))
+    out, tangent = AG.jvp(f, x, v)
+    np.testing.assert_allclose(tangent.numpy(), [2.0, 4.0], rtol=1e-6)
+    out2, grads = AG.vjp(f, x, v)
+    np.testing.assert_allclose(grads.numpy(), [2.0, 4.0], rtol=1e-6)
+    np.testing.assert_allclose(out.numpy(), out2.numpy())
+
+
+def test_create_graph_through_pylayer_raises():
+    class Double(AG.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, g):
+            return g * 2
+
+    x = pt.to_tensor(np.array(3.0, np.float32), stop_gradient=False)
+    y = Double.apply(x)
+    with pytest.raises(NotImplementedError, match="PyLayer|forward closure"):
+        AG.grad(y, [x], create_graph=True)

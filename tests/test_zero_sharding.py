@@ -1,0 +1,132 @@
+"""ZeRO stage 1/2/3 layout + memory proofs (distributed/sharding.py and
+llama make_train_step zero_stage).
+
+Reference capability: fleet group-sharded stages
+(dygraph_sharding_optimizer.py:48, group_sharded_stage2/3.py). The TPU
+formulation is a layout; these tests prove the layout is real: shard
+specs on the 8-device mesh, per-device bytes shrinking by the dp degree,
+gradients reduce-scattered (not all-reduced to full) in the compiled
+HLO, and numerics unchanged vs the replicated baseline.
+"""
+import re
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.parallel import init_hybrid_mesh
+
+
+CFG = L.LlamaConfig.tiny(dtype=jnp.float32, use_flash_attention=False,
+                         remat=False)
+
+
+def _per_device_bytes(tree):
+    leaves = [x for x in jax.tree_util.tree_leaves(tree)
+              if hasattr(x, "addressable_shards")]
+    dev0 = leaves[0].addressable_shards[0].device
+    total = 0
+    for x in leaves:
+        for sh in x.addressable_shards:
+            if sh.device == dev0:
+                total += sh.data.size * sh.data.dtype.itemsize
+    return total
+
+
+def _state(zero_stage, dp=8):
+    hm = init_hybrid_mesh(dp=dp, pp=1, tp=1, set_global=False)
+    with hm.mesh:
+        step, init = L.make_train_step(CFG, hm.mesh,
+                                       zero_stage=zero_stage)
+        state = init(jax.random.PRNGKey(0))
+        batch = L.make_batch(CFG, batch_size=8, seq_len=16, mesh=hm.mesh)
+    return hm, step, state, batch
+
+
+def test_zero1_opt_state_sharded_over_dp():
+    hm, _, state, _ = _state(zero_stage=1)
+    mu = state["opt"][0].mu  # adamw first moment, mirrors params
+    lm_mu = mu["lm_head"]
+    assert "dp" in jax.tree_util.tree_leaves(
+        [lm_mu.sharding.spec])[0:] or "dp" in tuple(lm_mu.sharding.spec)
+    # per-device bytes shrink ~8x vs replicated (scalars excluded)
+    base = _per_device_bytes(_state(zero_stage=0)[2]["opt"])
+    z1 = _per_device_bytes(state["opt"])
+    assert z1 < base / 4, (z1, base)
+
+
+def test_zero3_params_sharded_and_memory_shrinks():
+    hm, _, state, _ = _state(zero_stage=3)
+    specs = jax.tree_util.tree_map(
+        lambda x: x.sharding.spec, state["params"])
+    flat = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, P))
+    assert any("dp" in tuple(s) for s in flat if isinstance(s, P))
+    base = _per_device_bytes(_state(zero_stage=0)[2]["params"])
+    z3 = _per_device_bytes(state["params"])
+    assert z3 < base / 4, (z3, base)
+
+
+def test_zero2_grads_reduce_scattered_in_hlo():
+    """Stage 2's claim: grads land in the dp-sharded layout via a
+    scatter-style collective. GSPMD lowers reduce-scatter either as a
+    literal reduce-scatter op (TPU) or as all-to-all + local add (the
+    CPU SPMD partitioner); both prove the grads are never kept as a
+    full replicated array at the optimizer update."""
+    hm, step, state, batch = _state(zero_stage=2)
+    with hm.mesh:
+        compiled = jax.jit(step.__wrapped__, donate_argnums=(0,)).lower(
+            state, batch).compile()
+    hlo = compiled.as_text()
+    assert ("reduce-scatter" in hlo) or ("all-to-all" in hlo), \
+        "expected a scatter-style grad collective for ZeRO-2"
+    # semantic check: the updated optimizer moments come out dp-sharded
+    new_state, _ = step(state, batch)
+    mu = new_state["opt"][0].mu["lm_head"]
+    assert "dp" in tuple(mu.sharding.spec), mu.sharding
+
+
+@pytest.mark.parametrize("stage", [1, 3])
+def test_zero_numerics_match_replicated(stage):
+    _, step0, state0, batch = _state(zero_stage=0)
+    _, stepz, statez, _ = _state(zero_stage=stage)
+    s0, l0 = step0(state0, batch)
+    sz, lz = stepz(statez, batch)
+    np.testing.assert_allclose(np.asarray(l0), np.asarray(lz),
+                               rtol=1e-5, atol=1e-6)
+    p0 = jax.tree_util.tree_leaves(s0["params"])[0]
+    pz = jax.tree_util.tree_leaves(sz["params"])[0]
+    np.testing.assert_allclose(np.asarray(p0), np.asarray(pz),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_dp_shard_warns_instead_of_silent_noop():
+    import paddle_tpu as pt
+    from paddle_tpu.distributed.sharding import _dp_shard
+    from paddle_tpu.parallel.mesh import init_hybrid_mesh as ihm
+    ihm(dp=8, pp=1, tp=1, set_global=True)
+    try:
+        t = pt.to_tensor(np.zeros((7, 3), np.float32))  # 7 % 8 != 0
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            ok = _dp_shard(t)
+        assert not ok
+        assert any("replicated" in str(x.message) for x in w)
+        with pytest.raises(ValueError, match="replicated"):
+            _dp_shard(t, strict=True)
+    finally:
+        from paddle_tpu.parallel import mesh as _m
+        _m._GLOBAL_MESH = None
+
+
+def test_zero_spec_picks_first_free_divisible_dim():
+    from paddle_tpu.distributed.sharding import zero_spec
+    assert tuple(zero_spec(P(None, "tp"), (32, 64), 8)) == ("dp", "tp")
+    assert tuple(zero_spec(P("tp"), (32, 64), 8)) == ("tp", "dp")
+    assert zero_spec(P(), (7, 9), 8) is None
+    assert zero_spec(P(), (), 8) is None
