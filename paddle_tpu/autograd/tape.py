@@ -240,6 +240,9 @@ def _leaf_accumulate(t, g_arr, create_graph: bool = False):
 
     if t.stop_gradient and not t._retain_grads:
         return
+    raw = g_arr._data if isinstance(g_arr, Tensor) else g_arr
+    if jax.dtypes.result_type(raw) == jax.dtypes.float0:
+        return  # integer/bool leaf: jax's symbolic zero cotangent
     if create_graph:
         g_t = g_arr if isinstance(g_arr, Tensor) else Tensor(
             g_arr, stop_gradient=True)

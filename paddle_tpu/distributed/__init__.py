@@ -33,12 +33,12 @@ from .env import (
     ParallelEnv,
 )
 from . import fleet
+from . import auto_tuner
 from .parallel import DataParallel
+from .watchdog import Watchdog
 
 
-def launch():
-    raise NotImplementedError(
-        "use standard jax multi-host launch: one python process per host, "
-        "paddle_tpu.distributed.init_parallel_env() calls "
-        "jax.distributed.initialize() (coordination service replaces the "
-        "reference's TCPStore rendezvous)")
+# the process launcher lives in the `launch` subpackage (CLI:
+# ``python -m paddle_tpu.distributed.launch``), mirroring
+# paddle.distributed.launch being a module
+from . import launch  # noqa: E402

@@ -1,0 +1,12 @@
+"""Static auto-parallel: the Engine (plan -> shard -> jitted SPMD train).
+
+Reference: python/paddle/distributed/auto_parallel/static/engine.py:97
+(Engine.__init__) and :1450 (fit) — there, a static program is planned
+(completer/planner), partitioned per-rank, and executed by the fleet
+executor. TPU-native redesign: the "plan" is a set of NamedShardings
+chosen by a rule-based planner, "partitioning" is GSPMD's job, and the
+"executor" is one jitted step function.
+"""
+from .engine import Engine, Strategy
+
+__all__ = ["Engine", "Strategy"]

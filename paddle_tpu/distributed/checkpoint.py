@@ -33,17 +33,40 @@ def _to_arrays(state_dict: Dict[str, Any]):
             for k, v in state_dict.items()}
 
 
+_ASYNC_CKPT = None
+
+
+def _async_checkpointer():
+    """One shared AsyncCheckpointer: its save() waits for its OWN
+    previous commit, so successive async saves are serialized instead of
+    racing each other on the filesystem (and its background resources
+    are reused rather than leaked per call)."""
+    global _ASYNC_CKPT
+    if _ASYNC_CKPT is None:
+        _ASYNC_CKPT = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    return _ASYNC_CKPT
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     async_save: bool = False):
+    """Save (optionally async). With ``async_save`` the call returns as
+    soon as the arrays are staged to host memory and a background thread
+    owns the filesystem write (reference: save_state_dict.py:35-56 async
+    queue). Call ``.wait_until_finished()`` on the returned checkpointer
+    before READING the files; back-to-back async saves are safe (the
+    shared checkpointer serializes its own commits)."""
     if not _HAS_ORBAX:
         raise RuntimeError("orbax-checkpoint is required for sharded save")
     path = os.path.abspath(path)
-    ckpt = ocp.StandardCheckpointer()
     arrays = _to_arrays(state_dict)
+    if async_save:
+        ckpt = _async_checkpointer()
+        ckpt.save(path, args=ocp.args.StandardSave(arrays), force=True)
+        return ckpt  # caller may wait_until_finished()
+    ckpt = ocp.StandardCheckpointer()
     ckpt.save(path, arrays, force=True)
-    if not async_save:
-        ckpt.wait_until_finished()
+    ckpt.wait_until_finished()
     return ckpt
 
 

@@ -1,0 +1,134 @@
+"""Training watchdog: hang / stall detection for collective steps.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.cc:43-59 — a
+loop thread that watches outstanding NCCL comm tasks and aborts the
+communicator (with a rank/op dump) when one exceeds its timeout.
+
+TPU-native reshaping: XLA owns the collectives inside one jitted step,
+so the observable unit is the STEP, not the individual collective. The
+watchdog is a daemon thread fed by step heartbeats; if no heartbeat
+lands within ``timeout``, it fires: dumps the live Python stacks of
+every thread (the analogue of the reference's comm-task dump — it shows
+where the host is stuck: dispatch, host callback, data loader, ...) and
+either invokes a user callback or hard-aborts the process so a job
+scheduler / launcher (distributed.launch propagates first-failure) can
+restart the pod.
+
+Usage::
+
+    wd = Watchdog(timeout=300, on_timeout="abort")
+    wd.start()
+    for batch in loader:
+        state, loss = step(state, batch)
+        wd.heartbeat(step=int(state["step"]))
+    wd.stop()
+"""
+from __future__ import annotations
+
+import faulthandler
+import io
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional, Union
+
+
+class Watchdog:
+    """Heartbeat-timeout stall detector for the training loop."""
+
+    def __init__(self, timeout: float = 300.0,
+                 on_timeout: Union[str, Callable] = "abort",
+                 check_interval: Optional[float] = None,
+                 log_stream=None):
+        """on_timeout: "abort" (dump stacks + os.abort), "raise_in_main"
+        (dump + interrupt the main thread), or a callable(info_dict)."""
+        if timeout <= 0:
+            raise ValueError("timeout must be positive")
+        self.timeout = float(timeout)
+        self.on_timeout = on_timeout
+        self.check_interval = check_interval or max(timeout / 10.0, 0.05)
+        self._log = log_stream or sys.stderr
+        self._last = time.monotonic()
+        self._last_step = None
+        self._stop = threading.Event()
+        self._fired = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- producer side ------------------------------------------------------
+    def heartbeat(self, step=None) -> None:
+        self._last = time.monotonic()
+        self._last_step = step
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="paddle_tpu-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def fired(self) -> bool:
+        return self._fired.is_set()
+
+    # -- internals ----------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.check_interval):
+            stalled = time.monotonic() - self._last
+            if stalled > self.timeout:
+                self._fire(stalled)
+                return
+
+    def _fire(self, stalled: float):
+        self._fired.set()
+        info = {
+            "stalled_seconds": stalled,
+            "timeout": self.timeout,
+            "last_step": self._last_step,
+            "pid": os.getpid(),
+        }
+        try:
+            self._log.write(
+                f"[paddle_tpu watchdog] no step heartbeat for "
+                f"{stalled:.1f}s (timeout {self.timeout}s, last step "
+                f"{self._last_step}); thread stacks follow\n")
+            self._log.flush()
+            # the comm_task_manager-style dump: where every host thread is
+            try:
+                self._log.fileno()
+                faulthandler.dump_traceback(file=self._log)
+            except (OSError, AttributeError, ValueError,
+                    io.UnsupportedOperation):
+                import traceback
+                for tid, frame in sys._current_frames().items():
+                    self._log.write(f"Thread {tid}:\n")
+                    self._log.write(
+                        "".join(traceback.format_stack(frame)))
+            self._log.flush()
+        except Exception:
+            pass
+        if callable(self.on_timeout):
+            self.on_timeout(info)
+        elif self.on_timeout == "raise_in_main":
+            import _thread
+            _thread.interrupt_main()
+        elif self.on_timeout == "abort":
+            os.abort()
+        else:  # pragma: no cover
+            raise ValueError(f"unknown on_timeout {self.on_timeout!r}")

@@ -14,6 +14,10 @@ from ..core import dtype as dtypes
 from ..core.tensor import Tensor
 from .registry import register_op, call_op
 
+# the paddle-parity op below is named `slice`, shadowing the builtin for
+# the rest of this module — keep a handle to the real one
+_pyslice = slice
+
 
 @register_op()
 def reshape(x, shape, name=None):
@@ -237,7 +241,7 @@ def index_sample(x, index, name=None):
 
 @register_op()
 def index_add(x, index, axis, value, name=None):
-    sl = [slice(None)] * x.ndim
+    sl = [_pyslice(None)] * x.ndim
     sl[axis] = index
     return x.at[tuple(sl)].add(value)
 
@@ -428,28 +432,23 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 def crop(x, shape=None, offsets=None, name=None):
     shape = [x.shape[i] if s == -1 else int(s) for i, s in enumerate(shape)]
     offsets = [0] * x.ndim if offsets is None else [int(o) for o in offsets]
-    sl = tuple(slice(o, o + s) for o, s in zip(offsets, shape))
+    sl = tuple(_pyslice(o, o + s) for o, s in zip(offsets, shape))
     return x[sl]
 
 
 @register_op()
 def slice(x, axes, starts, ends, name=None):
-    sl = [builtins_slice(None)] * x.ndim
+    sl = [_pyslice(None)] * x.ndim
     for ax, st, en in zip(axes, starts, ends):
-        sl[ax] = builtins_slice(int(st), int(en))
+        sl[ax] = _pyslice(int(st), int(en))
     return x[tuple(sl)]
-
-
-def builtins_slice(*a):
-    import builtins
-    return builtins.slice(*a)
 
 
 @register_op()
 def strided_slice(x, axes, starts, ends, strides, name=None):
-    sl = [builtins_slice(None)] * x.ndim
+    sl = [_pyslice(None)] * x.ndim
     for ax, st, en, sd in zip(axes, starts, ends, strides):
-        sl[ax] = builtins_slice(int(st), int(en), int(sd))
+        sl[ax] = _pyslice(int(st), int(en), int(sd))
     return x[tuple(sl)]
 
 

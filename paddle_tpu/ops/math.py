@@ -102,7 +102,7 @@ def heaviside(x, y, name=None):
     return jnp.heaviside(x, y)
 
 
-@register_op()
+@register_op(differentiable=False)  # jax defines no grad rule for it
 def nextafter(x, y, name=None):
     return jnp.nextafter(x, y)
 

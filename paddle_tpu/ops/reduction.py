@@ -160,5 +160,29 @@ def nanquantile(x, q, axis=None, keepdim=False, name=None):
 
 @register_op(differentiable=False)
 def mode(x, axis=-1, keepdim=False, name=None):
-    vals = jax.scipy.stats.mode(x, axis=axis, keepdims=keepdim)
-    return vals.mode, vals.count
+    # jax.scipy.stats.mode only reduces axis 0 correctly in this version.
+    # Sort-then-run-length (O(n log n) time, O(n) memory — a pairwise
+    # equality matrix would be O(n^2) and OOM on long axes): each sorted
+    # element's run is [first, last] where first is the running max of
+    # run-start indices and last the reverse running min of run-end
+    # indices; argmax of run length picks the smallest modal value.
+    xm = jnp.moveaxis(x, axis, -1)
+    xs = jnp.sort(xm, axis=-1)
+    n = xs.shape[-1]
+    iota = jnp.arange(n)
+    changed = xs[..., 1:] != xs[..., :-1]
+    new_run = jnp.concatenate(
+        [jnp.ones(xs.shape[:-1] + (1,), bool), changed], axis=-1)
+    run_end = jnp.concatenate(
+        [changed, jnp.ones(xs.shape[:-1] + (1,), bool)], axis=-1)
+    first = jax.lax.cummax(jnp.where(new_run, iota, 0), axis=xs.ndim - 1)
+    last = jax.lax.cummin(jnp.where(run_end, iota, n - 1),
+                          axis=xs.ndim - 1, reverse=True)
+    cnt = last - first + 1
+    k = jnp.argmax(cnt, axis=-1)
+    modes = jnp.take_along_axis(xs, k[..., None], axis=-1)[..., 0]
+    count = jnp.take_along_axis(cnt, k[..., None], axis=-1)[..., 0]
+    if keepdim:
+        modes = jnp.expand_dims(modes, axis)
+        count = jnp.expand_dims(count, axis)
+    return modes, count

@@ -93,6 +93,7 @@ def _collect_tensors(args, kwargs):
 
 _VJP_CACHE: Dict = {}
 _VJP_SEEN: set = set()
+_VJP_UNCACHABLE: set = set()  # op names whose fns cannot be jitted
 _VJP_CACHE_MAX = 4096
 
 
@@ -181,7 +182,18 @@ def _call_op_cached(name, fn, args, kwargs, diff, tensors):
         _VJP_CACHE[key] = entry
     fwd_jit, bwd_jit = entry
     arrays = [t._data for t in tensors2]
-    out = fwd_jit(arrays)
+    try:
+        out = fwd_jit(arrays)
+    except Exception as e:
+        _VJP_CACHE.pop(key, None)
+        # only TRACE-structure failures (data-dependent output shapes:
+        # masked_select, nonzero) poison the op name permanently;
+        # ordinary user errors (bad shapes/dtypes) just fall back once —
+        # the uncached path re-raises them — and must not disable the
+        # cache for every later valid call of this op
+        if isinstance(e, jax.errors.JAXTypeError):
+            _VJP_UNCACHABLE.add(name)
+        return None
 
     flat, treedef_out = jax.tree_util.tree_flatten(out)
     avals = [(o.shape, o.dtype) for o in flat]
@@ -218,7 +230,7 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
 
     diff = [t for t in tensors if not t.stop_gradient or t._node is not None]
 
-    if get_flag("eager_vjp_cache"):
+    if get_flag("eager_vjp_cache") and name not in _VJP_UNCACHABLE:
         try:
             res = _call_op_cached(name, fn, args, kwargs, diff, tensors)
         except (TypeError, ValueError):
