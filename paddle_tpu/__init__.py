@@ -23,6 +23,8 @@ from .core.dtype import (
 )
 from .core.generator import seed, Generator
 from .core.flags import get_flags, set_flags
+from .core.containers import (TensorArray, SelectedRows, create_array,
+                              array_write, array_read, array_length)
 from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad
 from .autograd.tape import backward as _backward
 from .framework import get_default_device, set_device, get_device, device_count, is_compiled_with_tpu

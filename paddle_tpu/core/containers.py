@@ -1,0 +1,116 @@
+"""TensorArray and SelectedRows — the reference's auxiliary tensor
+container types (paddle/fluid/framework/lod_tensor_array.h,
+paddle/phi/core/selected_rows.h + python paddle.tensor.array_* ops).
+
+TPU-native notes:
+  * TensorArray backs dynamic write/read sequences. Under jit, prefer
+    lax.scan; eagerly (and for API parity) this is a growable list with
+    write/read/stack/concat and the array_* functional ops.
+  * SelectedRows is the reference's sparse-gradient carrier (embedding
+    grads as {rows, values}). On TPU, gradients stay dense — XLA fuses
+    the scatter-add — so SelectedRows here is a faithful data type with
+    to_dense()/from_dense() for interop, not a dispatch path.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from .tensor import Tensor
+
+
+class TensorArray:
+    """Growable array of Tensors (reference: LoDTensorArray)."""
+
+    def __init__(self, tensors: Optional[Sequence[Tensor]] = None):
+        self._items: List[Tensor] = list(tensors or [])
+
+    def append(self, t) -> "TensorArray":
+        self._items.append(t if isinstance(t, Tensor) else Tensor(t))
+        return self
+
+    def write(self, index: int, t) -> "TensorArray":
+        t = t if isinstance(t, Tensor) else Tensor(t)
+        if index == len(self._items):
+            self._items.append(t)
+        elif 0 <= index < len(self._items):
+            self._items[index] = t
+        else:
+            raise IndexError(
+                f"write at {index} outside [0, {len(self._items)}]")
+        return self
+
+    def read(self, index: int) -> Tensor:
+        return self._items[index]
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def __iter__(self):
+        return iter(self._items)
+
+    def __getitem__(self, i):
+        return self._items[i]
+
+    def stack(self, axis: int = 0) -> Tensor:
+        from .. import ops
+        return ops.stack(list(self._items), axis=axis)
+
+    def concat(self, axis: int = 0) -> Tensor:
+        from .. import ops
+        return ops.concat(list(self._items), axis=axis)
+
+
+def create_array(dtype=None, initialized_list=None) -> TensorArray:
+    """paddle.tensor.create_array."""
+    return TensorArray(initialized_list)
+
+
+def array_write(x, i, array: Optional[TensorArray] = None) -> TensorArray:
+    """paddle.tensor.array_write (i may be a 0-d Tensor)."""
+    if array is None:
+        array = TensorArray()
+    array.write(int(i.numpy()) if isinstance(i, Tensor) else int(i), x)
+    return array
+
+
+def array_read(array: TensorArray, i) -> Tensor:
+    return array.read(int(i.numpy()) if isinstance(i, Tensor) else int(i))
+
+
+def array_length(array: TensorArray) -> Tensor:
+    return Tensor(jnp.asarray(len(array), jnp.int64), stop_gradient=True)
+
+
+class SelectedRows:
+    """{height, rows, values} sparse row container
+    (phi/core/selected_rows.h)."""
+
+    def __init__(self, rows, values, height: int):
+        self.rows = (rows if isinstance(rows, Tensor)
+                     else Tensor(jnp.asarray(rows, jnp.int32),
+                                 stop_gradient=True))
+        self.value = values if isinstance(values, Tensor) else Tensor(values)
+        self.height = int(height)
+        if self.rows.shape[0] != self.value.shape[0]:
+            raise ValueError(
+                f"rows ({self.rows.shape[0]}) and values "
+                f"({self.value.shape[0]}) must pair up")
+
+    def to_dense(self) -> Tensor:
+        dense = jnp.zeros((self.height,) + tuple(self.value.shape[1:]),
+                          self.value.data.dtype)
+        return Tensor(dense.at[self.rows.data].add(self.value.data))
+
+    @staticmethod
+    def from_dense(dense, rows=None) -> "SelectedRows":
+        d = dense.data if isinstance(dense, Tensor) else jnp.asarray(dense)
+        if rows is None:
+            nz = np.nonzero(np.any(
+                np.asarray(d).reshape(d.shape[0], -1) != 0, axis=1))[0]
+            rows = jnp.asarray(nz, jnp.int32)
+        else:
+            rows = jnp.asarray(rows, jnp.int32)
+        return SelectedRows(rows, Tensor(d[rows]), d.shape[0])

@@ -99,10 +99,13 @@ class Engine:
         self.plan = {}
         for name, p in self.model.named_parameters():
             existing = getattr(p.data, "sharding", None)
+            # a user placement is a NamedSharding with at least one
+            # non-None axis (PartitionSpec is itself a pytree LEAF, so
+            # iterate the spec's entries, not tree_leaves of it — a
+            # replicated P() must NOT count as a user placement)
             if (isinstance(existing, NamedSharding)
                     and any(ax is not None
-                            for ax in jax.tree_util.tree_leaves(
-                                [existing.spec]))):
+                            for ax in tuple(existing.spec))):
                 self.plan[name] = existing.spec  # user placement wins
                 continue
             spec = self._plan_param(name, p)
@@ -119,17 +122,38 @@ class Engine:
             a = jax.device_put(a, NamedSharding(self._mesh, spec))
         return Tensor(a, stop_gradient=True)
 
+    @staticmethod
+    def _batches(data, batch_size: Optional[int]):
+        """Yield (x, y) batches. With batch_size set, ``data`` must be
+        one (features, labels) array pair which gets re-batched
+        (reference Engine.fit re-batches its dataset); otherwise
+        ``data`` is already an iterable of batches."""
+        if batch_size is None:
+            yield from data
+            return
+        if not (isinstance(data, (tuple, list)) and len(data) == 2
+                and hasattr(data[0], "shape")):
+            raise ValueError(
+                "batch_size requires train_data=(features, labels) "
+                "arrays; pass an iterable of batches without batch_size")
+        xs, ys = data
+        n = xs.shape[0]
+        for s in range(0, n - n % batch_size, batch_size):
+            yield xs[s:s + batch_size], ys[s:s + batch_size]
+
     # ---------------------------------------------------------- execute ----
     def fit(self, train_data, epochs: int = 1, batch_size: Optional[int]
             = None, verbose: int = 0, log_freq: int = 10):
         """train_data: iterable of (input, label) batches (a DataLoader
-        or any iterable of numpy/Tensor pairs)."""
+        or any iterable of numpy/Tensor pairs), or one (features,
+        labels) pair together with ``batch_size``."""
         if self.loss is None or self.optimizer is None:
             raise ValueError("fit() needs loss and optimizer")
         self.prepare()
         history = []
         for epoch in range(epochs):
-            for i, batch in enumerate(train_data):
+            for i, batch in enumerate(self._batches(train_data,
+                                                    batch_size)):
                 x, y = batch[0], batch[1]
                 x = self._shard_batch(x)
                 y = self._shard_batch(y)
@@ -148,12 +172,21 @@ class Engine:
         from ...autograd import no_grad
         self.prepare()
         losses = []
+        for m in self.metrics:
+            m.reset()
         with no_grad():
             for batch in eval_data:
                 x, y = self._shard_batch(batch[0]), self._shard_batch(
                     batch[1])
-                losses.append(float(self.loss(self.model(x), y).numpy()))
-        return {"loss": float(np.mean(losses))}
+                pred = self.model(x)
+                losses.append(float(self.loss(pred, y).numpy()))
+                for m in self.metrics:
+                    m.update(m.compute(pred, y))
+        out = {"loss": float(np.mean(losses))}
+        for m in self.metrics:
+            out[m.name() if callable(getattr(m, "name", None))
+                else type(m).__name__.lower()] = m.accumulate()
+        return out
 
     def predict(self, test_data):
         from ...autograd import no_grad

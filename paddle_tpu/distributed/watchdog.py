@@ -45,6 +45,14 @@ class Watchdog:
         (dump + interrupt the main thread), or a callable(info_dict)."""
         if timeout <= 0:
             raise ValueError("timeout must be positive")
+        if not callable(on_timeout) and on_timeout not in ("abort",
+                                                           "raise_in_main"):
+            # validate NOW: an invalid action discovered at fire time
+            # would die silently inside the daemon thread — the exact
+            # do-nothing failure the watchdog exists to prevent
+            raise ValueError(
+                f"on_timeout must be 'abort', 'raise_in_main', or a "
+                f"callable, got {on_timeout!r}")
         self.timeout = float(timeout)
         self.on_timeout = on_timeout
         self.check_interval = check_interval or max(timeout / 10.0, 0.05)

@@ -67,6 +67,12 @@ class StaticFunction:
         self._fn = dygraph_function
         self._layer = layer
         self._input_spec = input_spec
+        # full_graph=False is the SOT graph-break analogue (reference:
+        # jit/sot fallback on untraceable bytecode): if tracing fails,
+        # permanently fall back to running the dygraph function eagerly
+        # instead of raising. full_graph=True surfaces the trace error.
+        self._full_graph = full_graph
+        self._fell_back = False
         functools.update_wrapper(self, dygraph_function)
 
         def _wrap(a):
@@ -93,7 +99,14 @@ class StaticFunction:
     def _params(self) -> List[Parameter]:
         return self._layer.parameters() if self._layer is not None else []
 
+    def _eager(self, *args, **kwargs):
+        if self._layer is not None:
+            return self._fn(self._layer, *args, **kwargs)
+        return self._fn(*args, **kwargs)
+
     def __call__(self, *args, **kwargs):
+        if self._fell_back:
+            return self._eager(*args, **kwargs)
         params = self._params()
         static_kwargs = tuple(
             (k, v) for k, v in kwargs.items()
@@ -105,9 +118,18 @@ class StaticFunction:
             return self._jitted(list(param_arrays), list(arg_arrays),
                                 dict(kwarr), static_kwargs)
 
-        return _registry.call_op(
-            f"to_static:{getattr(self._fn, '__name__', 'fn')}",
-            fn, (params,) + args, dyn_kwargs, differentiable=True)
+        try:
+            return _registry.call_op(
+                f"to_static:{getattr(self._fn, '__name__', 'fn')}",
+                fn, (params,) + args, dyn_kwargs, differentiable=True)
+        except jax.errors.JAXTypeError:
+            if self._full_graph:
+                raise
+            # graph break: untraceable python (data-dependent control
+            # flow, concretization) — run the whole function eagerly
+            # from now on (SOT splits subgraphs; we fall back wholesale)
+            self._fell_back = True
+            return self._eager(*args, **kwargs)
 
     # reference API surface
     @property
@@ -126,18 +148,22 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, **kwargs):
+              backend=None, full_graph: bool = True, **kwargs):
     """Decorator/wrapper (api.py:195). ``backend`` accepted for source
-    compat (the reference's CINN switch); compilation is always XLA here."""
+    compat (the reference's CINN switch); compilation is always XLA here.
+    ``full_graph=False`` enables the SOT-style fallback: untraceable
+    functions run eagerly instead of raising."""
     from ..nn.layer import Layer
 
     def wrap(f):
         if isinstance(f, Layer):
             sf = StaticFunction(type(f).forward, layer=f,
-                                input_spec=input_spec)
+                                input_spec=input_spec,
+                                full_graph=full_graph)
             f.forward = sf
             return f
-        return StaticFunction(f, input_spec=input_spec)
+        return StaticFunction(f, input_spec=input_spec,
+                              full_graph=full_graph)
 
     if function is not None:
         return wrap(function)

@@ -1,0 +1,92 @@
+"""Dynamic-shape bucketing: bounded compilations for varying batch/seq.
+
+Reference capability: symbolic shapes + bucketed lowering — PIR's
+``DimExpr`` (paddle/pir/include/dialect/shape/utils/dim_expr.h:168-177)
+lets one program cover a family of shapes, and CINN lowers bucketed
+kernels per range (op_lowering_impl.h:61). XLA compiles static shapes
+only, so the TPU-native policy is explicit: pad the dynamic dim up to a
+bucket from a fixed ladder, trace ONE executable per bucket (log-many,
+not per-size), and carry the true length so the function can mask.
+This is the standard serving/variable-batch recipe on TPU.
+
+    step = bucketed(fn, axis=0)            # pad+slice transparently
+    step = bucketed(fn, axis=0, with_length=True)  # fn gets valid_len
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+DEFAULT_BUCKETS = tuple(2 ** i for i in range(16))  # 1..32768
+
+
+def bucket_size(n: int, buckets: Optional[Sequence[int]] = None) -> int:
+    """Smallest bucket >= n (power-of-two ladder by default)."""
+    for b in sorted(buckets or DEFAULT_BUCKETS):
+        if b >= n:
+            return b
+    raise ValueError(f"{n} exceeds the largest bucket "
+                     f"{max(buckets or DEFAULT_BUCKETS)}")
+
+
+class BucketedFunction:
+    """Wraps a jax-traceable function so calls with any size of the
+    dynamic ``axis`` reuse one compiled executable per bucket."""
+
+    def __init__(self, fn: Callable, axis: int = 0,
+                 buckets: Optional[Sequence[int]] = None,
+                 with_length: bool = False,
+                 pad_value: float = 0):
+        self._fn = fn
+        self.axis = axis
+        self.buckets = tuple(sorted(buckets or DEFAULT_BUCKETS))
+        self.with_length = with_length
+        self.pad_value = pad_value
+        self._jit = jax.jit(self._padded_call)
+        functools.update_wrapper(self, fn)
+
+    def _padded_call(self, args, valid_len):
+        if self.with_length:
+            return self._fn(*args, valid_len=valid_len)
+        return self._fn(*args)
+
+    def __call__(self, *args):
+        ax = self.axis
+        arrays = [jnp.asarray(a) for a in args]
+        sizes = {a.shape[ax] for a in arrays if a.ndim > ax}
+        if len(sizes) != 1:
+            raise ValueError(
+                f"all inputs must agree on dim {ax}; got {sizes}")
+        n = sizes.pop()
+        b = bucket_size(n, self.buckets)
+        padded = []
+        for a in arrays:
+            if a.ndim > ax and a.shape[ax] != b:
+                pad = [(0, 0)] * a.ndim
+                pad[ax] = (0, b - n)
+                a = jnp.pad(a, pad, constant_values=self.pad_value)
+            padded.append(a)
+        out = self._jit(padded, jnp.int32(n))
+        # slice outputs that kept the bucketed dim back to the true size
+        def unpad(o):
+            if (hasattr(o, "ndim") and o.ndim > ax
+                    and o.shape[ax] == b and b != n):
+                return jax.lax.slice_in_dim(o, 0, n, axis=ax)
+            return o
+        return jax.tree_util.tree_map(unpad, out)
+
+
+def bucketed(fn: Optional[Callable] = None, *, axis: int = 0,
+             buckets: Optional[Sequence[int]] = None,
+             with_length: bool = False, pad_value: float = 0):
+    """Decorator form of :class:`BucketedFunction`."""
+    def wrap(f):
+        return BucketedFunction(f, axis=axis, buckets=buckets,
+                                with_length=with_length,
+                                pad_value=pad_value)
+    return wrap(fn) if fn is not None else wrap

@@ -1,0 +1,82 @@
+"""Runtime kernel autotune cache.
+
+Reference: paddle/phi/kernels/autotune/ — algorithm selection by timing
+(cuDNN algo search, transpose/layout autotune) with a per-process cache
+keyed by op + shapes.
+
+TPU-native shape: candidates are jax-traceable callables (different
+Pallas block sizes, layouts, algorithm variants); the first call for a
+given key times each candidate with a warm-up plus chained timed
+iterations and caches the winner. All later calls dispatch straight to
+the cached choice.
+
+Timing caveat documented for the tunnelled dev runtime: host wall time
+carries ~100 ms dispatch noise per sync there, so use ``iters`` high
+enough (or run where the device is locally attached) for the deltas to
+dominate; tests exercise the machinery on CPU where timing is honest.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+
+_CACHE: Dict[Any, int] = {}
+_STATS: Dict[Any, Tuple[float, ...]] = {}
+
+
+def clear():
+    _CACHE.clear()
+    _STATS.clear()
+
+
+def cache_info():
+    return dict(_CACHE), dict(_STATS)
+
+
+def _time_once(fn, args, iters: int) -> float:
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def autotune(key, candidates: Sequence[Callable], args: tuple,
+             iters: int = 10):
+    """Run the fastest of ``candidates`` for ``args``; first call per
+    ``key`` measures, later calls hit the cache.
+
+    key: hashable (op name, shapes, dtypes, ...). candidates: callables
+    with identical semantics. Returns the chosen candidate's output.
+    """
+    if not candidates:
+        raise ValueError("need at least one candidate")
+    idx = _CACHE.get(key)
+    if idx is None:
+        times = []
+        for fn in candidates:
+            try:
+                times.append(_time_once(fn, args, iters))
+            except Exception:
+                times.append(float("inf"))
+        idx = int(min(range(len(times)), key=times.__getitem__))
+        if times[idx] == float("inf"):
+            raise RuntimeError(f"all autotune candidates failed for {key}")
+        _CACHE[key] = idx
+        _STATS[key] = tuple(times)
+    return candidates[idx](*args)
+
+
+def choose(key, candidates: Sequence[Callable], args: tuple,
+           iters: int = 10) -> int:
+    """Return the winning index for callers that bind the winner
+    themselves; on a warm cache this is a pure lookup (no execution)."""
+    idx = _CACHE.get(key)
+    if idx is not None:
+        return idx
+    autotune(key, candidates, args, iters)
+    return _CACHE[key]

@@ -192,7 +192,10 @@ def _call_op_cached(name, fn, args, kwargs, diff, tensors):
         # the uncached path re-raises them — and must not disable the
         # cache for every later valid call of this op
         if isinstance(e, jax.errors.JAXTypeError):
-            _VJP_UNCACHABLE.add(name)
+            # key by (name, fn): shared wrapper names (every to_static
+            # Layer dispatches as "to_static:forward") must not let one
+            # untraceable model poison the cache for all the others
+            _VJP_UNCACHABLE.add((name, fn))
         return None
 
     flat, treedef_out = jax.tree_util.tree_flatten(out)
@@ -230,7 +233,7 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
 
     diff = [t for t in tensors if not t.stop_gradient or t._node is not None]
 
-    if get_flag("eager_vjp_cache") and name not in _VJP_UNCACHABLE:
+    if get_flag("eager_vjp_cache") and (name, fn) not in _VJP_UNCACHABLE:
         try:
             res = _call_op_cached(name, fn, args, kwargs, diff, tensors)
         except (TypeError, ValueError):

@@ -1,0 +1,5 @@
+"""paddle_tpu.utils — extension loading and misc utilities
+(reference: python/paddle/utils/)."""
+from . import cpp_extension
+
+__all__ = ["cpp_extension"]

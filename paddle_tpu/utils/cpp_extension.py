@@ -1,0 +1,136 @@
+"""Out-of-tree custom C++ kernels — the custom-op / custom-kernel C API.
+
+Reference capability: paddle.utils.cpp_extension (load/setup compiling
+user .cc into ops) and the custom-kernel C API (paddle/phi/capi/): users
+ship kernels the framework dispatches without rebuilding it.
+
+TPU-native redesign: the stable plugin ABI is XLA's FFI. ``load()``
+compiles user C++ written against the header-only ``xla/ffi/api/ffi.h``
+(shipped inside jaxlib — ``jax.ffi.include_dir()``), registers every
+exported ``XLA_FFI_DEFINE_HANDLER_SYMBOL`` with jax, and wraps each as a
+REGISTERED framework op, so custom kernels dispatch exactly like
+built-ins (eager tape, jit, vjp via ``define_grad``). Host kernels run
+through the FFI on CPU; on-device TPU kernels are written as Pallas
+(ops/pallas) — the FFI path is the host-custom-call half of the
+reference's plugin story.
+
+Example (see tests/test_cpp_extension.py for a full kernel)::
+
+    ext = load(name="my_ops", sources=["my_ops.cc"],
+               functions={"scaled_add": dict(
+                   handler="ScaledAdd", n_args=2,
+                   attrs={"alpha": np.float32})})
+    y = ext.scaled_add(x1, x2, alpha=2.0)   # a paddle_tpu op
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import types
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def include_paths() -> list:
+    """Compiler include dirs for writing FFI kernels."""
+    return [jax.ffi.include_dir()]
+
+
+def _compile(name: str, sources: Sequence[str], extra_cflags, build_dir):
+    build_dir = build_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "paddle_tpu_extensions")
+    os.makedirs(build_dir, exist_ok=True)
+    so = os.path.join(build_dir, f"lib{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    if os.path.exists(so) and all(
+            os.path.getmtime(so) >= os.path.getmtime(s) for s in srcs):
+        return so
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+           *[f"-I{p}" for p in include_paths()],
+           *(extra_cflags or []), *srcs, "-o", so]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"custom op build failed:\n{' '.join(cmd)}\n{proc.stderr}")
+    return so
+
+
+def load(name: str, sources: Sequence[str],
+         functions: Dict[str, Dict[str, Any]],
+         extra_cflags: Optional[Sequence[str]] = None,
+         build_directory: Optional[str] = None,
+         platform: str = "cpu"):
+    """Compile + register custom FFI kernels; returns a module-like
+    namespace of framework ops.
+
+    functions: op_name -> spec with keys
+      handler: exported XLA_FFI_DEFINE_HANDLER_SYMBOL name;
+      n_args: number of array inputs;
+      attrs: optional {attr_name: np dtype} scalar attributes;
+      out_like: index of the input whose shape/dtype the output copies
+        (default 0), or a callable (*avals) -> ShapeDtypeStruct.
+    """
+    from ..ops.registry import register_op
+
+    so = _compile(name, sources, extra_cflags, build_directory)
+    lib = ctypes.CDLL(so)
+    ext = types.SimpleNamespace(__name__=name, _lib=lib, _path=so)
+
+    for op_name, spec in functions.items():
+        handler = getattr(lib, spec["handler"])
+        target = f"{name}.{op_name}"
+        jax.ffi.register_ffi_target(
+            target, jax.ffi.pycapsule(handler), platform=platform)
+        n_args = int(spec.get("n_args", 1))
+        attr_types = spec.get("attrs", {})
+        out_like = spec.get("out_like", 0)
+
+        def make(target=target, n_args=n_args, attr_types=attr_types,
+                 out_like=out_like, op_name=op_name):
+            def fn(*args, **kwargs):
+                arrays = args[:n_args]
+                attrs = {}
+                for k, tp in attr_types.items():
+                    if k not in kwargs:
+                        raise TypeError(f"{op_name} missing attr {k!r}")
+                    attrs[k] = tp(kwargs[k])
+                if callable(out_like):
+                    out = out_like(*arrays)
+                else:
+                    ref = arrays[out_like]
+                    out = jax.ShapeDtypeStruct(ref.shape, ref.dtype)
+                return jax.ffi.ffi_call(target, out)(*arrays, **attrs)
+
+            fn.__name__ = op_name
+            return fn
+
+        wrapped = register_op(name=f"{name}.{op_name}",
+                              differentiable=False,
+                              also_method=False)(make())
+        setattr(ext, op_name, wrapped)
+    return ext
+
+
+def define_grad(ext, op_name: str, grad_fn: Callable):
+    """Attach a gradient to a loaded custom op: ``grad_fn`` is a pure
+    JAX function with the same signature returning the primal output —
+    it becomes the differentiable surrogate whose vjp the tape records,
+    while the FFI kernel stays the forward implementation under
+    ``no_grad``/inference. (The reference's custom-op grad kernels map
+    to this: one more function, not another ABI.)"""
+    from ..ops.registry import register_op
+
+    fwd = getattr(ext, op_name)
+
+    def op(*args, **kwargs):
+        return grad_fn(*args, **kwargs)
+
+    op.__name__ = f"{op_name}_diff"
+    diff = register_op(name=f"{ext.__name__}.{op_name}_diff",
+                       also_method=False)(op)
+    setattr(ext, op_name + "_diff", diff)
+    return diff

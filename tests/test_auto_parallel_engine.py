@@ -84,6 +84,61 @@ def test_user_placement_wins_over_planner():
     assert "x" in tuple(plan["fc1.weight"]), plan["fc1.weight"]
 
 
+def test_fit_with_batch_size_rebatches():
+    rng = np.random.RandomState(1)
+    xs = rng.randn(32, 32).astype(np.float32)
+    ys = rng.randn(32, 8).astype(np.float32)
+    model = MLP()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    eng = Engine(model, loss=pt.nn.functional.mse_loss, optimizer=opt,
+                 strategy=Strategy(dp_degree=2, mp_degree=1))
+    hist = eng.fit((xs, ys), epochs=1, batch_size=8)
+    assert len(hist) == 4  # 32 samples / bs 8
+    with pytest.raises(ValueError, match="batch_size"):
+        eng.fit(list(_data(2)), batch_size=8)
+
+
+def test_evaluate_reports_metrics():
+    class MeanAbs:
+        def reset(self):
+            self.v, self.n = 0.0, 0
+
+        def compute(self, pred, label):
+            return float(np.abs(pred.numpy() - label.numpy()).mean())
+
+        def update(self, c):
+            self.v += c
+            self.n += 1
+
+        def accumulate(self):
+            return self.v / max(self.n, 1)
+
+        def name(self):
+            return "mean_abs"
+
+    model = MLP()
+    eng = Engine(model, loss=pt.nn.functional.mse_loss,
+                 optimizer=pt.optimizer.AdamW(
+                     learning_rate=1e-3, parameters=model.parameters()),
+                 metrics=[MeanAbs()],
+                 strategy=Strategy(dp_degree=2, mp_degree=1))
+    res = eng.evaluate(list(_data(2)))
+    assert "mean_abs" in res and np.isfinite(res["mean_abs"])
+
+
+def test_replicated_sharding_does_not_count_as_user_placement():
+    """A fully replicated NamedSharding (e.g. from a previous
+    mp_degree=1 prepare or a checkpoint restore) must NOT suppress the
+    planner on the next prepare."""
+    model = MLP()
+    Engine(model, strategy=Strategy(dp_degree=8, mp_degree=1)).prepare()
+    eng2 = Engine(model, strategy=Strategy(dp_degree=2, mp_degree=4,
+                                           min_shard_size=128))
+    plan = eng2.distributed_plan()
+    assert any("mp" in tuple(s) for s in plan.values()), plan
+
+
 def test_strategy_validation():
     with pytest.raises(NotImplementedError):
         Strategy(pp_degree=2)

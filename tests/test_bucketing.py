@@ -1,0 +1,65 @@
+"""Dynamic-shape bucketing (jit/bucketing.py — the DimExpr/bucketed
+lowering counterpart, dim_expr.h:168-177 / op_lowering_impl.h:61)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.jit.bucketing import bucketed, bucket_size, \
+    BucketedFunction
+
+
+def test_bucket_ladder():
+    assert bucket_size(1) == 1
+    assert bucket_size(3) == 4
+    assert bucket_size(128) == 128
+    assert bucket_size(129) == 256
+    with pytest.raises(ValueError):
+        bucket_size(10 ** 9)
+
+
+def test_one_compile_per_bucket_many_sizes():
+    traces = []
+
+    @bucketed(axis=0)
+    def f(x):
+        traces.append(1)  # runs only when (re)tracing
+        return x * 2.0
+
+    for n in (3, 4, 2, 7, 8, 5, 6, 1):
+        out = f(jnp.ones((n, 4)))
+        assert out.shape == (n, 4)
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+    # sizes 1..8 span buckets {1,2,4,8} -> at most 4 traces, not 8
+    assert len(traces) <= 4, traces
+
+
+def test_masking_with_valid_len():
+    @bucketed(axis=0, with_length=True)
+    def mean_rows(x, valid_len):
+        mask = (jnp.arange(x.shape[0]) < valid_len)[:, None]
+        return jnp.sum(x * mask) / (valid_len * x.shape[1])
+
+    x = np.full((5, 2), 3.0, np.float32)
+    out = mean_rows(x)  # padded to bucket 8; padding masked out
+    np.testing.assert_allclose(float(out), 3.0, rtol=1e-6)
+
+
+def test_multi_input_consistency_checked():
+    @bucketed(axis=0)
+    def f(a, b):
+        return a + b
+
+    with pytest.raises(ValueError, match="agree"):
+        f(jnp.ones((3, 2)), jnp.ones((4, 2)))
+
+
+def test_custom_buckets_and_pad_value():
+    @bucketed(axis=0, buckets=(4, 16), pad_value=1.0, with_length=True)
+    def prod_all(x, valid_len):
+        del valid_len
+        return jnp.prod(x)  # padding of 1.0 is the identity here
+
+    out = prod_all(np.full((3,), 2.0, np.float32))
+    np.testing.assert_allclose(float(out), 8.0)

@@ -1,0 +1,146 @@
+"""TensorArray/SelectedRows (core/containers.py), the autotune cache
+(ops/autotune.py), and the SOT graph-break fallback (jit full_graph).
+
+Reference capabilities: LoDTensorArray + paddle.tensor.array_* ops,
+phi/core/selected_rows.h, phi/kernels/autotune/, jit/sot fallback.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+import paddle_tpu as pt
+from paddle_tpu.core.containers import (TensorArray, SelectedRows,
+                                        create_array, array_write,
+                                        array_read, array_length)
+
+
+# ---------------------------------------------------------- TensorArray ----
+
+def test_tensor_array_write_read_stack():
+    arr = create_array()
+    for i in range(4):
+        array_write(pt.to_tensor(np.full((2,), float(i), np.float32)),
+                    i, arr)
+    assert int(array_length(arr).numpy()) == 4
+    np.testing.assert_allclose(array_read(arr, 2).numpy(), 2.0)
+    stacked = arr.stack()
+    assert tuple(stacked.shape) == (4, 2)
+    np.testing.assert_allclose(stacked.numpy()[:, 0], [0, 1, 2, 3])
+    cat = arr.concat()
+    assert tuple(cat.shape) == (8,)
+
+
+def test_tensor_array_overwrite_and_bounds():
+    arr = TensorArray()
+    arr.write(0, pt.to_tensor(np.zeros(2, np.float32)))
+    arr.write(0, pt.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(arr.read(0).numpy(), 1.0)
+    with pytest.raises(IndexError):
+        arr.write(5, pt.to_tensor(np.ones(2, np.float32)))
+
+
+def test_tensor_array_grad_flows_through_stack():
+    xs = [pt.to_tensor(np.full((3,), float(i + 1), np.float32),
+                       stop_gradient=False) for i in range(3)]
+    arr = TensorArray(xs)
+    loss = (arr.stack() * 2.0).sum()
+    loss.backward()
+    for x in xs:
+        np.testing.assert_allclose(x.grad.numpy(), 2.0)
+
+
+# ---------------------------------------------------------- SelectedRows ----
+
+def test_selected_rows_roundtrip():
+    rows = np.array([1, 4], np.int64)
+    vals = np.arange(6, dtype=np.float32).reshape(2, 3)
+    sr = SelectedRows(rows, vals, height=6)
+    dense = sr.to_dense().numpy()
+    assert dense.shape == (6, 3)
+    np.testing.assert_allclose(dense[1], vals[0])
+    np.testing.assert_allclose(dense[4], vals[1])
+    assert np.all(dense[[0, 2, 3, 5]] == 0)
+    back = SelectedRows.from_dense(dense)
+    np.testing.assert_array_equal(np.asarray(back.rows.numpy()), rows)
+    np.testing.assert_allclose(back.value.numpy(), vals)
+
+
+def test_selected_rows_duplicate_rows_accumulate():
+    sr = SelectedRows(np.array([2, 2], np.int64),
+                      np.ones((2, 2), np.float32), height=4)
+    np.testing.assert_allclose(sr.to_dense().numpy()[2], 2.0)
+
+
+# -------------------------------------------------------------- autotune ----
+
+def test_autotune_picks_faster_candidate_and_caches():
+    import time
+    from paddle_tpu.ops import autotune as at
+    at.clear()
+    calls = {"slow": 0, "fast": 0}
+
+    def slow(x):
+        calls["slow"] += 1
+        time.sleep(0.02)
+        return x * 2
+
+    def fast(x):
+        calls["fast"] += 1
+        return x * 2
+
+    x = jnp.ones((4,))
+    for _ in range(5):
+        out = at.autotune("k", [slow, fast], (x,), iters=2)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+    cache, stats = at.cache_info()
+    assert cache["k"] == 1  # fast won
+    # slow ran only during measurement, never after
+    assert calls["slow"] <= 3 and calls["fast"] >= 7
+
+
+def test_autotune_skips_failing_candidates():
+    from paddle_tpu.ops import autotune as at
+    at.clear()
+
+    def broken(x):
+        raise RuntimeError("no")
+
+    def ok(x):
+        return x + 1
+
+    out = at.autotune("k2", [broken, ok], (jnp.zeros(2),), iters=1)
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    with pytest.raises(RuntimeError, match="all autotune"):
+        at.autotune("k3", [broken], (jnp.zeros(2),), iters=1)
+
+
+# ----------------------------------------------------------- SOT fallback ----
+
+def test_to_static_full_graph_false_falls_back_on_graph_break():
+    calls = []
+
+    @pt.jit.to_static(full_graph=False)
+    def f(x):
+        calls.append(1)
+        if float(x.sum().numpy()) > 0:  # concretizes a tracer -> break
+            return x * 2
+        return x
+
+    x = pt.to_tensor(np.ones(3, np.float32))
+    out = f(x)  # falls back to eager, still correct
+    np.testing.assert_allclose(out.numpy(), 2.0)
+    out2 = f(x)  # stays on the eager path
+    np.testing.assert_allclose(out2.numpy(), 2.0)
+
+
+def test_to_static_full_graph_true_raises_on_break():
+    import jax
+
+    @pt.jit.to_static(full_graph=True)
+    def f(x):
+        if float(x.sum().numpy()) > 0:
+            return x * 2
+        return x
+
+    with pytest.raises(jax.errors.JAXTypeError):
+        f(pt.to_tensor(np.ones(3, np.float32)))
