@@ -138,7 +138,9 @@ class Engine:
                 "arrays; pass an iterable of batches without batch_size")
         xs, ys = data
         n = xs.shape[0]
-        for s in range(0, n - n % batch_size, batch_size):
+        # the tail partial batch IS yielded (dropping it would silently
+        # train on nothing when n < batch_size)
+        for s in range(0, n, batch_size):
             yield xs[s:s + batch_size], ys[s:s + batch_size]
 
     # ---------------------------------------------------------- execute ----
@@ -181,11 +183,33 @@ class Engine:
                 pred = self.model(x)
                 losses.append(float(self.loss(pred, y).numpy()))
                 for m in self.metrics:
-                    m.update(m.compute(pred, y))
+                    # hapi metric protocol: compute() may return a tuple
+                    # of update()'s positional args (Metric.compute's
+                    # default passes (pred, label) through)
+                    res = m.compute(pred, y)
+                    if isinstance(res, (tuple, list)):
+                        m.update(*res)
+                    else:
+                        m.update(res)
         out = {"loss": float(np.mean(losses))}
         for m in self.metrics:
-            out[m.name() if callable(getattr(m, "name", None))
-                else type(m).__name__.lower()] = m.accumulate()
+            names = (m.name() if callable(getattr(m, "name", None))
+                     else type(m).__name__.lower())
+            acc = m.accumulate()
+            if isinstance(names, (list, tuple)):
+                # multi-output metrics (Accuracy(topk=(1,5))) pair
+                # name[i] with accumulate()[i]; ndarray results coerce
+                # to a list so they pair element-wise too
+                accs = (np.asarray(acc).ravel().tolist()
+                        if isinstance(acc, (list, tuple, np.ndarray))
+                        else [acc] * len(names))
+                if len(accs) != len(names):
+                    raise ValueError(
+                        f"metric {names} returned {len(accs)} values "
+                        f"for {len(names)} names")
+                out.update(zip(names, accs))
+            else:
+                out[names] = acc
         return out
 
     def predict(self, test_data):

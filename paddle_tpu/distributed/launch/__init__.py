@@ -58,7 +58,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default=1, help="processes to spawn on this node")
     p.add_argument("--log_dir", type=str, default=None,
                    help="write per-rank stdout/stderr to "
-                        "<log_dir>/workerlog.<rank>")
+                        "<log_dir>/workerlog.<rank> (restart attempts "
+                        "append .<attempt>)")
+    p.add_argument("--max_restarts", type=int, default=0,
+                   help="elastic: restart the whole job up to N times "
+                        "after a failed worker (reference: fleet elastic "
+                        "manager)")
     p.add_argument("--env", action="append", default=[],
                    help="extra KEY=VALUE env for the children")
     p.add_argument("script", help="training script to run")
@@ -67,13 +72,42 @@ def build_parser() -> argparse.ArgumentParser:
 
 
 def launch(args: Optional[List[str]] = None) -> int:
+    """Run the job; with --max_restarts N, a failed attempt is retried
+    with a fresh coordinator (the elastic-manager restart loop,
+    fleet/elastic/manager.py:125 — scoped to whole-job restarts: TPU
+    SPMD cannot continue with a partial world the way parameter-server
+    jobs can)."""
     ns = build_parser().parse_args(args)
+    attempts = max(int(getattr(ns, "max_restarts", 0)), 0) + 1
+    if attempts > 1 and ns.nnodes > 1:
+        # per-node restart loops cannot agree on attempt numbers or
+        # coordinator lifetime without a cross-node rendezvous; restarts
+        # of multi-node jobs belong to the cluster scheduler
+        raise SystemExit(
+            "--max_restarts only supports single-node jobs; multi-node "
+            "elastic restart must come from the job scheduler "
+            "(k8s/GKE restart policy)")
+    rc = 1
+    for attempt in range(attempts):
+        rc = _launch_once(ns, attempt)
+        if rc == 0 or rc == 130:
+            return rc
+        if attempt + 1 < attempts:
+            print(f"[paddle_tpu launch] attempt {attempt} failed "
+                  f"(exit {rc}); restarting "
+                  f"({attempts - attempt - 1} retries left)",
+                  file=sys.stderr)
+    return rc
+
+
+def _launch_once(ns, attempt: int = 0) -> int:
     world = ns.nnodes * ns.nproc
     master = ns.master
     if master is None:
         if ns.nnodes > 1:
             raise SystemExit("--master host:port is required for "
                              "multi-node jobs")
+        # fresh port per attempt: the old coordinator socket may linger
         master = f"127.0.0.1:{_free_port()}"
 
     procs: List[subprocess.Popen] = []
@@ -96,6 +130,9 @@ def launch(args: Optional[List[str]] = None) -> int:
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_LOCAL_RANK": str(local_rank),
             "PADDLE_CURRENT_ENDPOINT": f"{socket.gethostname()}:{rank}",
+            # elastic: which restart attempt this is (scripts resume
+            # from their last checkpoint when > 0)
+            "PADDLE_RESTART_ATTEMPT": str(attempt),
         })
         for kv in ns.env:
             k, _, v = kv.partition("=")
@@ -103,8 +140,9 @@ def launch(args: Optional[List[str]] = None) -> int:
         out = None
         if ns.log_dir:
             os.makedirs(ns.log_dir, exist_ok=True)
+            suffix = f".{attempt}" if attempt else ""
             out = open(os.path.join(ns.log_dir,
-                                    f"workerlog.{rank}"), "wb")
+                                    f"workerlog.{rank}{suffix}"), "wb")
             logs.append(out)
         procs.append(subprocess.Popen(
             [sys.executable, "-u", ns.script, *ns.script_args],

@@ -55,10 +55,20 @@ class BucketedFunction:
             return self._fn(*args, valid_len=valid_len)
         return self._fn(*args)
 
+    def _has_dim(self, a) -> bool:
+        """Whether the bucketed axis exists on ``a`` (correct for
+        negative axis too — ``ndim > ax`` would wrongly admit scalars
+        when ax < 0)."""
+        nd = getattr(a, "ndim", None)
+        if nd is None:
+            return False
+        ax = self.axis
+        return nd >= (-ax if ax < 0 else ax + 1)
+
     def __call__(self, *args):
         ax = self.axis
         arrays = [jnp.asarray(a) for a in args]
-        sizes = {a.shape[ax] for a in arrays if a.ndim > ax}
+        sizes = {a.shape[ax] for a in arrays if self._has_dim(a)}
         if len(sizes) != 1:
             raise ValueError(
                 f"all inputs must agree on dim {ax}; got {sizes}")
@@ -66,16 +76,15 @@ class BucketedFunction:
         b = bucket_size(n, self.buckets)
         padded = []
         for a in arrays:
-            if a.ndim > ax and a.shape[ax] != b:
+            if self._has_dim(a) and a.shape[ax] != b:
                 pad = [(0, 0)] * a.ndim
-                pad[ax] = (0, b - n)
+                pad[ax % a.ndim] = (0, b - n)
                 a = jnp.pad(a, pad, constant_values=self.pad_value)
             padded.append(a)
         out = self._jit(padded, jnp.int32(n))
         # slice outputs that kept the bucketed dim back to the true size
         def unpad(o):
-            if (hasattr(o, "ndim") and o.ndim > ax
-                    and o.shape[ax] == b and b != n):
+            if (self._has_dim(o) and o.shape[ax] == b and b != n):
                 return jax.lax.slice_in_dim(o, 0, n, axis=ax)
             return o
         return jax.tree_util.tree_map(unpad, out)

@@ -123,6 +123,7 @@ def define_grad(ext, op_name: str, grad_fn: Callable):
     ``no_grad``/inference. (The reference's custom-op grad kernels map
     to this: one more function, not another ABI.)"""
     from ..ops.registry import register_op
+    from ..autograd import tape as _tape
 
     fwd = getattr(ext, op_name)
 
@@ -130,7 +131,17 @@ def define_grad(ext, op_name: str, grad_fn: Callable):
         return grad_fn(*args, **kwargs)
 
     op.__name__ = f"{op_name}_diff"
-    diff = register_op(name=f"{ext.__name__}.{op_name}_diff",
-                       also_method=False)(op)
-    setattr(ext, op_name + "_diff", diff)
-    return diff
+    diff_inner = register_op(name=f"{ext.__name__}.{op_name}_diff",
+                             also_method=False)(op)
+
+    def dispatch(*args, **kwargs):
+        # honour the documented contract: the FFI kernel IS the forward
+        # when no gradient is needed; the surrogate only runs when the
+        # tape must record a differentiable computation
+        if not _tape.grad_enabled():
+            return fwd(*args, **kwargs)
+        return diff_inner(*args, **kwargs)
+
+    dispatch.__name__ = f"{op_name}_diff"
+    setattr(ext, op_name + "_diff", dispatch)
+    return dispatch

@@ -97,6 +97,11 @@ def test_fit_with_batch_size_rebatches():
     assert len(hist) == 4  # 32 samples / bs 8
     with pytest.raises(ValueError, match="batch_size"):
         eng.fit(list(_data(2)), batch_size=8)
+    # partial tail batch and n < batch_size are NOT dropped
+    hist2 = eng.fit((xs[:10], ys[:10]), epochs=1, batch_size=8)
+    assert len(hist2) == 2
+    hist3 = eng.fit((xs[:4], ys[:4]), epochs=1, batch_size=8)
+    assert len(hist3) == 1
 
 
 def test_evaluate_reports_metrics():
@@ -125,6 +130,33 @@ def test_evaluate_reports_metrics():
                  strategy=Strategy(dp_degree=2, mp_degree=1))
     res = eng.evaluate(list(_data(2)))
     assert "mean_abs" in res and np.isfinite(res["mean_abs"])
+
+
+def test_evaluate_with_builtin_accuracy_metric():
+    """Built-in metrics use the hapi protocol: compute() returns the
+    update() args (possibly a tuple), name() may be a list."""
+    import paddle_tpu.metric as M
+
+    class Clf(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = pt.nn.Linear(8, 4)
+
+        def forward(self, x):
+            return pt.nn.functional.softmax(self.fc(x))
+
+    rng = np.random.RandomState(0)
+    data = [(rng.randn(8, 8).astype(np.float32),
+             rng.randint(0, 4, (8, 1)).astype(np.int64))
+            for _ in range(2)]
+    model = Clf()
+    eng = Engine(model, loss=pt.nn.functional.cross_entropy,
+                 optimizer=pt.optimizer.AdamW(
+                     learning_rate=1e-3, parameters=model.parameters()),
+                 metrics=[M.Accuracy(topk=(1, 2))],
+                 strategy=Strategy(dp_degree=2, mp_degree=1))
+    res = eng.evaluate(data)
+    assert "acc_top1" in res and "acc_top2" in res, res
 
 
 def test_replicated_sharding_does_not_count_as_user_placement():

@@ -1,0 +1,120 @@
+"""Domain APIs: fft, signal (stft/istft), distribution, geometric.
+
+VERDICT round-2 flagged these modules as live-but-untested; these are
+numeric checks against scipy-free closed forms and round-trip
+identities (reference: python/paddle/fft.py, signal.py,
+distribution/, geometric/).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# ------------------------------------------------------------------ fft ----
+
+def test_fft_roundtrip_and_parseval():
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 16).astype(np.float32)
+    X = pt.fft.fft(pt.to_tensor(x.astype(np.complex64)))
+    back = pt.fft.ifft(X)
+    np.testing.assert_allclose(np.asarray(back.numpy()).real, x,
+                               atol=1e-4)
+    # Parseval: sum|x|^2 == sum|X|^2 / N
+    e_t = (x ** 2).sum()
+    e_f = (np.abs(np.asarray(X.numpy())) ** 2).sum() / 16
+    np.testing.assert_allclose(e_t, e_f, rtol=1e-4)
+
+
+def test_rfft_matches_numpy():
+    x = np.random.RandomState(1).randn(4, 32).astype(np.float32)
+    got = pt.fft.rfft(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(got, np.fft.rfft(x), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_fftfreq():
+    np.testing.assert_allclose(pt.fft.fftfreq(8, d=0.5).numpy(),
+                               np.fft.fftfreq(8, d=0.5), rtol=1e-6)
+
+
+# --------------------------------------------------------------- signal ----
+
+def test_frame_and_overlap_add_roundtrip():
+    x = np.arange(32, dtype=np.float32)
+    frames = pt.signal.frame(pt.to_tensor(x), frame_length=8,
+                             hop_length=8)
+    # non-overlapping frames reassemble exactly
+    back = pt.signal.overlap_add(frames, hop_length=8)
+    np.testing.assert_allclose(back.numpy(), x)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(2)
+    x = rng.randn(1, 512).astype(np.float32)
+    spec = pt.signal.stft(pt.to_tensor(x), n_fft=64, hop_length=16)
+    back = pt.signal.istft(spec, n_fft=64, hop_length=16)
+    n = min(back.shape[-1], x.shape[-1])
+    np.testing.assert_allclose(np.asarray(back.numpy())[..., 32:n - 32],
+                               x[..., 32:n - 32], atol=1e-3)
+
+
+# --------------------------------------------------------- distribution ----
+
+def test_normal_log_prob_and_sampling_moments():
+    d = pt.distribution.Normal(loc=1.0, scale=2.0)
+    lp = float(d.log_prob(pt.to_tensor(np.float32(1.0))).numpy())
+    np.testing.assert_allclose(lp, -np.log(2.0 * np.sqrt(2 * np.pi)),
+                               rtol=1e-5)
+    s = d.sample([20000])
+    np.testing.assert_allclose(float(s.numpy().mean()), 1.0, atol=0.1)
+    np.testing.assert_allclose(float(s.numpy().std()), 2.0, atol=0.1)
+
+
+def test_kl_divergence_normal_closed_form():
+    p = pt.distribution.Normal(loc=0.0, scale=1.0)
+    q = pt.distribution.Normal(loc=1.0, scale=2.0)
+    kl = float(pt.distribution.kl_divergence(p, q).numpy())
+    want = np.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+    np.testing.assert_allclose(kl, want, rtol=1e-5)
+
+
+def test_categorical_and_bernoulli():
+    c = pt.distribution.Categorical(
+        probs=pt.to_tensor(np.array([0.2, 0.3, 0.5], np.float32)))
+    s = c.sample([5000]).numpy()
+    assert set(np.unique(s)) <= {0, 1, 2}
+    frac2 = (s == 2).mean()
+    assert 0.4 < frac2 < 0.6
+    b = pt.distribution.Bernoulli(0.25)
+    lp = float(b.log_prob(pt.to_tensor(np.float32(1.0))).numpy())
+    np.testing.assert_allclose(lp, np.log(0.25), rtol=1e-5)
+
+
+def test_gamma_beta_entropy_finite():
+    for d in (pt.distribution.Gamma(2.0, 3.0),
+              pt.distribution.Beta(2.0, 5.0),
+              pt.distribution.Laplace(0.0, 1.0)):
+        assert np.isfinite(float(np.asarray(d.entropy().numpy())))
+
+
+# ------------------------------------------------------------ geometric ----
+
+def test_segment_ops():
+    data = pt.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                 np.float32))
+    seg = pt.to_tensor(np.array([0, 0, 1], np.int32))
+    s = pt.geometric.segment_sum(data, seg).numpy()
+    np.testing.assert_allclose(s, [[4., 6.], [5., 6.]])
+    m = pt.geometric.segment_mean(data, seg).numpy()
+    np.testing.assert_allclose(m, [[2., 3.], [5., 6.]])
+    mx = pt.geometric.segment_max(data, seg).numpy()
+    np.testing.assert_allclose(mx, [[3., 4.], [5., 6.]])
+
+
+def test_send_u_recv_message_passing():
+    x = pt.to_tensor(np.array([[1.], [2.], [4.]], np.float32))
+    src = pt.to_tensor(np.array([0, 1, 2], np.int32))
+    dst = pt.to_tensor(np.array([1, 2, 0], np.int32))
+    out = pt.geometric.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+    np.testing.assert_allclose(out, [[4.], [1.], [2.]])

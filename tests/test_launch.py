@@ -76,6 +76,29 @@ def test_env_wiring_single_proc(tmp_path):
     assert "ENV_OK" in res.stdout
 
 
+def test_elastic_restart_retries_and_succeeds(tmp_path):
+    """Elastic: worker fails on attempt 0, succeeds on attempt 1 — the
+    launcher restarts the whole job (reference elastic manager loop)."""
+    res = _run_launch(["--nproc", "1", "--max_restarts", "2"], """
+        import os, sys
+        attempt = int(os.environ["PADDLE_RESTART_ATTEMPT"])
+        if attempt == 0:
+            sys.exit(7)     # first attempt dies
+        print("RECOVERED on attempt", attempt)
+        """, tmp_path)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "RECOVERED on attempt 1" in res.stdout
+    assert "restarting" in res.stderr
+
+
+def test_elastic_exhausts_restarts(tmp_path):
+    res = _run_launch(["--nproc", "1", "--max_restarts", "1"], """
+        import sys
+        sys.exit(9)
+        """, tmp_path)
+    assert res.returncode == 9
+
+
 def test_multinode_requires_master(tmp_path):
     script = tmp_path / "noop.py"
     script.write_text("print('hi')")
