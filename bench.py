@@ -67,12 +67,19 @@ def main():
 
         state, _ = run_n(2, state)  # compile + warmup
         n0, n1 = max(iters // 4, 1), iters
-        t0 = time.perf_counter()
-        state, _ = run_n(n0, state)
-        t_short = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        state, loss = run_n(n1, state)
-        t_long = time.perf_counter() - t0
+        # repeat and take min of EACH chain time separately before
+        # differencing: min-of-the-difference would prefer a repeat
+        # whose short chain got slowed by a time-share neighbour
+        # (inflated subtrahend -> understated dt -> overstated MFU)
+        t_short = t_long = float("inf")
+        loss = None
+        for _ in range(2):
+            t0 = time.perf_counter()
+            state, _ = run_n(n0, state)
+            t_short = min(t_short, time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            state, loss = run_n(n1, state)
+            t_long = min(t_long, time.perf_counter() - t0)
         dt = (t_long - t_short) / (n1 - n0)
 
         if on_tpu:

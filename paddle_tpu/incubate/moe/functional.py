@@ -101,6 +101,41 @@ def top_k_gating(
     return dispatch, combine, aux_loss
 
 
+def moe_ffn_dropless(
+    x: jax.Array,
+    gate_w: jax.Array,
+    w_gate: jax.Array,
+    w_up: jax.Array,
+    w_down: jax.Array,
+    *,
+    top_k: int = 2,
+):
+    """Dropless token-choice MoE FFN (same contract as :func:`moe_ffn`,
+    returns ``(y, aux_loss)``): routes through the authored grouped-GEMM
+    Pallas kernel (ops/pallas/grouped_matmul.py) — no capacity factor,
+    nothing dropped. Single-device/dp layouts; EP all_to_all dispatch
+    stays on :func:`moe_ffn`. The load-balance aux loss uses the SAME
+    switch-gate spelling as :func:`top_k_gating` so the two paths cannot
+    drift."""
+    from ...ops.pallas.grouped_matmul import moe_mlp_dropless
+
+    orig_shape = x.shape
+    D = orig_shape[-1]
+    E = w_gate.shape[0]
+    xs = x.reshape(-1, D)
+    logits = xs.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    raw_gates = jax.nn.softmax(logits, axis=-1)
+    cw, eids = jax.lax.top_k(raw_gates, top_k)
+    # aux: identical formula to top_k_gating (top-1 density x mean prob)
+    density = jnp.mean(jax.nn.one_hot(eids[:, 0], E, dtype=jnp.float32),
+                       axis=0)
+    density_proxy = jnp.mean(raw_gates, axis=0)
+    aux = jnp.mean(density * density_proxy) * (E * E)
+    y = moe_mlp_dropless(xs, eids, cw.astype(x.dtype), w_gate, w_up,
+                         w_down)
+    return y.reshape(orig_shape), aux
+
+
 def moe_expert_compute(
     xs: jax.Array,
     dispatch: jax.Array,

@@ -43,6 +43,13 @@ class Qwen2MoeConfig:
     shared_expert_intermediate_size: int = 5632
     capacity_factor: float = 2.0
     router_aux_loss_coef: float = 0.001
+    # "einsum": GShard capacity dispatch (drops overflow tokens; the
+    # all_to_all EP path). "dropless": the authored grouped-GEMM Pallas
+    # kernel (ops/pallas/grouped_matmul.py) — no capacity, no drops;
+    # engages only when expert weights are unsharded (no ep/tp axis —
+    # the kernel has no shard_map partitioning rule yet); other layouts
+    # fall back to the einsum path automatically.
+    moe_impl: str = "einsum"
     dtype: Any = jnp.bfloat16
     remat: bool = True
     use_flash_attention: bool = True
@@ -146,7 +153,8 @@ def shard_params(params, cfg: Qwen2MoeConfig, mesh: Mesh):
         put, params, specs, is_leaf=lambda x: isinstance(x, P))
 
 
-def decoder_layer(lp, h, cfg: Qwen2MoeConfig, ep_axis: Optional[str]):
+def decoder_layer(lp, h, cfg: Qwen2MoeConfig, ep_axis: Optional[str],
+                  use_dropless: bool = False):
     B, T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
@@ -162,13 +170,21 @@ def decoder_layer(lp, h, cfg: Qwen2MoeConfig, ep_axis: Optional[str]):
     h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
 
     x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-    routed, aux = moe_ffn(
-        x, lp["router"],
-        lp["experts"]["w_gate"], lp["experts"]["w_up"],
-        lp["experts"]["w_down"],
-        top_k=cfg.num_experts_per_tok,
-        capacity_factor=cfg.capacity_factor,
-        ep_axis=ep_axis)
+    if use_dropless:
+        from ..incubate.moe.functional import moe_ffn_dropless
+        routed, aux = moe_ffn_dropless(
+            x, lp["router"],
+            lp["experts"]["w_gate"], lp["experts"]["w_up"],
+            lp["experts"]["w_down"],
+            top_k=cfg.num_experts_per_tok)
+    else:
+        routed, aux = moe_ffn(
+            x, lp["router"],
+            lp["experts"]["w_gate"], lp["experts"]["w_up"],
+            lp["experts"]["w_down"],
+            top_k=cfg.num_experts_per_tok,
+            capacity_factor=cfg.capacity_factor,
+            ep_axis=ep_axis)
     sh = lp["shared"]
     shared = (jax.nn.silu(x @ sh["w_gate"]) * (x @ sh["w_up"])) @ sh["w_down"]
     shared = jax.nn.sigmoid(x @ sh["gate"]) * shared
@@ -178,11 +194,20 @@ def decoder_layer(lp, h, cfg: Qwen2MoeConfig, ep_axis: Optional[str]):
 def forward(params, tokens, cfg: Qwen2MoeConfig,
             mesh: Optional[Mesh] = None):
     """tokens [B, T] -> (logits [B, T, V], total_aux_loss)."""
+    if cfg.moe_impl not in ("einsum", "dropless"):
+        raise ValueError(f"moe_impl must be 'einsum' or 'dropless', "
+                         f"got {cfg.moe_impl!r}")
     ep_axis = ("ep" if mesh is not None and mesh.shape.get("ep", 1) > 1
                else None)
+    # the grouped-GEMM kernel has no shard_map partitioning rule yet, so
+    # dropless only engages on layouts where the expert weights are not
+    # ep/tp-sharded (GSPMD would otherwise all-gather them per step)
+    use_dropless = (cfg.moe_impl == "dropless" and ep_axis is None
+                    and (mesh is None or mesh.shape.get("tp", 1) == 1))
     h = params["embed"].astype(cfg.dtype)[tokens]
 
-    fn = partial(decoder_layer, cfg=cfg, ep_axis=ep_axis)
+    fn = partial(decoder_layer, cfg=cfg, ep_axis=ep_axis,
+                 use_dropless=use_dropless)
     if cfg.remat:
         fn = jax.checkpoint(fn)
 

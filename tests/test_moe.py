@@ -116,3 +116,27 @@ def test_qwen2_moe_train_step_decreases_loss():
             losses.append(float(l))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_qwen2_moe_dropless_impl_trains():
+    """cfg.moe_impl='dropless' routes the MoE FFN through the authored
+    grouped-GEMM kernel; the train step must run and improve."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.models import qwen2_moe as Q
+    from paddle_tpu.parallel import init_hybrid_mesh
+    hm = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
+    cfg = Q.Qwen2MoeConfig.tiny(dtype=jnp.float32, remat=False,
+                                use_flash_attention=False,
+                                moe_impl="dropless")
+    with hm.mesh:
+        step, init = Q.make_train_step(cfg, hm.mesh)
+        state = init(jax.random.PRNGKey(0))
+        batch = Q.make_batch(cfg, batch_size=2, seq_len=16, mesh=hm.mesh)
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, batch)
+            losses.append(float(loss))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
