@@ -36,12 +36,30 @@ from . import ops  # noqa: F401
 bool = bool_  # paddle.bool
 
 
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    """Free-standing parameter factory (reference:
+    python/paddle/tensor/creation.py create_parameter)."""
+    from .nn import initializer as I
+    dtype = _dtype_mod.to_framework_dtype(dtype or "float32")
+    init = default_initializer
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    p = Parameter(init(shape, dtype), name=name or "")
+    if attr is not None and getattr(attr, "trainable", True) is False:
+        p.stop_gradient = True
+        p.trainable = False
+    return p
+
+
 _LAZY_SUBMODULES = (
     "nn", "optimizer", "io", "amp", "jit", "distributed", "vision", "metric",
     "incubate", "models", "profiler", "autograd", "static", "sparse", "fft",
     "signal", "linalg", "text", "audio", "hapi", "device", "regularizer",
     "distribution", "quantization", "geometric", "onnx", "utils", "version",
-    "callbacks", "parallel",
+    "callbacks", "parallel", "strings",
 )
 
 

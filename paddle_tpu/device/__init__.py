@@ -56,3 +56,9 @@ class cuda:
     @staticmethod
     def device_count() -> int:
         return device_count()
+
+
+from .custom import (  # noqa: E402,F401
+    register_custom_device, register_custom_devices_from_env,
+    get_all_custom_device_type, is_custom_device_available,
+)

@@ -1,0 +1,83 @@
+"""Out-of-tree device plugins — the PJRT answer to CustomDevice.
+
+Reference: paddle/phi/backends/custom/ — a C function-pointer table
+(device_ext.h:107-383: ~60 slots covering init/deinit, stream/event,
+memcpy h2d/d2h/d2d, allocate, collectives via XCCL) that a vendor .so
+fills in, discovered from CUSTOM_DEVICE_ROOT and registered by
+``custom_device.cc`` LoadCustomRuntimeLib.
+
+The TPU-native equivalent is the PJRT plugin ABI: the C API every XLA
+backend (TPU, GPU, and out-of-tree silicon) implements. One plugin .so
+exports ``GetPjrtApi``; JAX discovers it either from the
+``PJRT_NAMES_AND_LIBRARY_PATHS`` env (name:path pairs) or from
+installed ``jax_plugins.*`` namespace packages. PJRT subsumes both
+halves of the reference's ABI — the device table (compile/execute/
+transfer/alloc) AND the XCCL collective table (collectives live behind
+PJRT's compiled-executable interface) — so this module is deliberately
+a registrar, not a reimplementation of a 60-slot table: the stable ABI
+already exists, we point the runtime at vendor libraries that speak it.
+
+``register_custom_device("mychip", "/opt/mychip/pjrt_mychip.so")`` is
+the CUSTOM_DEVICE_ROOT moment: after it, ``jax.devices("mychip")``
+(and therefore every paddle_tpu op, shard_map, collective, and jit) run
+on the plugin's devices with no further framework changes.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+_REGISTERED: Dict[str, str] = {}
+
+
+def register_custom_device(name: str, library_path: str,
+                           options: Optional[dict] = None,
+                           priority: int = 400) -> None:
+    """Register a PJRT plugin .so as backend ``name``.
+
+    Must be called before the first jax operation (backends initialize
+    once per process — same constraint as the reference's
+    LoadCustomRuntimeLib, which runs at framework-init).
+    """
+    if not os.path.exists(library_path):
+        raise FileNotFoundError(
+            f"PJRT plugin for device '{name}' not found: {library_path}")
+    import jax
+    from jax._src import xla_bridge
+
+    if xla_bridge.backends_are_initialized():
+        raise RuntimeError(
+            "register_custom_device must run before JAX backends "
+            "initialize (import paddle_tpu, register, then compute)")
+    xla_bridge.register_plugin(
+        name, library_path=library_path, options=options, priority=priority)
+    _REGISTERED[name] = library_path
+
+
+def register_custom_devices_from_env(env: str = "PADDLE_TPU_CUSTOM_DEVICES"
+                                     ) -> List[str]:
+    """Bulk registration from ``name:/path/to/plugin.so;name2:/p2.so``
+    (the CUSTOM_DEVICE_ROOT discovery pattern, env-driven)."""
+    spec = os.environ.get(env, "")
+    names = []
+    for pair in filter(None, spec.split(";")):
+        name, _, path = pair.partition(":")
+        register_custom_device(name.strip(), path.strip())
+        names.append(name.strip())
+    return names
+
+
+def get_all_custom_device_type() -> List[str]:
+    """Names registered in this process (reference
+    python/paddle/device/__init__.py get_all_custom_device_type)."""
+    return sorted(_REGISTERED)
+
+
+def is_custom_device_available(name: str) -> bool:
+    if name not in _REGISTERED:
+        return False
+    import jax
+    try:
+        return len(jax.devices(name)) > 0
+    except RuntimeError:
+        return False

@@ -7,3 +7,4 @@ from . import moe  # noqa: F401
 from .nn import functional as _fused  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import asp  # noqa: F401

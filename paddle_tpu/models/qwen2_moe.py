@@ -199,11 +199,14 @@ def forward(params, tokens, cfg: Qwen2MoeConfig,
                          f"got {cfg.moe_impl!r}")
     ep_axis = ("ep" if mesh is not None and mesh.shape.get("ep", 1) > 1
                else None)
-    # the grouped-GEMM kernel has no shard_map partitioning rule yet, so
-    # dropless only engages on layouts where the expert weights are not
-    # ep/tp-sharded (GSPMD would otherwise all-gather them per step)
+    # the grouped-GEMM kernel has no GSPMD partitioning rule yet, so
+    # dropless only engages on layouts where nothing it touches is
+    # sharded: not the expert weights (ep/tp) and not the token
+    # activations either (dp — an un-partitionable pallas_call would
+    # make XLA replicate the full activation on every dp rank per step)
     use_dropless = (cfg.moe_impl == "dropless" and ep_axis is None
-                    and (mesh is None or mesh.shape.get("tp", 1) == 1))
+                    and (mesh is None or (mesh.shape.get("tp", 1) == 1
+                                          and mesh.shape.get("dp", 1) == 1)))
     h = params["embed"].astype(cfg.dtype)[tokens]
 
     fn = partial(decoder_layer, cfg=cfg, ep_axis=ep_axis,

@@ -2,3 +2,4 @@
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
                         RMSProp, Adamax, Lamb, L1Decay, L2Decay)
+from .lbfgs import LBFGS

@@ -1,0 +1,324 @@
+"""L-BFGS optimizer with strong-Wolfe line search.
+
+Reference: python/paddle/optimizer/lbfgs.py (class LBFGS, _strong_wolfe).
+Redesigned, not translated: the reference walks per-parameter dense
+tensors with its own flatten/offset bookkeeping; here the history and
+direction math run on ONE flat f32 vector (ravel of all trainable
+params), which XLA handles as a handful of fused vector ops — there is
+no per-parameter kernel-launch cost to amortise on TPU. The closure
+runs the user's eager forward+backward, so this composes with the tape
+(autograd/tape.py) exactly like the reference's dygraph LBFGS.
+
+Like the reference, ``step(closure)`` may evaluate the closure several
+times (line search); state (history, Hessian-diagonal estimate) lives
+on the optimizer and is checkpointable via ``state_dict``.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..autograd import no_grad
+from .optimizer import Optimizer
+
+
+def _cubic_interpolate(x1, f1, g1, x2, f2, g2, bounds=None):
+    """Minimizer of the cubic through (x1,f1,g1),(x2,f2,g2); reference
+    lbfgs.py _cubic_interpolate."""
+    if bounds is not None:
+        xmin_bound, xmax_bound = bounds
+    else:
+        xmin_bound, xmax_bound = (x1, x2) if x1 <= x2 else (x2, x1)
+    d1 = g1 + g2 - 3 * (f1 - f2) / (x1 - x2)
+    d2_square = d1 ** 2 - g1 * g2
+    if d2_square >= 0:
+        d2 = d2_square ** 0.5
+        if x1 <= x2:
+            min_pos = x2 - (x2 - x1) * ((g2 + d2 - d1) / (g2 - g1 + 2 * d2))
+        else:
+            min_pos = x1 - (x1 - x2) * ((g1 + d2 - d1) / (g1 - g2 + 2 * d2))
+        return min(max(min_pos, xmin_bound), xmax_bound)
+    return (xmin_bound + xmax_bound) / 2.0
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS (reference python/paddle/optimizer/lbfgs.py).
+
+    Usage (paddle UX)::
+
+        opt = LBFGS(parameters=model.parameters(), line_search_fn="strong_wolfe")
+        def closure():
+            opt.clear_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            return loss
+        loss = opt.step(closure)
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn: Optional[str] = None,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         False, name)
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("only line_search_fn='strong_wolfe' is "
+                             f"supported, got {line_search_fn!r}")
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        # flat-vector history (host numpy: tiny, control-flow heavy)
+        self._old_dirs: List[np.ndarray] = []
+        self._old_stps: List[np.ndarray] = []
+        self._ro: List[float] = []
+        self._H_diag = 1.0
+        self._prev_flat_grad: Optional[np.ndarray] = None
+        self._d: Optional[np.ndarray] = None
+        self._t = 0.0
+        self._n_iter = 0
+
+    # -- flat <-> params ----------------------------------------------------
+    def _trainable(self):
+        return [p for p in self._param_list if not p.stop_gradient]
+
+    def _gather_flat_grad(self) -> np.ndarray:
+        parts = []
+        for p in self._trainable():
+            g = p._grad._data if p._grad is not None else jnp.zeros_like(p._data)
+            if self._weight_decay is not None:
+                g = g + self._decay_coeff(p) * p._data.astype(g.dtype)
+            parts.append(np.asarray(g, np.float64).ravel())
+        return np.concatenate(parts)
+
+    def _gather_flat_param(self) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray(p._data, np.float64).ravel() for p in self._trainable()])
+
+    @no_grad()
+    def _set_flat_param(self, flat: np.ndarray):
+        off = 0
+        for p in self._trainable():
+            n = int(np.prod(p._data.shape)) if p._data.ndim else 1
+            chunk = flat[off:off + n].reshape(p._data.shape)
+            p._data = jnp.asarray(chunk, p._data.dtype)
+            off += n
+
+    # -- strong wolfe (reference lbfgs.py _strong_wolfe) --------------------
+    def _directional_evaluate(self, closure, x0, t, d):
+        self._set_flat_param(x0 + t * d)
+        loss = float(closure())
+        g = self._gather_flat_grad()
+        return loss, g
+
+    def _strong_wolfe(self, closure, x0, t, d, f, g, gtd,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        d_norm = float(np.abs(d).max())
+        g = g.copy()
+        f_new, g_new = self._directional_evaluate(closure, x0, t, d)
+        ls_func_evals = 1
+        gtd_new = float(g_new @ d)
+
+        # bracket phase
+        t_prev, f_prev, g_prev, gtd_prev = 0.0, f, g, gtd
+        done = False
+        ls_iter = 0
+        while ls_iter < max_ls:
+            if f_new > (f + c1 * t * gtd) or (ls_iter > 1 and f_new >= f_prev):
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new.copy()]
+                bracket_gtd = [gtd_prev, gtd_new]
+                break
+            if abs(gtd_new) <= -c2 * gtd:
+                bracket = [t, t]
+                bracket_f = [f_new, f_new]
+                bracket_g = [g_new, g_new]
+                done = True
+                break
+            if gtd_new >= 0:
+                bracket = [t_prev, t]
+                bracket_f = [f_prev, f_new]
+                bracket_g = [g_prev, g_new.copy()]
+                bracket_gtd = [gtd_prev, gtd_new]
+                break
+            min_step = t + 0.01 * (t - t_prev)
+            max_step = t * 10
+            tmp = t
+            t = _cubic_interpolate(t_prev, f_prev, gtd_prev, t, f_new,
+                                   gtd_new, bounds=(min_step, max_step))
+            t_prev, f_prev, g_prev, gtd_prev = tmp, f_new, g_new.copy(), gtd_new
+            f_new, g_new = self._directional_evaluate(closure, x0, t, d)
+            ls_func_evals += 1
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+        else:
+            bracket = [0.0, t]
+            bracket_f = [f, f_new]
+            bracket_g = [g, g_new]
+            bracket_gtd = [gtd, gtd_new]
+
+        # zoom phase
+        insuf_progress = False
+        low_pos, high_pos = (0, 1) if bracket_f[0] <= bracket_f[-1] else (1, 0)
+        while not done and ls_iter < max_ls:
+            if abs(bracket[1] - bracket[0]) * d_norm < self.tolerance_change:
+                break
+            t = _cubic_interpolate(bracket[0], bracket_f[0], bracket_gtd[0],
+                                   bracket[1], bracket_f[1], bracket_gtd[1])
+            eps = 0.1 * abs(bracket[1] - bracket[0])
+            if min(max(bracket) - t, t - min(bracket)) < eps:
+                if insuf_progress or t >= max(bracket) or t <= min(bracket):
+                    t = (max(bracket) - eps if abs(t - max(bracket))
+                         < abs(t - min(bracket)) else min(bracket) + eps)
+                    insuf_progress = False
+                else:
+                    insuf_progress = True
+            else:
+                insuf_progress = False
+            f_new, g_new = self._directional_evaluate(closure, x0, t, d)
+            ls_func_evals += 1
+            gtd_new = float(g_new @ d)
+            ls_iter += 1
+            if f_new > (f + c1 * t * gtd) or f_new >= bracket_f[low_pos]:
+                bracket[high_pos] = t
+                bracket_f[high_pos] = f_new
+                bracket_g[high_pos] = g_new.copy()
+                bracket_gtd[high_pos] = gtd_new
+                low_pos, high_pos = ((0, 1) if bracket_f[0] <= bracket_f[1]
+                                     else (1, 0))
+            else:
+                if abs(gtd_new) <= -c2 * gtd:
+                    done = True
+                elif gtd_new * (bracket[high_pos] - bracket[low_pos]) >= 0:
+                    bracket[high_pos] = bracket[low_pos]
+                    bracket_f[high_pos] = bracket_f[low_pos]
+                    bracket_g[high_pos] = bracket_g[low_pos]
+                    bracket_gtd[high_pos] = bracket_gtd[low_pos]
+                bracket[low_pos] = t
+                bracket_f[low_pos] = f_new
+                bracket_g[low_pos] = g_new.copy()
+                bracket_gtd[low_pos] = gtd_new
+
+        t = bracket[low_pos]
+        f_new = bracket_f[low_pos]
+        g_new = bracket_g[low_pos]
+        return f_new, g_new, t, ls_func_evals
+
+    # -- step ---------------------------------------------------------------
+    def step(self, closure: Callable[[], "Tensor"] = None):
+        """One LBFGS iteration group (up to ``max_iter`` inner updates).
+        ``closure`` must clear grads, compute the loss, call backward, and
+        return the loss — it will be called multiple times."""
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure")
+        self._sync_lr()
+        lr = float(np.asarray(self._lr._data))
+
+        orig_loss = closure()
+        loss = float(orig_loss)
+        current_evals = 1
+        flat_grad = self._gather_flat_grad()
+        if float(np.abs(flat_grad).max()) <= self.tolerance_grad:
+            return orig_loss
+
+        n_iter = 0
+        while n_iter < self.max_iter:
+            n_iter += 1
+            self._n_iter += 1
+            if self._n_iter == 1:
+                self._d = -flat_grad
+                self._H_diag = 1.0
+            else:
+                y = flat_grad - self._prev_flat_grad
+                s = self._d * self._t
+                ys = float(y @ s)
+                if ys > 1e-10:
+                    if len(self._old_dirs) == self.history_size:
+                        self._old_dirs.pop(0)
+                        self._old_stps.pop(0)
+                        self._ro.pop(0)
+                    self._old_dirs.append(y)
+                    self._old_stps.append(s)
+                    self._ro.append(1.0 / ys)
+                    self._H_diag = ys / float(y @ y)
+                # two-loop recursion
+                num_old = len(self._old_dirs)
+                al = [0.0] * num_old
+                q = -flat_grad
+                for i in range(num_old - 1, -1, -1):
+                    al[i] = float(self._old_stps[i] @ q) * self._ro[i]
+                    q = q - al[i] * self._old_dirs[i]
+                d = q * self._H_diag
+                for i in range(num_old):
+                    be_i = float(self._old_dirs[i] @ d) * self._ro[i]
+                    d = d + self._old_stps[i] * (al[i] - be_i)
+                self._d = d
+            self._prev_flat_grad = flat_grad.copy()
+            prev_loss = loss
+
+            # -- step length
+            if self._n_iter == 1:
+                self._t = min(1.0, 1.0 / float(np.abs(flat_grad).sum())) * lr
+            else:
+                self._t = lr
+            gtd = float(flat_grad @ self._d)
+            if gtd > -self.tolerance_change:
+                break
+            if self.line_search_fn == "strong_wolfe":
+                x0 = self._gather_flat_param()
+                loss, flat_grad, self._t, ls_evals = self._strong_wolfe(
+                    closure, x0, self._t, self._d, loss, flat_grad, gtd)
+                self._set_flat_param(x0 + self._t * self._d)
+                current_evals += ls_evals
+            else:
+                self._set_flat_param(
+                    self._gather_flat_param() + self._t * self._d)
+                if n_iter != self.max_iter:
+                    loss = float(closure())
+                    flat_grad = self._gather_flat_grad()
+                    current_evals += 1
+
+            # -- convergence checks
+            if current_evals >= self.max_eval:
+                break
+            if float(np.abs(flat_grad).max()) <= self.tolerance_grad:
+                break
+            if float(np.abs(self._d * self._t).max()) <= self.tolerance_change:
+                break
+            if abs(loss - prev_loss) < self.tolerance_change:
+                break
+
+        self._step_count._data = self._step_count._data + 1
+        return orig_loss
+
+    def state_dict(self):
+        return {
+            "old_dirs": [np.asarray(a) for a in self._old_dirs],
+            "old_stps": [np.asarray(a) for a in self._old_stps],
+            "ro": list(self._ro),
+            "H_diag": self._H_diag,
+            "prev_flat_grad": (None if self._prev_flat_grad is None
+                               else np.asarray(self._prev_flat_grad)),
+            "d": None if self._d is None else np.asarray(self._d),
+            "t": self._t,
+            "n_iter": self._n_iter,
+        }
+
+    def set_state_dict(self, state):
+        self._old_dirs = [np.asarray(a) for a in state.get("old_dirs", [])]
+        self._old_stps = [np.asarray(a) for a in state.get("old_stps", [])]
+        self._ro = list(state.get("ro", []))
+        self._H_diag = state.get("H_diag", 1.0)
+        pfg = state.get("prev_flat_grad")
+        self._prev_flat_grad = None if pfg is None else np.asarray(pfg)
+        d = state.get("d")
+        self._d = None if d is None else np.asarray(d)
+        self._t = state.get("t", 0.0)
+        self._n_iter = state.get("n_iter", 0)

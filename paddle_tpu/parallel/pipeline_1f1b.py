@@ -32,6 +32,20 @@ Stage inputs live in a circular buffer of depth 2S (the lifetime of a
 saved input is 2(S-s)-1 ticks), which is the O(S)-not-O(M) bound
 (tests/test_pipeline_1f1b.py compares compiled peak memory vs GPipe).
 
+Zero-bubble (reference pipeline_zero_bubble.py, ZB-H1/H2): splits each
+backward into B (input-grad, on the critical path) and W (weight-grad,
+not), and schedules W into the fill/drain bubbles of each RANK. That
+lever does not exist in this lockstep traced form: every tick every
+device executes the same program (one fwd + one bwd per slot via vmap),
+so there are no idle rank-ticks to fill — the bubble manifests as the
+(2S-1)/(M+2S-1) fraction of ticks whose microbatch slot is masked out.
+Deferring W here would have to re-derive the pullback (an extra forward
+per slot-microbatch, cost M*F) to save only (2S-1)*W of masked work — a
+net loss for any M > 2S. The equivalent levers under XLA are: raise M
+(amortizes the fixed bubble), VPP (below, for partition parity), and
+remat inside stage_fn (frees the memory that would have bought ZB-H2's
+schedule). This is a deliberate redesign decision, not an omission.
+
 Interleaved VPP (``virtual_chunks=V > 1``): the layer stack is split
 into V*S chunks and chunk v*S+s is placed on device s (round-robin,
 exactly the reference's VPP partitioning) by laying the slot axis out

@@ -124,6 +124,16 @@ class QuantedLinear(Layer):
         return self.inner(x)
 
 
+def _replace_sublayer(model: Layer, dotted_name: str, new_layer: Layer):
+    """Swap the sublayer at ``a.b.c`` for ``new_layer``."""
+    parent, _, leaf = dotted_name.rpartition(".")
+    holder = model
+    if parent:
+        for part in parent.split("."):
+            holder = getattr(holder, part)
+    setattr(holder, leaf, new_layer)
+
+
 class QAT:
     """Quantization-aware training entry (reference qat.py)."""
 
@@ -136,21 +146,91 @@ class QAT:
         for name, sub in list(model.named_sublayers()):
             if isinstance(sub, Linear):
                 a_cls, w_cls = self.config._for(sub)
-                parent, _, leaf = name.rpartition(".")
-                holder = model
-                if parent:
-                    for part in parent.split("."):
-                        holder = getattr(holder, part)
-                wrapped = QuantedLinear(
+                _replace_sublayer(model, name, QuantedLinear(
                     sub, a_cls() if a_cls else None,
-                    w_cls() if w_cls else None)
-                setattr(holder, leaf, wrapped)
+                    w_cls() if w_cls else None))
         return model
 
 
+class ChannelWiseAbsmaxObserver(BaseQuanter):
+    """Per-output-channel absmax observer for [in, out] Linear weights
+    (reference observers/abs_max_headwise.py / per-channel weight
+    observer). Produces one scale per output feature."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def forward(self, x):
+        data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        cur = jnp.maximum(jnp.max(jnp.abs(data), axis=0), 1e-8)
+        self._scale = (cur if self._scale is None
+                       else jnp.maximum(self._scale, cur))
+        return x
+
+
+class Int8Linear(Layer):
+    """Deployed weight-only int8 Linear: stores the weight as real int8
+    plus a per-output-channel f32 scale and dequantizes into the matmul
+    dtype at call time (reference capability: int8 deploy after
+    PTQ.convert, quantization/ptq.py). Weight-only is the TPU-relevant
+    deployment shape — 2x HBM cut on the weight stream, activations stay
+    bf16 for the MXU."""
+
+    def __init__(self, qweight, scales, bias=None, compute_dtype=None):
+        super().__init__()
+        # buffers, not attributes: state_dict must carry the deployed
+        # weights through save/load
+        self.register_buffer("qweight", Tensor(qweight))   # int8 [in, out]
+        self.register_buffer("scales", Tensor(scales))     # f32 [out]
+        self.bias = bias
+        self.compute_dtype = compute_dtype or jnp.float32
+
+    def forward(self, x):
+        data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        w = (self.qweight.data.astype(self.compute_dtype)
+             * (self.scales.data / 127.0).astype(self.compute_dtype))
+        out = data.astype(self.compute_dtype) @ w
+        if self.bias is not None:
+            out = out + self.bias.data.astype(self.compute_dtype)
+        return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def quantize_weight_int8(w):
+    """[in, out] float weight -> (int8 weight, f32 per-channel scales)."""
+    data = w.data if isinstance(w, Tensor) else jnp.asarray(w)
+    scales = jnp.maximum(jnp.max(jnp.abs(data), axis=0), 1e-8)
+    q = jnp.clip(jnp.round(data / scales * 127.0), -127, 127).astype(jnp.int8)
+    return q, scales.astype(jnp.float32)
+
+
 class PTQ(QAT):
-    """Post-training quantization: same wrapping with observers; calibrate
-    by running representative data, then convert."""
+    """Post-training quantization (reference quantization/ptq.py):
+    ``quantize`` wraps layers with observers, the caller runs
+    representative data through the model (activation calibration), and
+    ``convert`` replaces each observed Linear with an ``Int8Linear``
+    holding real int8 storage. Weight scales come from the weight
+    quanter's observed per-channel scale when it recorded one (e.g.
+    ``ChannelWiseAbsmaxObserver``); otherwise from the weights directly
+    — weights are fully known at convert time, so unlike activations
+    they need no data pass."""
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
-        return model if inplace else copy.deepcopy(model)
+        model = model if inplace else copy.deepcopy(model)
+        for name, sub in list(model.named_sublayers()):
+            if not isinstance(sub, QuantedLinear):
+                continue
+            w = sub.inner.weight
+            observed = getattr(sub.w_quanter, "_scale", None)
+            if (observed is not None and getattr(observed, "ndim", 0) == 1
+                    and observed.shape[0] == w.data.shape[-1]):
+                scales = jnp.asarray(observed, jnp.float32)
+                q = jnp.clip(jnp.round(w.data / scales * 127.0),
+                             -127, 127).astype(jnp.int8)
+            else:
+                q, scales = quantize_weight_int8(w)
+            _replace_sublayer(model, name, Int8Linear(
+                q, scales, bias=sub.inner.bias,
+                compute_dtype=w.data.dtype))
+        return model

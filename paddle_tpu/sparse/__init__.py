@@ -168,3 +168,6 @@ def sin(x, name=None) -> SparseCooTensor:
 
 def is_same_shape(x, y) -> bool:
     return tuple(x.shape) == tuple(y.shape)
+
+
+from . import nn  # noqa: E402,F401  (after class defs: nn imports them)

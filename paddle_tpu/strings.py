@@ -1,0 +1,107 @@
+"""String tensor ops (reference: paddle/phi/kernels/strings/ —
+StringTensor with strings_lower_upper_kernel, strings_copy, plus the
+phi/api strings_api_gen surface paddle._C_ops.strings_*).
+
+Honest TPU position: strings never touch the accelerator — in the
+reference too, string kernels are CPU-only pre/post-processing next to
+the tokenizer. So the storage here is a numpy object array on host, and
+the contract is the API: creation, lower/upper (with the reference's
+use_utf8_encoding switch — False = ASCII-only fast path), equality, and
+conversion to/from the numeric token tensors that DO go to the chip.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Sequence, Union
+
+import numpy as np
+
+__all__ = ["StringTensor", "to_string_tensor", "lower", "upper", "equal",
+           "encode_utf8", "decode_utf8"]
+
+
+class StringTensor:
+    """Host-resident string array (reference phi::StringTensor,
+    paddle/phi/core/string_tensor.h)."""
+
+    def __init__(self, data: Union[np.ndarray, Sequence[str]]):
+        arr = np.asarray(data, dtype=object)
+        bad = [x for x in arr.ravel() if not isinstance(x, str)]
+        if bad:
+            raise TypeError(f"StringTensor holds str only, got {type(bad[0])}")
+        self._data = arr
+
+    @property
+    def shape(self):
+        return tuple(self._data.shape)
+
+    def numpy(self) -> np.ndarray:
+        return self._data
+
+    def tolist(self):
+        return self._data.tolist()
+
+    def __getitem__(self, idx):
+        out = self._data[idx]
+        return out if isinstance(out, str) else StringTensor(out)
+
+    def __len__(self):
+        return len(self._data)
+
+    def __repr__(self):
+        return f"StringTensor(shape={self.shape}, {self._data.tolist()!r})"
+
+
+def to_string_tensor(data) -> StringTensor:
+    return data if isinstance(data, StringTensor) else StringTensor(data)
+
+
+def _map(x: StringTensor, fn) -> StringTensor:
+    return StringTensor(np.vectorize(fn, otypes=[object])(x._data))
+
+
+def lower(x, use_utf8_encoding: bool = False) -> StringTensor:
+    """strings_lower (strings_lower_upper_kernel.h): ASCII tolower by
+    default; full unicode casefold when use_utf8_encoding."""
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _map(x, str.lower)
+    return _map(x, lambda s: "".join(
+        chr(ord(c) + 32) if "A" <= c <= "Z" else c for c in s))
+
+
+def upper(x, use_utf8_encoding: bool = False) -> StringTensor:
+    x = to_string_tensor(x)
+    if use_utf8_encoding:
+        return _map(x, str.upper)
+    return _map(x, lambda s: "".join(
+        chr(ord(c) - 32) if "a" <= c <= "z" else c for c in s))
+
+
+def equal(x, y) -> np.ndarray:
+    return to_string_tensor(x)._data == to_string_tensor(y)._data
+
+
+def encode_utf8(x, maxlen: int = None, pad: int = 0):
+    """StringTensor -> padded uint8 Tensor [n, maxlen] + lengths — the
+    bridge onto the chip (device tensors are numeric)."""
+    from .core.tensor import Tensor
+    import jax.numpy as jnp
+    x = to_string_tensor(x)
+    raw: List[bytes] = [s.encode("utf-8") for s in x._data.ravel()]
+    L = maxlen or max((len(b) for b in raw), default=0)
+    buf = np.full((len(raw), L), pad, np.uint8)
+    lens = np.zeros((len(raw),), np.int32)
+    for i, b in enumerate(raw):
+        b = b[:L]
+        buf[i, :len(b)] = np.frombuffer(b, np.uint8)
+        lens[i] = len(b)
+    return Tensor(jnp.asarray(buf)), Tensor(jnp.asarray(lens))
+
+
+def decode_utf8(codes, lengths) -> StringTensor:
+    buf = np.asarray(codes.data if hasattr(codes, "data") else codes,
+                     np.uint8)
+    lens = np.asarray(lengths.data if hasattr(lengths, "data") else lengths,
+                      np.int64)
+    return StringTensor([bytes(buf[i, :lens[i]]).decode("utf-8")
+                         for i in range(buf.shape[0])])

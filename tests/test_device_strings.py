@@ -1,0 +1,60 @@
+"""Custom-device plugin registrar and string tensor ops.
+
+Reference tests: test/custom_runtime/test_custom_device_*.py (plugin
+load path), test/legacy_test/test_strings_lower_upper_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.device import (register_custom_device,
+                               register_custom_devices_from_env,
+                               get_all_custom_device_type)
+from paddle_tpu import strings
+
+
+def test_register_custom_device_missing_lib():
+    with pytest.raises(FileNotFoundError):
+        register_custom_device("mychip", "/nonexistent/pjrt_mychip.so")
+    assert "mychip" not in get_all_custom_device_type()
+
+
+def test_register_after_backend_init_refuses(tmp_path):
+    # conftest already initialized the CPU backend -> must refuse with
+    # actionable guidance instead of silently never taking effect
+    fake = tmp_path / "pjrt_fake.so"
+    fake.write_bytes(b"\x7fELF")
+    with pytest.raises(RuntimeError, match="before JAX backends"):
+        register_custom_device("fakechip", str(fake))
+
+
+def test_register_from_env_empty(monkeypatch):
+    monkeypatch.delenv("PADDLE_TPU_CUSTOM_DEVICES", raising=False)
+    assert register_custom_devices_from_env() == []
+
+
+def test_strings_lower_upper():
+    st = strings.to_string_tensor(["Hello World", "ABC", "already lower"])
+    low = strings.lower(st)
+    assert low.tolist() == ["hello world", "abc", "already lower"]
+    up = strings.upper(st)
+    assert up.tolist() == ["HELLO WORLD", "ABC", "ALREADY LOWER"]
+    # ascii mode leaves non-ascii untouched; utf8 mode folds it
+    st2 = strings.to_string_tensor(["Straße", "ÀÉÎ"])
+    assert strings.lower(st2).tolist() == ["straße", "ÀÉÎ"]
+    assert strings.lower(st2, use_utf8_encoding=True).tolist() == \
+        ["straße", "àéî"]
+
+
+def test_strings_roundtrip_device_bridge():
+    st = strings.to_string_tensor(["tok", "tokenizer", "日本語"])
+    codes, lens = strings.encode_utf8(st)
+    assert codes.shape[0] == 3 and codes.dtype == np.uint8
+    back = strings.decode_utf8(codes, lens)
+    assert back.tolist() == ["tok", "tokenizer", "日本語"]
+    assert strings.equal(st, back).all()
+
+
+def test_string_tensor_validates():
+    with pytest.raises(TypeError):
+        strings.StringTensor([1, 2, 3])

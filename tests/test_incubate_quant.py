@@ -1,0 +1,146 @@
+"""LBFGS, ASP n:m sparsity, and int8 PTQ deployment.
+
+Mirrors reference tests: test/legacy_test/test_lbfgs_class.py (rosenbrock
+/ quadratic convergence), test/asp/test_asp_pruning_*.py (mask validity +
+density), test/quantization/test_ptq.py (observer->convert numerics).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu import nn
+from paddle_tpu.optimizer import LBFGS
+
+
+class TinyMLP(nn.Layer):
+    def __init__(self, din=8, dh=16, dout=4):
+        super().__init__()
+        self.fc1 = nn.Linear(din, dh)
+        self.fc2 = nn.Linear(dh, dout)
+
+    def forward(self, x):
+        return self.fc2(pt.nn.functional.relu(self.fc1(x)))
+
+
+# ---------------------------------------------------------------- LBFGS
+def test_lbfgs_quadratic_converges():
+    # min ||Ax - b||^2 — LBFGS should reach machine-precision optimum fast
+    rng = np.random.RandomState(0)
+    A = rng.randn(10, 6).astype(np.float32)
+    b = rng.randn(10).astype(np.float32)
+    x = pt.create_parameter([6], "float32")
+
+    opt = LBFGS(parameters=[x], line_search_fn="strong_wolfe", max_iter=50)
+
+    def closure():
+        opt.clear_grad()
+        r = pt.to_tensor(A) @ x - pt.to_tensor(b)
+        loss = (r * r).sum()
+        loss.backward()
+        return loss
+
+    for _ in range(5):
+        opt.step(closure)
+    x_star, *_ = np.linalg.lstsq(A, b, rcond=None)
+    np.testing.assert_allclose(np.asarray(x.data), x_star, atol=1e-4)
+
+
+def test_lbfgs_no_line_search_descends():
+    w = pt.create_parameter([4], "float32")
+
+    opt = LBFGS(parameters=[w], learning_rate=1.0, max_iter=10)
+
+    def closure():
+        opt.clear_grad()
+        loss = ((w - 3.0) ** 2).sum()
+        loss.backward()
+        return loss
+
+    first = float(opt.step(closure))
+    for _ in range(3):
+        last = float(opt.step(closure))
+    assert last < first
+    np.testing.assert_allclose(np.asarray(w.data), 3.0, atol=1e-3)
+
+
+# ------------------------------------------------------------------ ASP
+def test_asp_mask_and_prune():
+    from paddle_tpu.incubate import asp
+
+    w = np.random.RandomState(1).randn(8, 16).astype(np.float32)
+    mask = np.asarray(asp.get_mask_1d(w, 2, 4))
+    assert asp.check_mask_1d(mask, 2, 4)
+    # mask keeps exactly the 2 largest |w| per group of 4
+    groups = (np.abs(w) * mask.reshape(w.shape)).reshape(8, 4, 4)
+    kept_min = np.sort(groups, axis=-1)[..., -2]          # smallest kept
+    dropped = (np.abs(w).reshape(8, 4, 4) * (1 - mask.reshape(8, 4, 4)))
+    assert (dropped.max(-1) <= kept_min + 1e-6).all()
+
+    model = TinyMLP()
+    masks = asp.prune_model(model, n=2, m=4)
+    assert set(masks) == {"fc1.weight", "fc2.weight"}
+    for _, p in [("fc1", model.fc1.weight), ("fc2", model.fc2.weight)]:
+        assert asp.check_sparsity(np.asarray(p.data), 2, 4)
+        assert abs(asp.calculate_density(p) - 0.5) < 0.05
+
+
+def test_asp_decorated_optimizer_keeps_sparsity():
+    from paddle_tpu.incubate import asp
+
+    model = TinyMLP()
+    asp.prune_model(model, n=2, m=4)
+    opt = asp.decorate(pt.optimizer.SGD(
+        learning_rate=0.1, parameters=model.parameters()))
+    x = pt.to_tensor(np.random.RandomState(2).randn(4, 8).astype(np.float32))
+    for _ in range(3):
+        loss = (model(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert asp.check_sparsity(np.asarray(model.fc1.weight.data), 2, 4)
+    assert asp.check_sparsity(np.asarray(model.fc2.weight.data), 2, 4)
+
+
+def test_asp_excluded_layers():
+    from paddle_tpu.incubate import asp
+
+    model = TinyMLP()
+    asp.set_excluded_layers(model, ["fc2"])
+    masks = asp.prune_model(model, n=2, m=4)
+    assert "fc1.weight" in masks and "fc2.weight" not in masks
+    asp.reset_excluded_layers(model)
+
+
+# ------------------------------------------------------------- int8 PTQ
+def test_ptq_convert_int8_numerics():
+    import jax.numpy as jnp
+    from paddle_tpu.quantization import (
+        PTQ, QuantConfig, AbsmaxObserver, ChannelWiseAbsmaxObserver,
+        Int8Linear)
+
+    model = TinyMLP(din=8, dh=32, dout=4)
+    cfg = QuantConfig(activation=AbsmaxObserver,
+                      weight=ChannelWiseAbsmaxObserver)
+    ptq = PTQ(cfg)
+    q = ptq.quantize(model)
+    x = pt.to_tensor(np.random.RandomState(3).randn(16, 8).astype(np.float32))
+    q(x)  # calibrate
+    deployed = ptq.convert(q)
+    assert isinstance(deployed.fc1, Int8Linear)
+    assert deployed.fc1.qweight.data.dtype == jnp.int8
+    # converted scales == the per-channel absmax the observer recorded
+    np.testing.assert_allclose(
+        np.asarray(deployed.fc1.scales.data),
+        np.abs(np.asarray(model.fc1.weight.data)).max(0), rtol=1e-6)
+    ref = np.asarray(model(x).data)
+    got = np.asarray(deployed(x).data)
+    # int8 weight-only: small relative error vs float model
+    denom = max(np.abs(ref).max(), 1e-6)
+    assert np.abs(got - ref).max() / denom < 0.05
+    # int8 weights + scales survive a state_dict round trip
+    sd = deployed.state_dict()
+    assert any("qweight" in k for k in sd)
+    fresh = ptq.convert(ptq.quantize(TinyMLP(din=8, dh=32, dout=4)))
+    fresh(x)
+    fresh.set_state_dict(sd)
+    np.testing.assert_allclose(np.asarray(fresh(x).data), got, atol=1e-6)

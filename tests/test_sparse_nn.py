@@ -1,0 +1,129 @@
+"""Sparse conv / batchnorm / attention vs dense references.
+
+Mirrors reference tests: test/legacy_test/test_sparse_conv_op.py,
+test_sparse_norm_op.py, test_sparse_attention_op.py.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu import sparse
+
+
+def _random_coo(rng, shape, nnz, channels):
+    """Random [N,D,H,W,C] sparse voxel tensor with unique sites."""
+    n, d, h, w = shape
+    sites = set()
+    while len(sites) < nnz:
+        sites.add((rng.randint(n), rng.randint(d), rng.randint(h),
+                   rng.randint(w)))
+    idx = np.asarray(sorted(sites), np.int32)                  # [nnz, 4]
+    vals = rng.randn(nnz, channels).astype(np.float32)
+    return idx, vals
+
+
+def _densify(idx, vals, shape, channels):
+    dense = np.zeros(shape + (channels,), np.float32)
+    for row, (n, d, h, w) in enumerate(idx):
+        dense[n, d, h, w] = vals[row]
+    return dense
+
+
+def _dense_conv3d(x, w, stride=1, padding=0):
+    """Straightforward NDHWC conv for the check (float64 numpy)."""
+    import itertools
+    kd, kh, kw, cin, cout = w.shape
+    N, D, H, W, _ = x.shape
+    pad = np.zeros((N, D + 2 * padding, H + 2 * padding, W + 2 * padding,
+                    cin), np.float64)
+    pad[:, padding:padding + D, padding:padding + H,
+        padding:padding + W] = x
+    oD = (D + 2 * padding - kd) // stride + 1
+    oH = (H + 2 * padding - kh) // stride + 1
+    oW = (W + 2 * padding - kw) // stride + 1
+    out = np.zeros((N, oD, oH, oW, cout), np.float64)
+    for z, y, xx in itertools.product(range(oD), range(oH), range(oW)):
+        patch = pad[:, z * stride:z * stride + kd,
+                    y * stride:y * stride + kh,
+                    xx * stride:xx * stride + kw]          # [N,kd,kh,kw,cin]
+        out[:, z, y, xx] = np.einsum("nijkc,ijkco->no", patch, w)
+    return out
+
+
+def test_subm_conv3d_matches_masked_dense():
+    rng = np.random.RandomState(0)
+    shape, cin, cout = (2, 5, 5, 5), 3, 4
+    idx, vals = _random_coo(rng, shape, nnz=20, channels=cin)
+    sp = sparse.sparse_coo_tensor(idx.T, vals, shape + (cin,))
+    conv = sparse.nn.SubmConv3D(cin, cout, kernel_size=3)
+    out = conv(sp)
+    # submanifold: same coords, values = dense conv at those sites
+    np.testing.assert_array_equal(np.asarray(out.indices()), idx.T)
+    dense_in = _densify(idx, vals, shape, cin)
+    ref = _dense_conv3d(dense_in, np.asarray(conv.weight.data, np.float64),
+                        stride=1, padding=1)
+    ref = ref + np.asarray(conv.bias.data, np.float64)
+    got = np.asarray(out.values())
+    for row, (n, d, h, w) in enumerate(idx):
+        np.testing.assert_allclose(got[row], ref[n, d, h, w], atol=1e-4)
+
+
+def test_conv3d_matches_dense():
+    rng = np.random.RandomState(1)
+    shape, cin, cout = (1, 6, 6, 6), 2, 3
+    idx, vals = _random_coo(rng, shape, nnz=12, channels=cin)
+    sp = sparse.sparse_coo_tensor(idx.T, vals, shape + (cin,))
+    conv = sparse.nn.Conv3D(cin, cout, kernel_size=2, stride=2, bias_attr=False)
+    out = conv(sp)
+    dense_in = _densify(idx, vals, shape, cin)
+    ref = _dense_conv3d(dense_in, np.asarray(conv.weight.data, np.float64),
+                        stride=2, padding=0)
+    got = np.asarray(out.to_dense().data)
+    assert got.shape == ref.shape
+    # output sites produced by the sparse path must match dense values;
+    # dense may have tiny values only where sparse emitted a site
+    np.testing.assert_allclose(got, ref, atol=1e-4)
+
+
+def test_sparse_batchnorm_relu():
+    rng = np.random.RandomState(2)
+    shape, c = (2, 4, 4, 4), 5
+    idx, vals = _random_coo(rng, shape, nnz=30, channels=c)
+    sp = sparse.sparse_coo_tensor(idx.T, vals, shape + (c,))
+    bn = sparse.nn.BatchNorm(c)
+    out = bn(sp)
+    v = np.asarray(out.values())
+    np.testing.assert_allclose(v.mean(0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(v.std(0), 1.0, atol=1e-2)
+    relu_out = sparse.nn.ReLU()(out)
+    assert (np.asarray(relu_out.values()) >= 0).all()
+
+
+def test_sparse_attention_matches_masked_dense():
+    rng = np.random.RandomState(3)
+    B, H, T, D = 1, 2, 8, 4
+    q = rng.randn(B, H, T, D).astype(np.float32)
+    k = rng.randn(B, H, T, D).astype(np.float32)
+    v = rng.randn(B, H, T, D).astype(np.float32)
+    # banded pattern (each row attends to itself and previous position)
+    crows, cols = [0], []
+    for t in range(T):
+        row_cols = [max(t - 1, 0), t] if t else [0]
+        cols.extend(sorted(set(row_cols)))
+        crows.append(len(cols))
+    mask = np.full((T, T), -np.inf, np.float64)
+    for t in range(T):
+        for c in cols[crows[t]:crows[t + 1]]:
+            mask[t, c] = 0.0
+    csr = sparse.sparse_csr_tensor(np.asarray(crows, np.int32),
+                                   np.asarray(cols, np.int32),
+                                   np.ones(len(cols), np.float32), (T, T))
+    out = sparse.nn.functional.attention(
+        pt.to_tensor(q), pt.to_tensor(k), pt.to_tensor(v), csr)
+    # dense reference with -inf masking
+    logits = np.einsum("bhtd,bhsd->bhts", q.astype(np.float64),
+                       k.astype(np.float64)) / np.sqrt(D) + mask
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = np.einsum("bhts,bhsd->bhtd", p, v.astype(np.float64))
+    np.testing.assert_allclose(np.asarray(out.data), ref, atol=1e-4)
