@@ -40,18 +40,11 @@ def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False,
                      default_initializer=None):
     """Free-standing parameter factory (reference:
     python/paddle/tensor/creation.py create_parameter)."""
-    from .nn import initializer as I
-    dtype = _dtype_mod.to_framework_dtype(dtype or "float32")
-    init = default_initializer
-    if attr is not None and getattr(attr, "initializer", None) is not None:
-        init = attr.initializer
-    if init is None:
-        init = I.Constant(0.0) if is_bias else I.XavierNormal()
-    p = Parameter(init(shape, dtype), name=name or "")
-    if attr is not None and getattr(attr, "trainable", True) is False:
-        p.stop_gradient = True
-        p.trainable = False
-    return p
+    from .nn.layer import make_parameter
+    return make_parameter(shape, dtype or "float32", attr=attr,
+                          is_bias=is_bias,
+                          default_initializer=default_initializer,
+                          name=name or "")
 
 
 _LAZY_SUBMODULES = (

@@ -10,3 +10,17 @@ from . import qwen2_moe
 from .llama import LlamaConfig
 from .qwen2_moe import Qwen2MoeConfig
 from .lenet import LeNet
+from .vgg import VGG, vgg11, vgg13, vgg16, vgg19
+from .alexnet import AlexNet, alexnet
+from .squeezenet import SqueezeNet, squeezenet1_0, squeezenet1_1
+from .mobilenet import (MobileNetV1, MobileNetV2, MobileNetV3Small,
+                        MobileNetV3Large, mobilenet_v1, mobilenet_v2,
+                        mobilenet_v3_small, mobilenet_v3_large)
+from .densenet import (DenseNet, densenet121, densenet161, densenet169,
+                       densenet201, densenet264)
+from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
+                           shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+                           shufflenet_v2_x1_0, shufflenet_v2_x1_5,
+                           shufflenet_v2_x2_0, shufflenet_v2_swish)
+from .googlenet import GoogLeNet, googlenet
+from .inceptionv3 import InceptionV3, inception_v3

@@ -1,0 +1,157 @@
+"""Inception v3.
+
+Reference: python/paddle/vision/models/inceptionv3.py (InceptionA-E
+blocks with the factorized 7x1/1x7 and 3x1/1x3 convs; 299x299 input).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+def _cat(*ts):
+    return Tensor(jnp.concatenate([t.data for t in ts], axis=1))
+
+
+class BasicConv2D(nn.Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                      bias_attr=False),
+            nn.BatchNorm2D(out_c), nn.ReLU())
+
+
+class InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = BasicConv2D(in_c, 64, 1)
+        self.b5 = nn.Sequential(BasicConv2D(in_c, 48, 1),
+                                BasicConv2D(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(BasicConv2D(in_c, 64, 1),
+                                BasicConv2D(64, 96, 3, padding=1),
+                                BasicConv2D(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                BasicConv2D(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return _cat(self.b1(x), self.b5(x), self.b3(x), self.bp(x))
+
+
+class InceptionB(nn.Layer):
+    """grid reduction 35->17"""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = BasicConv2D(in_c, 384, 3, stride=2)
+        self.b3d = nn.Sequential(BasicConv2D(in_c, 64, 1),
+                                 BasicConv2D(64, 96, 3, padding=1),
+                                 BasicConv2D(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat(self.b3(x), self.b3d(x), self.pool(x))
+
+
+class InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = BasicConv2D(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            BasicConv2D(in_c, c7, 1),
+            BasicConv2D(c7, c7, (1, 7), padding=(0, 3)),
+            BasicConv2D(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = nn.Sequential(
+            BasicConv2D(in_c, c7, 1),
+            BasicConv2D(c7, c7, (7, 1), padding=(3, 0)),
+            BasicConv2D(c7, c7, (1, 7), padding=(0, 3)),
+            BasicConv2D(c7, c7, (7, 1), padding=(3, 0)),
+            BasicConv2D(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                BasicConv2D(in_c, 192, 1))
+
+    def forward(self, x):
+        return _cat(self.b1(x), self.b7(x), self.b7d(x), self.bp(x))
+
+
+class InceptionD(nn.Layer):
+    """grid reduction 17->8"""
+
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(BasicConv2D(in_c, 192, 1),
+                                BasicConv2D(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            BasicConv2D(in_c, 192, 1),
+            BasicConv2D(192, 192, (1, 7), padding=(0, 3)),
+            BasicConv2D(192, 192, (7, 1), padding=(3, 0)),
+            BasicConv2D(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return _cat(self.b3(x), self.b7(x), self.pool(x))
+
+
+class InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = BasicConv2D(in_c, 320, 1)
+        self.b3_1 = BasicConv2D(in_c, 384, 1)
+        self.b3_2a = BasicConv2D(384, 384, (1, 3), padding=(0, 1))
+        self.b3_2b = BasicConv2D(384, 384, (3, 1), padding=(1, 0))
+        self.bd_1 = nn.Sequential(BasicConv2D(in_c, 448, 1),
+                                  BasicConv2D(448, 384, 3, padding=1))
+        self.bd_2a = BasicConv2D(384, 384, (1, 3), padding=(0, 1))
+        self.bd_2b = BasicConv2D(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, stride=1, padding=1),
+                                BasicConv2D(in_c, 192, 1))
+
+    def forward(self, x):
+        b3 = self.b3_1(x)
+        bd = self.bd_1(x)
+        return _cat(self.b1(x), self.b3_2a(b3), self.b3_2b(b3),
+                    self.bd_2a(bd), self.bd_2b(bd), self.bp(x))
+
+
+class InceptionV3(nn.Layer):
+    def __init__(self, num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            BasicConv2D(3, 32, 3, stride=2),
+            BasicConv2D(32, 32, 3),
+            BasicConv2D(32, 64, 3, padding=1),
+            nn.MaxPool2D(3, stride=2),
+            BasicConv2D(64, 80, 1),
+            BasicConv2D(80, 192, 3),
+            nn.MaxPool2D(3, stride=2))
+        self.blocks = nn.Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160),
+            InceptionC(768, 160), InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.drop = nn.Dropout(0.2)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weight hub in this build")
+    return InceptionV3(**kwargs)

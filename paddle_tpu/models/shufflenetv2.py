@@ -1,0 +1,132 @@
+"""ShuffleNetV2 family.
+
+Reference: python/paddle/vision/models/shufflenetv2.py (channel-shuffle
+inverted residual units; x0_25..x2_0 + swish variant).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+}
+_STAGE_REPEATS = [4, 8, 4]
+
+
+def channel_shuffle(x, groups: int):
+    data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    n, c, h, w = data.shape
+    data = data.reshape(n, groups, c // groups, h, w)
+    data = jnp.swapaxes(data, 1, 2).reshape(n, c, h, w)
+    return Tensor(data)
+
+
+class _ConvBNAct(nn.Sequential):
+    def __init__(self, in_c, out_c, k, stride=1, groups=1, act=nn.ReLU):
+        layers = [nn.Conv2D(in_c, out_c, k, stride=stride,
+                            padding=(k - 1) // 2, groups=groups,
+                            bias_attr=False),
+                  nn.BatchNorm2D(out_c)]
+        if act is not None:
+            layers.append(act())
+        super().__init__(*layers)
+
+
+class ShuffleUnit(nn.Layer):
+    """stride-1 unit: split channels, transform one half, shuffle."""
+
+    def __init__(self, channels, act):
+        super().__init__()
+        c = channels // 2
+        self.branch = nn.Sequential(
+            _ConvBNAct(c, c, 1, act=act),
+            _ConvBNAct(c, c, 3, groups=c, act=None),
+            _ConvBNAct(c, c, 1, act=act))
+        self._c = c
+
+    def forward(self, x):
+        data = x.data
+        x1, x2 = data[:, :self._c], data[:, self._c:]
+        out = jnp.concatenate([x1, self.branch(Tensor(x2)).data], axis=1)
+        return channel_shuffle(Tensor(out), 2)
+
+
+class ShuffleDownUnit(nn.Layer):
+    """stride-2 unit: both branches transform, spatial halved."""
+
+    def __init__(self, in_c, out_c, act):
+        super().__init__()
+        c = out_c // 2
+        self.branch1 = nn.Sequential(
+            _ConvBNAct(in_c, in_c, 3, stride=2, groups=in_c, act=None),
+            _ConvBNAct(in_c, c, 1, act=act))
+        self.branch2 = nn.Sequential(
+            _ConvBNAct(in_c, c, 1, act=act),
+            _ConvBNAct(c, c, 3, stride=2, groups=c, act=None),
+            _ConvBNAct(c, c, 1, act=act))
+
+    def forward(self, x):
+        out = jnp.concatenate(
+            [self.branch1(x).data, self.branch2(x).data], axis=1)
+        return channel_shuffle(Tensor(out), 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale: float = 1.0, act: str = "relu",
+                 num_classes: int = 1000, with_pool: bool = True):
+        super().__init__()
+        if scale not in _STAGE_OUT:
+            raise ValueError(f"scale must be one of {sorted(_STAGE_OUT)}")
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        outs = _STAGE_OUT[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _ConvBNAct(3, outs[0], 3, stride=2, act=act_layer)
+        self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = outs[0]
+        for si, reps in enumerate(_STAGE_REPEATS):
+            out_c = outs[si + 1]
+            stages.append(ShuffleDownUnit(in_c, out_c, act_layer))
+            stages += [ShuffleUnit(out_c, act_layer) for _ in range(reps - 1)]
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _ConvBNAct(in_c, outs[-1], 1, act=act_layer)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = nn.Linear(outs[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.pool1(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(x.flatten(1))
+        return x
+
+
+def _factory(scale, act="relu"):
+    def make(pretrained=False, **kwargs):
+        if pretrained:
+            raise NotImplementedError("no pretrained weight hub in this build")
+        return ShuffleNetV2(scale=scale, act=act, **kwargs)
+    return make
+
+
+shufflenet_v2_x0_25 = _factory(0.25)
+shufflenet_v2_x0_33 = _factory(0.33)
+shufflenet_v2_x0_5 = _factory(0.5)
+shufflenet_v2_x1_0 = _factory(1.0)
+shufflenet_v2_x1_5 = _factory(1.5)
+shufflenet_v2_x2_0 = _factory(2.0)
+shufflenet_v2_swish = _factory(1.0, act="swish")

@@ -19,6 +19,24 @@ from ..core import dtype as dtypes
 from ..core.tensor import Parameter, Tensor
 
 
+def make_parameter(shape, dtype, attr=None, is_bias: bool = False,
+                   default_initializer=None, name: str = "") -> Parameter:
+    """Shared ParamAttr resolution (initializer override + trainable)
+    behind Layer.create_parameter AND paddle_tpu.create_parameter."""
+    from . import initializer as I
+    dtype = dtypes.to_framework_dtype(dtype)
+    init = default_initializer
+    if attr is not None and getattr(attr, "initializer", None) is not None:
+        init = attr.initializer
+    if init is None:
+        init = I.Constant(0.0) if is_bias else I.XavierNormal()
+    p = Parameter(init(shape, dtype), name=name)
+    if attr is not None and getattr(attr, "trainable", True) is False:
+        p.stop_gradient = True
+        p.trainable = False
+    return p
+
+
 class HookRemoveHelper:
     def __init__(self, container, hid):
         self._container = container
@@ -97,19 +115,9 @@ class Layer:
     # -- construction helpers ----------------------------------------------
     def create_parameter(self, shape, dtype=None, is_bias: bool = False,
                          default_initializer=None, attr=None) -> Parameter:
-        from . import initializer as I
-        dtype = dtypes.to_framework_dtype(dtype or self._dtype)
-        init = default_initializer
-        if attr is not None and getattr(attr, "initializer", None) is not None:
-            init = attr.initializer
-        if init is None:
-            init = I.Constant(0.0) if is_bias else I.XavierNormal()
-        data = init(shape, dtype)
-        p = Parameter(data)
-        if attr is not None and getattr(attr, "trainable", True) is False:
-            p.stop_gradient = True
-            p.trainable = False
-        return p
+        return make_parameter(shape, dtype or self._dtype, attr=attr,
+                              is_bias=is_bias,
+                              default_initializer=default_initializer)
 
     def register_buffer(self, name: str, tensor, persistable: bool = True):
         if tensor is not None and not isinstance(tensor, Tensor):

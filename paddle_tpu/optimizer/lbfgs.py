@@ -89,9 +89,13 @@ class LBFGS(Optimizer):
         return [p for p in self._param_list if not p.stop_gradient]
 
     def _gather_flat_grad(self) -> np.ndarray:
+        params_grads = [
+            (p, p._grad._data if p._grad is not None
+             else jnp.zeros_like(p._data)) for p in self._trainable()]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
         parts = []
-        for p in self._trainable():
-            g = p._grad._data if p._grad is not None else jnp.zeros_like(p._data)
+        for p, g in params_grads:
             if self._weight_decay is not None:
                 g = g + self._decay_coeff(p) * p._data.astype(g.dtype)
             parts.append(np.asarray(g, np.float64).ravel())
@@ -299,7 +303,8 @@ class LBFGS(Optimizer):
         return orig_loss
 
     def state_dict(self):
-        return {
+        out = super().state_dict()
+        out.update({
             "old_dirs": [np.asarray(a) for a in self._old_dirs],
             "old_stps": [np.asarray(a) for a in self._old_stps],
             "ro": list(self._ro),
@@ -309,9 +314,11 @@ class LBFGS(Optimizer):
             "d": None if self._d is None else np.asarray(self._d),
             "t": self._t,
             "n_iter": self._n_iter,
-        }
+        })
+        return out
 
     def set_state_dict(self, state):
+        super().set_state_dict(state)
         self._old_dirs = [np.asarray(a) for a in state.get("old_dirs", [])]
         self._old_stps = [np.asarray(a) for a in state.get("old_stps", [])]
         self._ro = list(state.get("ro", []))

@@ -61,10 +61,19 @@ def _gather_neighbors(values, idx, grid, offset, spatial):
 
 
 def subm_conv3d(x: SparseCooTensor, weight, bias=None, stride=1, padding=0,
-                dilation=1, key=None) -> SparseCooTensor:
+                dilation=1) -> SparseCooTensor:
     """Submanifold sparse conv: output coords == input coords (reference
     phi/kernels/sparse/gpu/conv_kernel.cu subm path). weight is
-    [kd, kh, kw, in, out] (the reference's DHWCO layout)."""
+    [kd, kh, kw, in, out] (the reference's DHWCO layout).
+
+    Submanifold semantics fix stride=1 and the kernel centered on each
+    site (padding only gates border neighbors, which the validity mask
+    already does) — non-default stride/dilation are rejected rather
+    than silently ignored."""
+    if _triple(stride) != (1, 1, 1) or _triple(dilation) != (1, 1, 1):
+        raise ValueError(
+            "subm_conv3d requires stride=1, dilation=1 (output sites are "
+            "the input sites); use sparse.nn.conv3d for strided conv")
     w = weight.data if isinstance(weight, Tensor) else jnp.asarray(weight)
     kd, kh, kw, cin, cout = w.shape
     idx = jnp.asarray(x._sp.indices, jnp.int32)       # [nnz, 4] n,d,h,w
@@ -219,21 +228,9 @@ def attention(query, key, value, sparse_mask: SparseCsrTensor,
     k = key.data if isinstance(key, Tensor) else jnp.asarray(key)
     v = value.data if isinstance(value, Tensor) else jnp.asarray(value)
     B, H, T, D = q.shape
-    indptr = jnp.asarray(sparse_mask._sp.indptr)      # [B*H, T+1] or [T+1]
-    cols = jnp.asarray(sparse_mask._sp.indices)
-    if indptr.ndim == 1:
-        indptr = jnp.broadcast_to(indptr, (B * H,) + indptr.shape)
-        cols = jnp.broadcast_to(cols, (B * H,) + cols.shape)
-    else:
-        cols = cols.reshape(B * H, -1)
-        indptr = indptr.reshape(B * H, T + 1)
     scale = 1.0 / np.sqrt(D)
 
-    def one_head(qh, kh, vh, ptr, cc):
-        nnz = cc.shape[0]
-        # row id of each edge: count of rows whose ptr <= edge index
-        edge = jnp.arange(nnz)
-        rows = jnp.searchsorted(ptr[1:], edge, side="right").astype(jnp.int32)
+    def one_head(qh, kh, vh, rows, cc):
         logits = (qh[rows] * kh[cc]).sum(-1) * scale
         # numerically-stable segment softmax over rows
         row_max = jax.ops.segment_max(logits, rows, num_segments=T)
@@ -241,13 +238,33 @@ def attention(query, key, value, sparse_mask: SparseCsrTensor,
         ex = jnp.exp(logits - row_max[rows])
         denom = jax.ops.segment_sum(ex, rows, num_segments=T)
         p = ex / jnp.maximum(denom[rows], 1e-20)
-        out = jax.ops.segment_sum(p[:, None] * vh[cc], rows, num_segments=T)
-        return out
+        return jax.ops.segment_sum(p[:, None] * vh[cc], rows, num_segments=T)
 
+    # The sparsity pattern is static metadata (same stance as the conv
+    # coordinate pass): expand CSR row pointers to COO row ids on host.
+    indptr = np.asarray(sparse_mask._sp.indptr)       # [B*H, T+1] or [T+1]
+    cols_all = np.asarray(sparse_mask._sp.indices)
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, T, D)
     vf = v.reshape(B * H, T, D)
-    out = jax.vmap(one_head)(qf, kf, vf, indptr, cols)
+    if indptr.ndim == 1:                              # shared pattern
+        rows = jnp.asarray(np.repeat(np.arange(T), np.diff(indptr)),
+                           jnp.int32)
+        cc = jnp.asarray(cols_all.ravel(), jnp.int32)
+        out = jax.vmap(lambda qh, kh, vh: one_head(qh, kh, vh, rows, cc))(
+            qf, kf, vf)
+    else:                                             # per-head, may be ragged
+        indptr = indptr.reshape(B * H, T + 1)
+        heads = []
+        for i in range(B * H):
+            # batched BCSR shares one nse; a head's real edges are the
+            # first indptr[i, -1] of its slice
+            c_i = cols_all.reshape(B * H, -1)[i][:indptr[i, -1]]
+            rows = jnp.asarray(np.repeat(np.arange(T), np.diff(indptr[i])),
+                               jnp.int32)
+            heads.append(one_head(qf[i], kf[i], vf[i], rows,
+                                  jnp.asarray(c_i, jnp.int32)))
+        out = jnp.stack(heads)
     return Tensor(out.reshape(B, H, T, D))
 
 

@@ -11,7 +11,7 @@ conversion to/from the numeric token tensors that DO go to the chip.
 """
 from __future__ import annotations
 
-from typing import Iterable, List, Sequence, Union
+from typing import Iterable, List, Optional, Sequence, Union
 
 import numpy as np
 
@@ -81,18 +81,33 @@ def equal(x, y) -> np.ndarray:
     return to_string_tensor(x)._data == to_string_tensor(y)._data
 
 
-def encode_utf8(x, maxlen: int = None, pad: int = 0):
+def _truncate_utf8(b: bytes, limit: int) -> bytes:
+    """Cut at <= limit bytes WITHOUT splitting a multi-byte character:
+    back off over UTF-8 continuation bytes (0b10xxxxxx) and the lead
+    byte they belong to."""
+    if len(b) <= limit:
+        return b
+    end = limit
+    while end > 0 and (b[end] & 0xC0) == 0x80:
+        end -= 1
+    return b[:end]
+
+
+def encode_utf8(x, maxlen: Optional[int] = None, pad: int = 0):
     """StringTensor -> padded uint8 Tensor [n, maxlen] + lengths — the
-    bridge onto the chip (device tensors are numeric)."""
+    bridge onto the chip (device tensors are numeric). Truncation at
+    ``maxlen`` lands on a character boundary so every row stays
+    decodable."""
     from .core.tensor import Tensor
     import jax.numpy as jnp
     x = to_string_tensor(x)
     raw: List[bytes] = [s.encode("utf-8") for s in x._data.ravel()]
-    L = maxlen or max((len(b) for b in raw), default=0)
+    L = (max((len(b) for b in raw), default=0) if maxlen is None
+         else int(maxlen))
     buf = np.full((len(raw), L), pad, np.uint8)
     lens = np.zeros((len(raw),), np.int32)
     for i, b in enumerate(raw):
-        b = b[:L]
+        b = _truncate_utf8(b, L)
         buf[i, :len(b)] = np.frombuffer(b, np.uint8)
         lens[i] = len(b)
     return Tensor(jnp.asarray(buf)), Tensor(jnp.asarray(lens))

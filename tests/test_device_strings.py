@@ -55,6 +55,13 @@ def test_strings_roundtrip_device_bridge():
     assert strings.equal(st, back).all()
 
 
+def test_strings_maxlen_truncates_on_char_boundary():
+    st = strings.to_string_tensor(["日本語"])  # 9 utf-8 bytes
+    codes, lens = strings.encode_utf8(st, maxlen=4)
+    assert int(np.asarray(lens.data)[0]) == 3  # backed off mid-char cut
+    assert strings.decode_utf8(codes, lens).tolist() == ["日"]
+
+
 def test_string_tensor_validates():
     with pytest.raises(TypeError):
         strings.StringTensor([1, 2, 3])

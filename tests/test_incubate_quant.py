@@ -101,6 +101,44 @@ def test_asp_decorated_optimizer_keeps_sparsity():
     assert asp.check_sparsity(np.asarray(model.fc2.weight.data), 2, 4)
 
 
+def test_asp_mask_2d_greedy():
+    from paddle_tpu.incubate import asp
+
+    w = np.random.RandomState(7).randn(8, 8).astype(np.float32)
+    mask = np.asarray(asp.get_mask_2d_greedy(w, 2, 4))
+    assert asp.check_mask_2d(mask, 2, 4)
+    assert not asp.check_mask_2d(np.ones((8, 8)), 2, 4)
+    model = TinyMLP(din=8, dh=16, dout=4)
+    masks = asp.prune_model(model, n=2, m=4, mask_algo="mask_2d_greedy")
+    # fc1 [8,16] divisible both dims -> 2D mask; fc2 [16,4] row dim 16 ok
+    assert "fc1.weight" in masks
+    assert asp.check_mask_2d(np.asarray(model.fc1.weight.data), 2, 4)
+
+
+def test_lbfgs_state_dict_roundtrip_and_clip():
+    from paddle_tpu.nn import ClipGradByNorm
+
+    w = pt.create_parameter([4], "float32")
+    opt = LBFGS(parameters=[w], learning_rate=1.0, max_iter=3,
+                grad_clip=ClipGradByNorm(0.5))
+
+    def closure():
+        opt.clear_grad()
+        loss = ((w - 2.0) ** 2).sum()
+        loss.backward()
+        return loss
+
+    opt.step(closure)
+    sd = opt.state_dict()
+    assert "step_count" in sd and "n_iter" in sd  # base + lbfgs state
+    w2 = pt.create_parameter([4], "float32")
+    opt2 = LBFGS(parameters=[w2], learning_rate=1.0, max_iter=3)
+    opt2.set_state_dict(sd)
+    assert opt2._n_iter == opt._n_iter
+    assert int(np.asarray(opt2._step_count.data)) == \
+        int(np.asarray(opt._step_count.data))
+
+
 def test_asp_excluded_layers():
     from paddle_tpu.incubate import asp
 

@@ -99,6 +99,53 @@ def test_sparse_batchnorm_relu():
     assert (np.asarray(relu_out.values()) >= 0).all()
 
 
+def test_subm_conv3d_rejects_stride_dilation():
+    import pytest
+    rng = np.random.RandomState(4)
+    shape, cin = (1, 4, 4, 4), 2
+    idx, vals = _random_coo(rng, shape, nnz=5, channels=cin)
+    sp = sparse.sparse_coo_tensor(idx.T, vals, shape + (cin,))
+    conv = sparse.nn.SubmConv3D(cin, 3, kernel_size=3, stride=2)
+    with pytest.raises(ValueError, match="stride"):
+        conv(sp)
+
+
+def test_sparse_attention_ragged_per_head():
+    # per-head CSR patterns with DIFFERENT nnz must not cross-contaminate
+    rng = np.random.RandomState(5)
+    B, H, T, D = 1, 2, 4, 4
+    q = rng.randn(B, H, T, D).astype(np.float32)
+    # head 0: diagonal only (4 edges); head 1: full causal (10 edges)
+    crows0 = np.arange(T + 1, dtype=np.int32)
+    cols0 = np.arange(T, dtype=np.int32)
+    crows1, cols1 = [0], []
+    for t in range(T):
+        cols1.extend(range(t + 1))
+        crows1.append(len(cols1))
+    # emulate a batched CSR object with ragged rows via a stub
+    class _SP:
+        pass
+    class _Mask:
+        _sp = _SP()
+    nse = max(len(cols0), len(cols1))
+    indptr = np.stack([np.pad(crows0, (0, 0)), np.asarray(crows1)])
+    cols = np.stack([np.pad(cols0, (0, nse - len(cols0))),
+                     np.asarray(cols1)])
+    _Mask._sp.indptr = indptr
+    _Mask._sp.indices = cols
+    out = sparse.nn.functional.attention(
+        pt.to_tensor(q), pt.to_tensor(q), pt.to_tensor(q), _Mask())
+    got = np.asarray(out.data)
+    # head 0 diagonal: output == v
+    np.testing.assert_allclose(got[0, 0], q[0, 0], atol=1e-5)
+    # head 1 causal: dense reference
+    logits = (q[0, 1] @ q[0, 1].T) / np.sqrt(D)
+    logits = np.where(np.tril(np.ones((T, T))) > 0, logits, -np.inf)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    np.testing.assert_allclose(got[0, 1], p @ q[0, 1], atol=1e-5)
+
+
 def test_sparse_attention_matches_masked_dense():
     rng = np.random.RandomState(3)
     B, H, T, D = 1, 2, 8, 4

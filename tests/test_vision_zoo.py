@@ -1,0 +1,79 @@
+"""Vision model zoo: forward shapes + trainability.
+
+Mirrors reference tests: test/legacy_test/test_vision_models.py (build
+each factory, forward a small batch, check logits shape).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import models as M
+
+
+def _img(n=1, size=64):
+    rng = np.random.RandomState(0)
+    return pt.to_tensor(rng.randn(n, 3, size, size).astype(np.float32))
+
+
+@pytest.mark.parametrize("factory,size", [
+    (lambda: M.vgg11(num_classes=10), 32),
+    (lambda: M.alexnet(num_classes=10), 64),
+    (lambda: M.squeezenet1_0(num_classes=10), 64),
+    (lambda: M.squeezenet1_1(num_classes=10), 64),
+    (lambda: M.mobilenet_v1(scale=0.25, num_classes=10), 32),
+    (lambda: M.mobilenet_v2(scale=0.25, num_classes=10), 32),
+    (lambda: M.mobilenet_v3_small(scale=0.5, num_classes=10), 32),
+    (lambda: M.mobilenet_v3_large(scale=0.5, num_classes=10), 32),
+    (lambda: M.densenet121(num_classes=10), 32),
+    (lambda: M.shufflenet_v2_x0_25(num_classes=10), 32),
+    (lambda: M.shufflenet_v2_swish(num_classes=10), 32),
+    (lambda: M.inception_v3(num_classes=10), 75),
+])
+def test_zoo_forward(factory, size):
+    model = factory()
+    model.eval()
+    out = model(_img(2, size))
+    assert tuple(out.shape) == (2, 10)
+    assert np.isfinite(np.asarray(out.data)).all()
+
+
+def test_vgg_batch_norm_variant():
+    m = M.vgg11(batch_norm=True, num_classes=4)
+    m.eval()
+    assert tuple(m(_img(1, 32)).shape) == (1, 4)
+
+
+def test_googlenet_aux_heads():
+    m = M.googlenet(num_classes=7)
+    m.train()
+    out, aux1, aux2 = m(_img(1, 64))
+    assert tuple(out.shape) == (1, 7)
+    assert tuple(aux1.shape) == (1, 7) and tuple(aux2.shape) == (1, 7)
+    m.eval()
+    out, aux1, aux2 = m(_img(1, 64))
+    assert aux1 is None and aux2 is None
+
+
+def test_zoo_trains_one_step():
+    m = M.mobilenet_v2(scale=0.25, num_classes=3)
+    m.train()
+    opt = pt.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+    x = _img(2, 32)
+    y = pt.to_tensor(np.array([0, 2]))
+    loss = pt.nn.functional.cross_entropy(m(x), y).mean()
+    loss.backward()
+    grads = [p for p in m.parameters() if p._grad is not None]
+    assert len(grads) > 20
+    opt.step()
+    opt.clear_grad()
+    loss2 = pt.nn.functional.cross_entropy(m(x), y).mean()
+    assert np.isfinite(float(loss2))
+
+
+def test_zoo_eval_deterministic_with_dropout():
+    m = M.alexnet(num_classes=5)
+    m.eval()
+    x = _img(1, 64)
+    a = np.asarray(m(x).data)
+    b = np.asarray(m(x).data)
+    np.testing.assert_array_equal(a, b)
