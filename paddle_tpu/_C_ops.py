@@ -1,0 +1,27 @@
+"""paddle._C_ops compatibility shim.
+
+Reference: python/paddle/_C_ops.py — the generated python-C binding
+module user code sometimes calls directly (``paddle._C_ops.matmul(x, y,
+False, False)``-style). Here every name resolves dynamically to the op
+registry (ops/registry.py), which is the real dispatch layer of this
+build — there is no separate C binding to generate, so the shim is one
+__getattr__.
+"""
+from __future__ import annotations
+
+
+def __getattr__(name: str):
+    from .ops import registry
+    import paddle_tpu
+    fn = getattr(paddle_tpu, name, None)
+    if fn is None:
+        fn = getattr(paddle_tpu.nn.functional, name, None)
+    if fn is None or not callable(fn):
+        raise AttributeError(
+            f"_C_ops has no op {name!r} (not in the op registry)")
+    return fn
+
+
+def __dir__():
+    import paddle_tpu
+    return [k for k in dir(paddle_tpu.ops) if not k.startswith("_")]

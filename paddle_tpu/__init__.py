@@ -52,8 +52,9 @@ _LAZY_SUBMODULES = (
     "incubate", "models", "profiler", "autograd", "static", "sparse", "fft",
     "signal", "linalg", "text", "audio", "hapi", "device", "regularizer",
     "distribution", "quantization", "geometric", "onnx", "utils", "version",
-    "callbacks", "parallel", "strings",
+    "callbacks", "parallel", "strings", "hub", "sysconfig", "_C_ops",
 )
+from .batch import batch  # noqa: E402
 
 
 def __getattr__(name):

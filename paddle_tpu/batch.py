@@ -1,0 +1,26 @@
+"""paddle.batch — minibatch reader decorator.
+
+Reference: python/paddle/batch.py (wraps a sample reader into a
+batch-of-samples reader; drop_last semantics).
+"""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size: int, drop_last: bool = False):
+    """``reader() -> iter of samples`` becomes ``() -> iter of lists``."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

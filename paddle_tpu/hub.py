@@ -1,0 +1,67 @@
+"""paddle.hub — load models from a hubconf.py entrypoint file.
+
+Reference: python/paddle/hub.py (list/help/load with github/gitee/local
+sources). The TPU build environment has zero egress, so remote sources
+raise with guidance; the local protocol (a directory containing
+``hubconf.py`` whose public callables are entrypoints) is fully
+supported — which is also what the reference uses once a repo is
+cached.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+from typing import List
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir!r}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.pop("paddle_tpu_hubconf", None)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _check_source(source: str):
+    if source not in ("local",):
+        raise NotImplementedError(
+            f"hub source {source!r} needs network egress; clone the repo "
+            "and use source='local' with repo_dir pointing at it")
+
+
+def list(repo_dir: str, source: str = "local", force_reload: bool = False
+         ) -> List[str]:  # noqa: A001 (reference API name)
+    """Names of entrypoints exported by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False) -> str:  # noqa: A001
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"{model!r} not in {repo_dir}/hubconf.py "
+                         f"(has: {list(repo_dir)})")
+    return getattr(mod, model).__doc__ or ""
+
+
+def load(repo_dir: str, model: str, source: str = "local",
+         force_reload: bool = False, **kwargs):
+    """Instantiate entrypoint ``model`` from the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise ValueError(f"{model!r} not in {repo_dir}/hubconf.py "
+                         f"(has: {list(repo_dir)})")
+    return getattr(mod, model)(**kwargs)

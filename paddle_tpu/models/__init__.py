@@ -24,3 +24,5 @@ from .shufflenetv2 import (ShuffleNetV2, shufflenet_v2_x0_25,
                            shufflenet_v2_x2_0, shufflenet_v2_swish)
 from .googlenet import GoogLeNet, googlenet
 from .inceptionv3 import InceptionV3, inception_v3
+from .ernie import (ErnieConfig, ErnieModel, ErnieForSequenceClassification,
+                    ErnieForPretraining)

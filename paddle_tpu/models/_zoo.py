@@ -1,0 +1,21 @@
+"""Shared helpers for the vision model-zoo factories."""
+from __future__ import annotations
+
+
+def check_no_pretrained(pretrained: bool):
+    """Single place for the no-weight-hub policy (zero-egress build)."""
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights need a download hub (zero-egress build); "
+            "load converted weights with model.set_state_dict instead")
+
+
+def zoo_factory(cls, name: str, **fixed):
+    """Factory with a real __name__ (closure-based 'make' degrades
+    tracebacks and repr)."""
+    def make(pretrained: bool = False, **kwargs):
+        check_no_pretrained(pretrained)
+        return cls(**{**fixed, **kwargs})
+    make.__name__ = make.__qualname__ = name
+    make.__doc__ = f"Build {cls.__name__} ({fixed or 'defaults'})."
+    return make

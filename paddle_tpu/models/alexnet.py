@@ -7,6 +7,7 @@ variant).
 from __future__ import annotations
 
 from .. import nn
+from ._zoo import check_no_pretrained
 
 __all__ = ["AlexNet", "alexnet"]
 
@@ -39,6 +40,5 @@ class AlexNet(nn.Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weight hub in this build")
+    check_no_pretrained(pretrained)
     return AlexNet(**kwargs)

@@ -6,10 +6,9 @@ densenet121/161/169/201/264).
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from .. import nn
-from ..core.tensor import Tensor
+from ._zoo import check_no_pretrained
+from ..ops.manipulation import concat
 
 __all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
            "densenet201", "densenet264"]
@@ -24,7 +23,7 @@ _CFGS = {
 
 
 class DenseLayer(nn.Layer):
-    def __init__(self, in_c, growth_rate, bn_size):
+    def __init__(self, in_c, growth_rate, bn_size, dropout: float = 0.0):
         super().__init__()
         self.bn1 = nn.BatchNorm2D(in_c)
         self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1,
@@ -33,11 +32,14 @@ class DenseLayer(nn.Layer):
         self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3,
                                padding=1, bias_attr=False)
         self.relu = nn.ReLU()
+        self.dropout = nn.Dropout(dropout) if dropout else None
 
     def forward(self, x):
         out = self.conv1(self.relu(self.bn1(x)))
         out = self.conv2(self.relu(self.bn2(out)))
-        return Tensor(jnp.concatenate([x.data, out.data], axis=1))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
 
 
 class Transition(nn.Layer):
@@ -69,7 +71,7 @@ class DenseNet(nn.Layer):
         ch = num_init
         for bi, n in enumerate(block_cfg):
             for _ in range(n):
-                feats.append(DenseLayer(ch, growth, bn_size))
+                feats.append(DenseLayer(ch, growth, bn_size, dropout))
                 ch += growth
             if bi != len(block_cfg) - 1:
                 feats.append(Transition(ch, ch // 2))
@@ -91,8 +93,7 @@ class DenseNet(nn.Layer):
 
 
 def _densenet(layers, pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weight hub in this build")
+    check_no_pretrained(pretrained)
     return DenseNet(layers=layers, **kwargs)
 
 

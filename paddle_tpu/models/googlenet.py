@@ -6,10 +6,9 @@ with 4 branches; two aux classifier heads active in train mode; returns
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from .. import nn
-from ..core.tensor import Tensor
+from ._zoo import check_no_pretrained
+from ..ops.manipulation import concat
 
 __all__ = ["GoogLeNet", "googlenet"]
 
@@ -26,9 +25,9 @@ class Inception(nn.Layer):
                                 nn.Conv2D(in_c, proj, 1), nn.ReLU())
 
     def forward(self, x):
-        return Tensor(jnp.concatenate(
-            [self.b1(x).data, self.b2(x).data, self.b3(x).data,
-             self.b4(x).data], axis=1))
+        # registered concat: keeps the autograd tape through the block
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
 
 
 class _AuxHead(nn.Layer):
@@ -93,6 +92,5 @@ class GoogLeNet(nn.Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weight hub in this build")
+    check_no_pretrained(pretrained)
     return GoogLeNet(**kwargs)

@@ -5,16 +5,16 @@ blocks with the factorized 7x1/1x7 and 3x1/1x3 convs; 299x299 input).
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from .. import nn
-from ..core.tensor import Tensor
+from ._zoo import check_no_pretrained
+from ..ops.manipulation import concat
 
 __all__ = ["InceptionV3", "inception_v3"]
 
 
 def _cat(*ts):
-    return Tensor(jnp.concatenate([t.data for t in ts], axis=1))
+    # registered concat: keeps the autograd tape through the block
+    return concat(list(ts), axis=1)
 
 
 class BasicConv2D(nn.Sequential):
@@ -152,6 +152,5 @@ class InceptionV3(nn.Layer):
 
 
 def inception_v3(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weight hub in this build")
+    check_no_pretrained(pretrained)
     return InceptionV3(**kwargs)

@@ -236,15 +236,9 @@ class MobileNetV3Large(_MobileNetV3):
         super().__init__(_V3_LARGE, 960, scale, num_classes, with_pool)
 
 
-def _factory(cls):
-    def make(pretrained=False, scale=1.0, **kwargs):
-        if pretrained:
-            raise NotImplementedError("no pretrained weight hub in this build")
-        return cls(scale=scale, **kwargs)
-    return make
+from ._zoo import zoo_factory
 
-
-mobilenet_v1 = _factory(MobileNetV1)
-mobilenet_v2 = _factory(MobileNetV2)
-mobilenet_v3_small = _factory(MobileNetV3Small)
-mobilenet_v3_large = _factory(MobileNetV3Large)
+mobilenet_v1 = zoo_factory(MobileNetV1, "mobilenet_v1")
+mobilenet_v2 = zoo_factory(MobileNetV2, "mobilenet_v2")
+mobilenet_v3_small = zoo_factory(MobileNetV3Small, "mobilenet_v3_small")
+mobilenet_v3_large = zoo_factory(MobileNetV3Large, "mobilenet_v3_large")

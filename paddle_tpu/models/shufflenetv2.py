@@ -5,10 +5,9 @@ inverted residual units; x0_25..x2_0 + swish variant).
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from .. import nn
-from ..core.tensor import Tensor
+from ..ops.manipulation import concat
+from .mobilenet import ConvBNReLU
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
            "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
@@ -23,22 +22,10 @@ _STAGE_REPEATS = [4, 8, 4]
 
 
 def channel_shuffle(x, groups: int):
-    data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-    n, c, h, w = data.shape
-    data = data.reshape(n, groups, c // groups, h, w)
-    data = jnp.swapaxes(data, 1, 2).reshape(n, c, h, w)
-    return Tensor(data)
-
-
-class _ConvBNAct(nn.Sequential):
-    def __init__(self, in_c, out_c, k, stride=1, groups=1, act=nn.ReLU):
-        layers = [nn.Conv2D(in_c, out_c, k, stride=stride,
-                            padding=(k - 1) // 2, groups=groups,
-                            bias_attr=False),
-                  nn.BatchNorm2D(out_c)]
-        if act is not None:
-            layers.append(act())
-        super().__init__(*layers)
+    """Tracked reshape/transpose ops only — the tape must flow through."""
+    n, c, h, w = x.shape
+    x = x.reshape([n, groups, c // groups, h, w])
+    return x.transpose([0, 2, 1, 3, 4]).reshape([n, c, h, w])
 
 
 class ShuffleUnit(nn.Layer):
@@ -48,16 +35,14 @@ class ShuffleUnit(nn.Layer):
         super().__init__()
         c = channels // 2
         self.branch = nn.Sequential(
-            _ConvBNAct(c, c, 1, act=act),
-            _ConvBNAct(c, c, 3, groups=c, act=None),
-            _ConvBNAct(c, c, 1, act=act))
+            ConvBNReLU(c, c, 1, act=act),
+            ConvBNReLU(c, c, 3, groups=c, act=None),
+            ConvBNReLU(c, c, 1, act=act))
         self._c = c
 
     def forward(self, x):
-        data = x.data
-        x1, x2 = data[:, :self._c], data[:, self._c:]
-        out = jnp.concatenate([x1, self.branch(Tensor(x2)).data], axis=1)
-        return channel_shuffle(Tensor(out), 2)
+        x1, x2 = x[:, :self._c], x[:, self._c:]
+        return channel_shuffle(concat([x1, self.branch(x2)], axis=1), 2)
 
 
 class ShuffleDownUnit(nn.Layer):
@@ -67,17 +52,16 @@ class ShuffleDownUnit(nn.Layer):
         super().__init__()
         c = out_c // 2
         self.branch1 = nn.Sequential(
-            _ConvBNAct(in_c, in_c, 3, stride=2, groups=in_c, act=None),
-            _ConvBNAct(in_c, c, 1, act=act))
+            ConvBNReLU(in_c, in_c, 3, stride=2, groups=in_c, act=None),
+            ConvBNReLU(in_c, c, 1, act=act))
         self.branch2 = nn.Sequential(
-            _ConvBNAct(in_c, c, 1, act=act),
-            _ConvBNAct(c, c, 3, stride=2, groups=c, act=None),
-            _ConvBNAct(c, c, 1, act=act))
+            ConvBNReLU(in_c, c, 1, act=act),
+            ConvBNReLU(c, c, 3, stride=2, groups=c, act=None),
+            ConvBNReLU(c, c, 1, act=act))
 
     def forward(self, x):
-        out = jnp.concatenate(
-            [self.branch1(x).data, self.branch2(x).data], axis=1)
-        return channel_shuffle(Tensor(out), 2)
+        return channel_shuffle(
+            concat([self.branch1(x), self.branch2(x)], axis=1), 2)
 
 
 class ShuffleNetV2(nn.Layer):
@@ -90,7 +74,7 @@ class ShuffleNetV2(nn.Layer):
         outs = _STAGE_OUT[scale]
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self.conv1 = _ConvBNAct(3, outs[0], 3, stride=2, act=act_layer)
+        self.conv1 = ConvBNReLU(3, outs[0], 3, stride=2, act=act_layer)
         self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
         stages = []
         in_c = outs[0]
@@ -100,7 +84,7 @@ class ShuffleNetV2(nn.Layer):
             stages += [ShuffleUnit(out_c, act_layer) for _ in range(reps - 1)]
             in_c = out_c
         self.stages = nn.Sequential(*stages)
-        self.conv_last = _ConvBNAct(in_c, outs[-1], 1, act=act_layer)
+        self.conv_last = ConvBNReLU(in_c, outs[-1], 1, act=act_layer)
         if with_pool:
             self.pool = nn.AdaptiveAvgPool2D((1, 1))
         if num_classes > 0:
@@ -115,18 +99,12 @@ class ShuffleNetV2(nn.Layer):
         return x
 
 
-def _factory(scale, act="relu"):
-    def make(pretrained=False, **kwargs):
-        if pretrained:
-            raise NotImplementedError("no pretrained weight hub in this build")
-        return ShuffleNetV2(scale=scale, act=act, **kwargs)
-    return make
+from ._zoo import zoo_factory
 
-
-shufflenet_v2_x0_25 = _factory(0.25)
-shufflenet_v2_x0_33 = _factory(0.33)
-shufflenet_v2_x0_5 = _factory(0.5)
-shufflenet_v2_x1_0 = _factory(1.0)
-shufflenet_v2_x1_5 = _factory(1.5)
-shufflenet_v2_x2_0 = _factory(2.0)
-shufflenet_v2_swish = _factory(1.0, act="swish")
+shufflenet_v2_x0_25 = zoo_factory(ShuffleNetV2, "shufflenet_v2_x0_25", scale=0.25)
+shufflenet_v2_x0_33 = zoo_factory(ShuffleNetV2, "shufflenet_v2_x0_33", scale=0.33)
+shufflenet_v2_x0_5 = zoo_factory(ShuffleNetV2, "shufflenet_v2_x0_5", scale=0.5)
+shufflenet_v2_x1_0 = zoo_factory(ShuffleNetV2, "shufflenet_v2_x1_0", scale=1.0)
+shufflenet_v2_x1_5 = zoo_factory(ShuffleNetV2, "shufflenet_v2_x1_5", scale=1.5)
+shufflenet_v2_x2_0 = zoo_factory(ShuffleNetV2, "shufflenet_v2_x2_0", scale=2.0)
+shufflenet_v2_swish = zoo_factory(ShuffleNetV2, "shufflenet_v2_swish", scale=1.0, act="swish")

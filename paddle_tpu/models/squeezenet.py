@@ -5,10 +5,9 @@ squeeze 1x1 -> expand 1x1 + 3x3 concat; same stage layouts).
 """
 from __future__ import annotations
 
-import jax.numpy as jnp
-
 from .. import nn
-from ..core.tensor import Tensor
+from ._zoo import check_no_pretrained
+from ..ops.manipulation import concat
 
 __all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
 
@@ -25,7 +24,9 @@ class Fire(nn.Layer):
         x = self.relu(self.squeeze(x))
         a = self.relu(self.expand1x1(x))
         b = self.relu(self.expand3x3(x))
-        return Tensor(jnp.concatenate([a.data, b.data], axis=1))
+        # registered concat keeps the autograd tape intact (raw
+        # jnp.concatenate on .data would freeze everything upstream)
+        return concat([a, b], axis=1)
 
 
 class SqueezeNet(nn.Layer):
@@ -72,12 +73,10 @@ class SqueezeNet(nn.Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weight hub in this build")
+    check_no_pretrained(pretrained)
     return SqueezeNet("1.0", **kwargs)
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weight hub in this build")
+    check_no_pretrained(pretrained)
     return SqueezeNet("1.1", **kwargs)

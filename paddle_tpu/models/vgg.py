@@ -7,6 +7,7 @@ constructor surface and layer topology).
 from __future__ import annotations
 
 from .. import nn
+from ._zoo import check_no_pretrained
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19"]
 
@@ -62,8 +63,7 @@ class VGG(nn.Layer):
 
 
 def _vgg(cfg, batch_norm, pretrained=False, **kwargs):
-    if pretrained:
-        raise NotImplementedError("no pretrained weight hub in this build")
+    check_no_pretrained(pretrained)
     return VGG(make_layers(_CFGS[cfg], batch_norm), **kwargs)
 
 

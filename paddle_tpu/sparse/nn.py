@@ -253,18 +253,29 @@ def attention(query, key, value, sparse_mask: SparseCsrTensor,
         cc = jnp.asarray(cols_all.ravel(), jnp.int32)
         out = jax.vmap(lambda qh, kh, vh: one_head(qh, kh, vh, rows, cc))(
             qf, kf, vf)
-    else:                                             # per-head, may be ragged
+    else:
         indptr = indptr.reshape(B * H, T + 1)
-        heads = []
-        for i in range(B * H):
-            # batched BCSR shares one nse; a head's real edges are the
-            # first indptr[i, -1] of its slice
-            c_i = cols_all.reshape(B * H, -1)[i][:indptr[i, -1]]
-            rows = jnp.asarray(np.repeat(np.arange(T), np.diff(indptr[i])),
-                               jnp.int32)
-            heads.append(one_head(qf[i], kf[i], vf[i], rows,
-                                  jnp.asarray(c_i, jnp.int32)))
-        out = jnp.stack(heads)
+        cols2d = cols_all.reshape(B * H, -1)
+        row_tbl = np.stack([np.repeat(np.arange(T), np.diff(indptr[i]))
+                            for i in range(B * H)]
+                           ) if (indptr[:, -1] == indptr[0, -1]).all() else None
+        if row_tbl is not None:
+            # uniform nnz across heads: one vmapped kernel, per-head
+            # (rows, cols) as batched inputs — no B*H graph unroll
+            out = jax.vmap(one_head)(
+                qf, kf, vf, jnp.asarray(row_tbl, jnp.int32),
+                jnp.asarray(cols2d[:, :indptr[0, -1]], jnp.int32))
+        else:                                         # genuinely ragged
+            heads = []
+            for i in range(B * H):
+                # a head's real edges are the first indptr[i, -1] of its
+                # (shared-nse padded) slice
+                c_i = cols2d[i][:indptr[i, -1]]
+                rows = jnp.asarray(
+                    np.repeat(np.arange(T), np.diff(indptr[i])), jnp.int32)
+                heads.append(one_head(qf[i], kf[i], vf[i], rows,
+                                      jnp.asarray(c_i, jnp.int32)))
+            out = jnp.stack(heads)
     return Tensor(out.reshape(B, H, T, D))
 
 

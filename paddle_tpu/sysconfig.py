@@ -1,0 +1,23 @@
+"""paddle.sysconfig — build introspection.
+
+Reference: python/paddle/sysconfig.py (get_include/get_lib for
+compiling extensions against the framework).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include() -> str:
+    """Directory of C/C++ headers for building native extensions
+    (the XLA-FFI custom-kernel path, utils/cpp_extension.py)."""
+    return os.path.join(_ROOT, "csrc")
+
+
+def get_lib() -> str:
+    """Directory holding the framework's compiled native libraries."""
+    return os.path.join(_ROOT, "csrc", "build")

@@ -7,6 +7,7 @@ the reference's paths.
 from . import transforms  # noqa: F401
 from . import datasets  # noqa: F401
 from . import models  # noqa: F401
+from . import ops  # noqa: F401
 from .models import (  # noqa: F401
     LeNet, ResNet, resnet18, resnet34, resnet50, resnet101, resnet152,
     wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,

@@ -1,0 +1,562 @@
+"""paddle.vision.ops — detection/vision operators.
+
+Reference: python/paddle/vision/ops.py (nms:1934, matrix_nms:2358,
+roi_align:1705, roi_pool:1572, psroi_pool:1441, box_coder:584,
+prior_box:438, yolo_box:277, deform_conv2d:766,
+distribute_fpn_proposals:1175, ConvNormActivation:1877) over CUDA
+kernels in paddle/phi/kernels/gpu/ (nms_kernel.cu, roi_align_kernel.cu,
+deformable_conv_kernel.cu ...).
+
+TPU-native design notes:
+- Greedy NMS is sequential by definition; the TPU shape is an O(N^2)
+  IoU matrix + a lax.fori_loop over boxes flipping a keep mask — no
+  host round trips, one fused program. matrix_nms is embarrassingly
+  parallel (its decay is a matrix expression) and is the TPU-preferred
+  suppressor.
+- roi_align/psroi_pool are bilinear gathers: vmap over RoIs of a
+  sampling-grid gather — XLA turns these into batched dynamic-slices.
+- deform_conv2d = bilinear sampling at offset positions + an einsum
+  against the kernel — the MXU does the contraction; there is no
+  im2col scratch buffer.
+- read_file/decode_jpeg are host-side file IO in the reference and out
+  of scope for the accelerator runtime (raise with guidance).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import List, Optional, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["nms", "matrix_nms", "roi_align", "roi_pool", "psroi_pool",
+           "box_coder", "prior_box", "yolo_box", "deform_conv2d",
+           "DeformConv2D", "RoIAlign", "RoIPool", "PSRoIPool",
+           "ConvNormActivation", "distribute_fpn_proposals"]
+
+
+def _arr(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def _box_iou_matrix(a, b):
+    """IoU of [N,4] x [M,4] xyxy boxes -> [N,M]."""
+    area_a = jnp.maximum(a[:, 2] - a[:, 0], 0) * jnp.maximum(
+        a[:, 3] - a[:, 1], 0)
+    area_b = jnp.maximum(b[:, 2] - b[:, 0], 0) * jnp.maximum(
+        b[:, 3] - b[:, 1], 0)
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area_a[:, None] + area_b[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def nms(boxes, iou_threshold: float = 0.3, scores=None, category_idxs=None,
+        categories=None, top_k: Optional[int] = None):
+    """Greedy hard NMS (reference ops.py:1934). Returns kept indices,
+    score-descending. Per-category when category_idxs/categories given
+    (boxes of different categories never suppress each other)."""
+    b = _arr(boxes).astype(jnp.float32)
+    n = b.shape[0]
+    s = (_arr(scores).astype(jnp.float32) if scores is not None
+         else jnp.arange(n, 0, -1, dtype=jnp.float32))
+    order = jnp.argsort(-s)
+    b_sorted = b[order]
+    iou = _box_iou_matrix(b_sorted, b_sorted)
+    if category_idxs is not None:
+        cat = _arr(category_idxs)[order]
+        iou = jnp.where(cat[:, None] == cat[None, :], iou, 0.0)
+
+    def body(i, keep):
+        # suppress i if any higher-scored kept box overlaps too much
+        over = (iou[i] > iou_threshold) & keep & (jnp.arange(n) < i)
+        return keep.at[i].set(~over.any())
+
+    keep = lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    kept_sorted = np.asarray(keep)
+    idx = np.asarray(order)[kept_sorted]
+    if top_k is not None:
+        idx = idx[:top_k]
+    return Tensor(jnp.asarray(idx, jnp.int32))
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
+               nms_top_k=400, keep_top_k=200, use_gaussian=False,
+               gaussian_sigma=2.0, background_label=0, normalized=True,
+               return_index=False, return_rois_num=True, name=None):
+    """Matrix NMS (reference ops.py:2358, SOLOv2): fully-parallel decay
+    of each box's score by its overlaps with higher-scored same-class
+    boxes — a matrix expression, no sequential loop; the TPU-preferred
+    suppressor. bboxes [B,N,4], scores [B,C,N]."""
+    bb = _arr(bboxes).astype(jnp.float32)
+    sc = _arr(scores).astype(jnp.float32)
+    B, C, N = sc.shape
+    outs, indices, rois_num = [], [], []
+    for bi in range(B):  # batch is host-level (ragged outputs)
+        per_class = []
+        for ci in range(C):
+            if ci == background_label:
+                continue
+            s = sc[bi, ci]
+            valid = s > score_threshold
+            order = jnp.argsort(-s)
+            if nms_top_k > 0:
+                order = order[:nms_top_k]
+            s_s, b_s = s[order], bb[bi][order]
+            iou = _box_iou_matrix(b_s, b_s)
+            upper = jnp.triu(iou, k=1)  # [i,j]: overlap of higher i on j
+            max_over = upper.max(axis=0)          # per box: worst overlap
+            comp = upper.max(axis=1)              # compensation term
+            if use_gaussian:
+                decay = jnp.exp(-(upper ** 2 - comp[:, None] ** 2)
+                                / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - upper) / jnp.maximum(1 - comp[:, None], 1e-10)
+                         ).min(axis=0)
+            dec_s = s_s * decay * valid[order]
+            keepm = dec_s > post_threshold
+            k_idx = np.nonzero(np.asarray(keepm))[0]
+            for j in k_idx:
+                per_class.append((float(dec_s[j]), ci, int(order[j])))
+        per_class.sort(key=lambda t: -t[0])
+        if keep_top_k > 0:
+            per_class = per_class[:keep_top_k]
+        out = np.asarray([[c, s] + list(np.asarray(bb[bi][i]))
+                          for s, c, i in per_class], np.float32
+                         ).reshape(-1, 6)
+        outs.append(out)
+        indices.extend(i + bi * N for _, _, i in per_class)
+        rois_num.append(len(per_class))
+    out = Tensor(jnp.asarray(np.concatenate(outs, axis=0)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(indices, jnp.int32)))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(rois_num, jnp.int32)))
+    return tuple(res) if len(res) > 1 else out
+
+
+def _bilinear_sample(feat, y, x):
+    """feat [C,H,W]; y/x arbitrary same-shaped grids -> [C, *grid]."""
+    C, H, W = feat.shape
+    y0 = jnp.clip(jnp.floor(y), 0, H - 1)
+    x0 = jnp.clip(jnp.floor(x), 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    ly, lx = y - y0, x - x0
+    y0i, x0i, y1i, x1i = (v.astype(jnp.int32) for v in (y0, x0, y1, x1))
+    v00 = feat[:, y0i, x0i]
+    v01 = feat[:, y0i, x1i]
+    v10 = feat[:, y1i, x0i]
+    v11 = feat[:, y1i, x1i]
+    # out-of-range samples contribute zero (reference roi_align border)
+    inb = ((y > -1) & (y < H) & (x > -1) & (x < W)).astype(feat.dtype)
+    return ((v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx
+             + v10 * ly * (1 - lx) + v11 * ly * lx) * inb)
+
+
+def _rois_to_batch(boxes, boxes_num, B):
+    """[sum(n),4] + per-image counts -> per-roi batch index."""
+    bn = np.asarray(_arr(boxes_num), np.int64)
+    return jnp.asarray(np.repeat(np.arange(B), bn), jnp.int32)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (reference ops.py:1705 over roi_align_kernel.cu):
+    average of bilinear samples on a regular grid inside each bin."""
+    feat = _arr(x)
+    rois = _arr(boxes).astype(jnp.float32)
+    B, C, H, W = feat.shape
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = _rois_to_batch(boxes, boxes_num, B)
+    off = 0.5 if aligned else 0.0
+    sr = sampling_ratio if sampling_ratio > 0 else 2
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * spatial_scale - off
+        rw = jnp.maximum(x2 - x1, 1e-3 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
+        bin_h, bin_w = rh / ph, rw / pw
+        gy = (y1 + bin_h * (jnp.arange(ph)[:, None, None, None]
+                            + (jnp.arange(sr)[None, None, :, None] + 0.5) / sr))
+        gx = (x1 + bin_w * (jnp.arange(pw)[None, :, None, None]
+                            + (jnp.arange(sr)[None, None, None, :] + 0.5) / sr))
+        yy = jnp.broadcast_to(gy, (ph, pw, sr, sr))
+        xx = jnp.broadcast_to(gx, (ph, pw, sr, sr))
+        samples = _bilinear_sample(feat[bi], yy, xx)   # [C,ph,pw,sr,sr]
+        return samples.mean(axis=(-1, -2))
+
+    return Tensor(jax.vmap(one_roi)(rois, batch_idx))
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool (reference ops.py:1572): max over quantized bins."""
+    feat = _arr(x)
+    rois = _arr(boxes).astype(jnp.float32)
+    B, C, H, W = feat.shape
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    batch_idx = _rois_to_batch(boxes, boxes_num, B)
+    # dense-grid formulation (static shapes): for every output bin,
+    # max over the full feature map masked to the bin's rectangle
+    ys = jnp.arange(H, dtype=jnp.float32)
+    xs = jnp.arange(W, dtype=jnp.float32)
+
+    def one_roi(roi, bi):
+        x1 = jnp.floor(roi[0] * spatial_scale)
+        y1 = jnp.floor(roi[1] * spatial_scale)
+        x2 = jnp.ceil(roi[2] * spatial_scale)
+        y2 = jnp.ceil(roi[3] * spatial_scale)
+        bh = jnp.maximum((y2 - y1) / ph, 1e-6)
+        bw = jnp.maximum((x2 - x1) / pw, 1e-6)
+        by = jnp.clip(jnp.floor((ys[None, :] - y1) / bh), -1, ph)  # [1,H]
+        bx = jnp.clip(jnp.floor((xs[None, :] - x1) / bw), -1, pw)
+        fy = (by == jnp.arange(ph, dtype=jnp.float32)[:, None])    # [ph,H]
+        fx = (bx == jnp.arange(pw, dtype=jnp.float32)[:, None])    # [pw,W]
+        m = fy[:, None, :, None] & fx[None, :, None, :]            # [ph,pw,H,W]
+        vals = jnp.where(m[None], feat[bi][:, None, None, :, :],
+                         -jnp.inf)
+        out = vals.max(axis=(-1, -2))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return Tensor(jax.vmap(one_roi)(rois, batch_idx))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference ops.py:1441): output
+    channel (c, i, j) averages input channel c*ph*pw + i*pw + j over
+    bin (i, j)."""
+    feat = _arr(x)
+    rois = _arr(boxes).astype(jnp.float32)
+    B, C, H, W = feat.shape
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    ph, pw = output_size
+    if C % (ph * pw):
+        raise ValueError(f"channels {C} must be divisible by "
+                         f"output_size^2 {ph * pw}")
+    Cout = C // (ph * pw)
+    batch_idx = _rois_to_batch(boxes, boxes_num, B)
+    ys = jnp.arange(H, dtype=jnp.float32) + 0.5
+    xs = jnp.arange(W, dtype=jnp.float32) + 0.5
+
+    def one_roi(roi, bi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        bh = jnp.maximum((y2 - y1) / ph, 0.1)
+        bw = jnp.maximum((x2 - x1) / pw, 0.1)
+        fmap = feat[bi].reshape(Cout, ph, pw, H, W)
+        by = jnp.floor((ys - y1) / bh)          # [H]
+        bx = jnp.floor((xs - x1) / bw)          # [W]
+        fy = (by[None, :] == jnp.arange(ph, dtype=jnp.float32)[:, None])
+        fx = (bx[None, :] == jnp.arange(pw, dtype=jnp.float32)[:, None])
+        m = (fy[:, None, :, None] & fx[None, :, None, :]).astype(feat.dtype)
+        s = jnp.einsum("cijhw,ijhw->cij", fmap, m)
+        cnt = jnp.maximum(m.sum((-1, -2)), 1.0)
+        return s / cnt
+
+    return Tensor(jax.vmap(one_roi)(rois, batch_idx))
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against anchors (reference ops.py:584)."""
+    pb = _arr(prior_box).astype(jnp.float32)
+    tb = _arr(target_box).astype(jnp.float32)
+    pbv = (None if prior_box_var is None
+           else jnp.asarray(_arr(prior_box_var), jnp.float32))
+    norm = 0.0 if box_normalized else 1.0
+    pw = pb[..., 2] - pb[..., 0] + norm
+    ph_ = pb[..., 3] - pb[..., 1] + norm
+    pcx = pb[..., 0] + pw * 0.5
+    pcy = pb[..., 1] + ph_ * 0.5
+    if code_type == "encode_center_size":
+        tw = tb[..., 2] - tb[..., 0] + norm
+        th = tb[..., 3] - tb[..., 1] + norm
+        tcx = tb[..., 0] + tw * 0.5
+        tcy = tb[..., 1] + th * 0.5
+        out = jnp.stack([(tcx[:, None] - pcx[None]) / pw[None],
+                         (tcy[:, None] - pcy[None]) / ph_[None],
+                         jnp.log(tw[:, None] / pw[None]),
+                         jnp.log(th[:, None] / ph_[None])], axis=-1)
+        if pbv is not None:
+            out = out / pbv
+        return Tensor(out)
+    if code_type == "decode_center_size":
+        d = tb if pbv is None else tb * pbv
+        if tb.ndim == 3:
+            # priors broadcast along `axis` of [.., .., 4] deltas
+            expand = (slice(None), None) if axis == 0 else (None, slice(None))
+            pcx, pcy, pw, ph_ = (v[expand] for v in (pcx, pcy, pw, ph_))
+        cx = d[..., 0] * pw + pcx
+        cy = d[..., 1] * ph_ + pcy
+        w = jnp.exp(d[..., 2]) * pw
+        h = jnp.exp(d[..., 3]) * ph_
+        return Tensor(jnp.stack([cx - w * 0.5, cy - h * 0.5,
+                                 cx + w * 0.5 - norm,
+                                 cy + h * 0.5 - norm], axis=-1))
+    raise ValueError(f"unknown code_type {code_type!r}")
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD anchor generation (reference ops.py:438). Pure host math."""
+    feat = _arr(input)
+    img = _arr(image)
+    H, W = feat.shape[2], feat.shape[3]
+    ih, iw = img.shape[2], img.shape[3]
+    step_h = steps[1] or ih / H
+    step_w = steps[0] or iw / W
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - a) < 1e-6 for a in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+    boxes = []
+    variances = []
+    for y, x in itertools.product(range(H), range(W)):
+        cx = (x + offset) * step_w
+        cy = (y + offset) * step_h
+        cell = []
+        for si, ms in enumerate(min_sizes):
+            ms = float(ms)
+            if min_max_aspect_ratios_order:
+                cell.append((cx, cy, ms, ms))
+                if max_sizes:
+                    big = np.sqrt(ms * float(max_sizes[si]))
+                    cell.append((cx, cy, big, big))
+                for ar in ars:
+                    if abs(ar - 1.0) < 1e-6:
+                        continue
+                    cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            else:
+                for ar in ars:
+                    cell.append((cx, cy, ms * np.sqrt(ar), ms / np.sqrt(ar)))
+                if max_sizes:
+                    big = np.sqrt(ms * float(max_sizes[si]))
+                    cell.append((cx, cy, big, big))
+        for cx_, cy_, bw, bh in cell:
+            box = [(cx_ - bw / 2) / iw, (cy_ - bh / 2) / ih,
+                   (cx_ + bw / 2) / iw, (cy_ + bh / 2) / ih]
+            if clip:
+                box = [min(max(v, 0.0), 1.0) for v in box]
+            boxes.append(box)
+            variances.append(list(variance))
+    n_per_cell = len(boxes) // (H * W)
+    out = jnp.asarray(boxes, jnp.float32).reshape(H, W, n_per_cell, 4)
+    var = jnp.asarray(variances, jnp.float32).reshape(H, W, n_per_cell, 4)
+    return Tensor(out), Tensor(var)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5, name=None):
+    """Decode YOLOv3 head predictions to boxes+scores (reference
+    ops.py:277). x [B, na*(5+C), H, W]."""
+    xv = _arr(x).astype(jnp.float32)
+    imgs = _arr(img_size)
+    B, _, H, W = xv.shape
+    na = len(anchors) // 2
+    an = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    p = xv.reshape(B, na, 5 + class_num, H, W)
+    gx = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    sig = jax.nn.sigmoid
+    bx = (sig(p[:, :, 0]) * scale_x_y - (scale_x_y - 1) / 2 + gx) / W
+    by = (sig(p[:, :, 1]) * scale_x_y - (scale_x_y - 1) / 2 + gy) / H
+    bw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / (
+        W * downsample_ratio)
+    bh = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / (
+        H * downsample_ratio)
+    conf = sig(p[:, :, 4])
+    cls = sig(p[:, :, 5:])
+    score = conf[:, :, None] * cls
+    ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+    iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * iw
+    y1 = (by - bh / 2) * ih
+    x2 = (bx + bw / 2) * iw
+    y2 = (by + bh / 2) * ih
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0)
+        y1 = jnp.clip(y1, 0)
+        x2 = jnp.minimum(x2, iw - 1)
+        y2 = jnp.minimum(y2, ih - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(B, -1, 4)
+    scores = score.transpose(0, 1, 3, 4, 2).reshape(B, -1, class_num)
+    keep = conf.reshape(B, -1) > conf_thresh
+    boxes = boxes * keep[..., None]
+    scores = scores * keep[..., None]
+    return Tensor(boxes), Tensor(scores)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference ops.py:766 over
+    deformable_conv_kernel.cu): bilinear-sample the input at
+    offset-shifted tap positions, contract with the kernel via einsum —
+    the MXU does the contraction, no im2col scratch.
+
+    x [B,Cin,H,W]; offset [B, 2*dg*kh*kw, Ho, Wo]; mask (v2)
+    [B, dg*kh*kw, Ho, Wo]; weight [Cout, Cin/groups, kh, kw]."""
+    if groups != 1 or deformable_groups != 1:
+        raise NotImplementedError("groups/deformable_groups > 1: compose "
+                                  "multiple deform_conv2d calls")
+    xv = _arr(x)
+    off = _arr(offset).astype(jnp.float32)
+    w = _arr(weight)
+    B, Cin, H, W = xv.shape
+    Cout, _, kh, kw = w.shape
+    st, pa, di = ((stride, stride) if isinstance(stride, int) else stride,
+                  (padding, padding) if isinstance(padding, int) else padding,
+                  (dilation, dilation) if isinstance(dilation, int)
+                  else dilation)
+    Ho = (H + 2 * pa[0] - di[0] * (kh - 1) - 1) // st[0] + 1
+    Wo = (W + 2 * pa[1] - di[1] * (kw - 1) - 1) // st[1] + 1
+    off = off.reshape(B, kh * kw, 2, Ho, Wo)
+    m = (None if mask is None
+         else _arr(mask).astype(jnp.float32).reshape(B, kh * kw, Ho, Wo))
+
+    oy = jnp.arange(Ho, dtype=jnp.float32)[:, None] * st[0] - pa[0]
+    ox = jnp.arange(Wo, dtype=jnp.float32)[None, :] * st[1] - pa[1]
+
+    def one_image(img, offs, mk):
+        cols = []
+        for ki in range(kh):
+            for kj in range(kw):
+                t = ki * kw + kj
+                yy = oy + ki * di[0] + offs[t, 0]
+                xx = ox + kj * di[1] + offs[t, 1]
+                s = _bilinear_sample(img, yy, xx)      # [Cin, Ho, Wo]
+                cols.append(s * mk[t])
+        col = jnp.stack(cols)                          # [T, Cin, Ho, Wo]
+        wk = w.reshape(Cout, Cin, kh * kw)             # [Cout, Cin, T]
+        return jnp.einsum("tchw,oct->ohw", col, wk)
+
+    out = jax.vmap(one_image)(xv, off,
+                              m if m is not None
+                              else jnp.ones((B, kh * kw, Ho, Wo),
+                                            jnp.float32))
+    if bias is not None:
+        out = out + _arr(bias)[None, :, None, None]
+    return Tensor(out)
+
+
+class DeformConv2D(nn.Layer):
+    """reference ops.py:973."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        k = ((kernel_size, kernel_size) if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        self._args = (stride, padding, dilation, deformable_groups, groups)
+        self.weight = self.create_parameter(
+            (out_channels, in_channels // groups) + k)
+        self.bias = (None if bias_attr is False else
+                     self.create_parameter((out_channels,), is_bias=True))
+
+    def forward(self, x, offset, mask=None):
+        st, pa, di, dg, g = self._args
+        return deform_conv2d(x, offset, self.weight, self.bias, st, pa, di,
+                             dg, g, mask)
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_align(x, boxes, boxes_num, *self._args)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, *self._args)
+
+
+class PSRoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self._args = (output_size, spatial_scale)
+
+    def forward(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, *self._args)
+
+
+class ConvNormActivation(nn.Sequential):
+    """reference ops.py:1877 (torchvision-style building block)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=nn.BatchNorm2D,
+                 activation_layer=nn.ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (reference ops.py:1175):
+    level = floor(refer_level + log2(sqrt(area)/refer_scale))."""
+    rois = np.asarray(_arr(fpn_rois), np.float64)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+                    * np.maximum(rois[:, 3] - rois[:, 1] + off, 0))
+    lvl = np.floor(refer_level + np.log2(scale / refer_scale + 1e-8))
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    n_levels = max_level - min_level + 1
+    multi_rois, restore = [], np.zeros(len(rois), np.int32)
+    rois_num_per = []
+    cursor = 0
+    for li in range(n_levels):
+        idx = np.nonzero(lvl == min_level + li)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx], jnp.float32)))
+        restore[idx] = np.arange(cursor, cursor + len(idx))
+        rois_num_per.append(Tensor(jnp.asarray([len(idx)], jnp.int32)))
+        cursor += len(idx)
+    restore_t = Tensor(jnp.asarray(restore[:, None], jnp.int32))
+    if rois_num is not None:
+        return multi_rois, restore_t, rois_num_per
+    return multi_rois, restore_t
+
+
+def read_file(*a, **k):
+    raise NotImplementedError(
+        "read_file/decode_jpeg are host file IO (reference: CPU-only "
+        "kernels); use PIL/numpy and paddle_tpu.to_tensor")
+
+
+decode_jpeg = read_file

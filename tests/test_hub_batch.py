@@ -1,0 +1,55 @@
+"""paddle.hub / paddle.batch / sysconfig / _C_ops shims.
+
+Reference tests: test/legacy_test/test_hub.py, test_batch.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+def test_hub_local_roundtrip(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny_mlp(width=4):\n"
+        "    'builds a tiny mlp'\n"
+        "    import paddle_tpu as pt\n"
+        "    return pt.nn.Linear(width, width)\n"
+        "def _private():\n"
+        "    pass\n")
+    from paddle_tpu import hub
+    assert hub.list(str(tmp_path)) == ["tiny_mlp"]
+    assert "tiny mlp" in hub.help(str(tmp_path), "tiny_mlp")
+    layer = hub.load(str(tmp_path), "tiny_mlp", width=6)
+    assert layer.in_features == 6
+
+
+def test_hub_remote_refuses():
+    from paddle_tpu import hub
+    with pytest.raises(NotImplementedError, match="egress"):
+        hub.load("some/repo", "model", source="github")
+
+
+def test_batch_reader():
+    r = pt.batch(lambda: iter(range(7)), batch_size=3)
+    assert [list(b) for b in r()] == [[0, 1, 2], [3, 4, 5], [6]]
+    r2 = pt.batch(lambda: iter(range(7)), batch_size=3, drop_last=True)
+    assert [list(b) for b in r2()] == [[0, 1, 2], [3, 4, 5]]
+    with pytest.raises(ValueError):
+        pt.batch(lambda: iter([]), batch_size=0)
+
+
+def test_sysconfig_paths():
+    from paddle_tpu import sysconfig
+    import os
+    assert os.path.isdir(sysconfig.get_include())
+    assert sysconfig.get_lib().endswith("build")
+
+
+def test_c_ops_shim_dispatches():
+    from paddle_tpu import _C_ops
+    x = pt.to_tensor(np.asarray([[1.0, 2.0]], np.float32))
+    y = pt.to_tensor(np.asarray([[3.0], [4.0]], np.float32))
+    out = _C_ops.matmul(x, y)
+    np.testing.assert_allclose(np.asarray(out.data), [[11.0]])
+    with pytest.raises(AttributeError):
+        _C_ops.definitely_not_an_op

@@ -1,0 +1,212 @@
+"""paddle.vision.ops parity tests.
+
+Mirrors reference tests: test/legacy_test/test_nms_op.py,
+test_roi_align_op.py, test_deformable_conv_op.py, test_yolo_box_op.py,
+test_box_coder_op.py, test_matrix_nms_op.py.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as V
+
+
+def _iou(a, b):
+    x1, y1 = max(a[0], b[0]), max(a[1], b[1])
+    x2, y2 = min(a[2], b[2]), min(a[3], b[3])
+    inter = max(x2 - x1, 0) * max(y2 - y1, 0)
+    ua = ((a[2] - a[0]) * (a[3] - a[1]) + (b[2] - b[0]) * (b[3] - b[1])
+          - inter)
+    return inter / max(ua, 1e-10)
+
+
+def _nms_ref(boxes, scores, thresh):
+    order = np.argsort(-scores)
+    keep = []
+    for i in order:
+        if all(_iou(boxes[i], boxes[j]) <= thresh for j in keep):
+            keep.append(i)
+    return keep
+
+
+def test_nms_matches_bruteforce():
+    rng = np.random.RandomState(0)
+    xy = rng.rand(40, 2) * 50
+    wh = rng.rand(40, 2) * 20 + 1
+    boxes = np.concatenate([xy, xy + wh], axis=1).astype(np.float32)
+    scores = rng.rand(40).astype(np.float32)
+    got = np.asarray(V.nms(pt.to_tensor(boxes), 0.4,
+                           scores=pt.to_tensor(scores)).data)
+    ref = _nms_ref(boxes, scores, 0.4)
+    np.testing.assert_array_equal(got, ref)
+
+
+def test_nms_categorical_and_topk():
+    rng = np.random.RandomState(1)
+    base = rng.rand(20, 2) * 10
+    boxes = np.concatenate([base, base + 5], axis=1).astype(np.float32)
+    scores = rng.rand(20).astype(np.float32)
+    cats = (np.arange(20) % 3).astype(np.int32)
+    got = np.asarray(V.nms(pt.to_tensor(boxes), 0.3,
+                           scores=pt.to_tensor(scores),
+                           category_idxs=pt.to_tensor(cats),
+                           categories=[0, 1, 2], top_k=5).data)
+    assert len(got) <= 5
+    # same-category survivors must not overlap above threshold
+    for i, gi in enumerate(got):
+        for gj in got[:i]:
+            if cats[gi] == cats[gj]:
+                assert _iou(boxes[gi], boxes[gj]) <= 0.3 + 1e-6
+
+
+def test_matrix_nms_runs_and_filters():
+    rng = np.random.RandomState(2)
+    b = rng.rand(1, 10, 2) * 20
+    boxes = np.concatenate([b, b + 10], axis=2).astype(np.float32)
+    scores = rng.rand(1, 3, 10).astype(np.float32)
+    out, idx, num = V.matrix_nms(pt.to_tensor(boxes), pt.to_tensor(scores),
+                                 score_threshold=0.3, post_threshold=0.1,
+                                 return_index=True)
+    out = np.asarray(out.data)
+    assert out.shape[1] == 6  # [class, score, x1, y1, x2, y2]
+    assert (out[:, 1] >= 0.1 - 1e-6).all()
+    assert int(np.asarray(num.data)[0]) == out.shape[0]
+
+
+def test_roi_align_linear_field_exact():
+    # bilinear sampling of a LINEAR field f(y,x)=y+x is exact, and the
+    # mean over a bin's sample grid equals f at the bin center — so
+    # out[i,j] must be yc(i) + xc(j) for interior RoIs (aligned=True)
+    yy, xx = np.mgrid[0:8, 0:8].astype(np.float32)
+    feat = (yy + xx)[None, None]
+    rois = np.asarray([[1, 1, 7, 7]], np.float32)
+    out = V.roi_align(pt.to_tensor(feat), pt.to_tensor(rois),
+                      pt.to_tensor(np.asarray([1], np.int32)),
+                      output_size=3, aligned=True)
+    got = np.asarray(out.data)[0, 0]
+    centers = np.asarray([1 + 2 * (i + 0.5) - 0.5 for i in range(3)])
+    ref = centers[:, None] + centers[None, :]
+    np.testing.assert_allclose(got, ref, atol=1e-5)
+
+
+def test_roi_pool_max_semantics():
+    feat = np.zeros((1, 1, 6, 6), np.float32)
+    feat[0, 0, 1, 1] = 5.0
+    feat[0, 0, 4, 4] = 7.0
+    rois = np.asarray([[0, 0, 6, 6]], np.float32)
+    out = V.roi_pool(pt.to_tensor(feat), pt.to_tensor(rois),
+                     pt.to_tensor(np.asarray([1], np.int32)), output_size=2)
+    got = np.asarray(out.data)[0, 0]
+    assert got[0, 0] == 5.0 and got[1, 1] == 7.0
+
+
+def test_psroi_pool_channel_routing():
+    # channel c*4+i*2+j feeds output channel c at bin (i,j)
+    feat = np.zeros((1, 8, 4, 4), np.float32)
+    for t in range(8):
+        feat[0, t] = t + 1
+    rois = np.asarray([[0, 0, 4, 4]], np.float32)
+    out = V.psroi_pool(pt.to_tensor(feat), pt.to_tensor(rois),
+                       pt.to_tensor(np.asarray([1], np.int32)),
+                       output_size=2)
+    got = np.asarray(out.data)[0]      # [2, 2, 2]
+    assert got.shape == (2, 2, 2)
+    np.testing.assert_allclose(got[0].ravel(), [1, 2, 3, 4])
+    np.testing.assert_allclose(got[1].ravel(), [5, 6, 7, 8])
+
+
+def test_box_coder_roundtrip():
+    rng = np.random.RandomState(3)
+    priors = np.asarray([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+    targets = np.abs(rng.rand(3, 4).astype(np.float32)) * 10
+    targets[:, 2:] += targets[:, :2] + 1  # valid boxes
+    enc = V.box_coder(pt.to_tensor(priors), None, pt.to_tensor(targets),
+                      code_type="encode_center_size")
+    assert tuple(enc.shape) == (3, 2, 4)
+    # decode per prior column and compare against the original target
+    for m in range(2):
+        dec = V.box_coder(pt.to_tensor(priors[m:m + 1]), None,
+                          pt.to_tensor(np.asarray(enc.data)[:, m]),
+                          code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec.data), targets,
+                                   rtol=1e-4, atol=1e-3)
+
+
+def test_prior_box_shapes_and_range():
+    feat = np.zeros((1, 8, 3, 3), np.float32)
+    img = np.zeros((1, 3, 30, 30), np.float32)
+    boxes, vars_ = V.prior_box(pt.to_tensor(feat), pt.to_tensor(img),
+                               min_sizes=[4.0], aspect_ratios=[2.0],
+                               clip=True)
+    assert boxes.shape[:2] == [3, 3]
+    b = np.asarray(boxes.data)
+    assert (b >= 0).all() and (b <= 1).all()
+    assert np.asarray(vars_.data).shape == b.shape
+
+
+def test_yolo_box_decodes():
+    rng = np.random.RandomState(4)
+    B, na, C, H = 1, 2, 3, 4
+    x = rng.randn(B, na * (5 + C), H, H).astype(np.float32)
+    boxes, scores = V.yolo_box(pt.to_tensor(x),
+                               pt.to_tensor(np.asarray([[64, 64]], np.int32)),
+                               anchors=[10, 13, 16, 30], class_num=C,
+                               conf_thresh=0.0, downsample_ratio=16)
+    assert tuple(boxes.shape) == (B, na * H * H, 4)
+    assert tuple(scores.shape) == (B, na * H * H, C)
+    b = np.asarray(boxes.data)
+    assert (b[..., 2] >= b[..., 0] - 1e-5).all()
+
+
+def test_deform_conv2d_zero_offset_is_conv():
+    rng = np.random.RandomState(5)
+    x = rng.randn(1, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+    off = np.zeros((1, 2 * 9, 8, 8), np.float32)
+    got = np.asarray(V.deform_conv2d(
+        pt.to_tensor(x), pt.to_tensor(off), pt.to_tensor(w),
+        padding=1).data)
+    ref = np.asarray(pt.nn.functional.conv2d(
+        pt.to_tensor(x), pt.to_tensor(w), padding=1).data)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_layer_and_mask():
+    rng = np.random.RandomState(6)
+    layer = V.DeformConv2D(3, 4, 3, padding=1)
+    x = pt.to_tensor(rng.randn(2, 3, 6, 6).astype(np.float32))
+    off = pt.to_tensor(rng.randn(2, 18, 6, 6).astype(np.float32) * 0.1)
+    mask = pt.to_tensor(np.ones((2, 9, 6, 6), np.float32) * 0.5)
+    out_nomask = layer(x, off)
+    out_mask = layer(x, off, mask)
+    assert tuple(out_nomask.shape) == (2, 4, 6, 6)
+    # mask=0.5 halves the sampled contribution (pre-bias linearity)
+    nb = np.asarray((out_nomask - layer.bias.reshape([1, -1, 1, 1])).data)
+    mb = np.asarray((out_mask - layer.bias.reshape([1, -1, 1, 1])).data)
+    np.testing.assert_allclose(mb, nb * 0.5, rtol=1e-4, atol=1e-4)
+
+
+def test_distribute_fpn_proposals():
+    rois = np.asarray([[0, 0, 10, 10],      # small -> low level
+                       [0, 0, 500, 500],    # large -> high level
+                       [0, 0, 60, 60]], np.float32)
+    multi, restore = V.distribute_fpn_proposals(
+        pt.to_tensor(rois), min_level=2, max_level=5, refer_level=4,
+        refer_scale=224)
+    assert len(multi) == 4
+    total = sum(int(np.asarray(r.data).shape[0]) for r in multi)
+    assert total == 3
+    r = np.asarray(restore.data).ravel()
+    assert sorted(r.tolist()) == [0, 1, 2]
+
+
+def test_conv_norm_activation_block():
+    blk = V.ConvNormActivation(3, 8, kernel_size=3, stride=2)
+    x = pt.to_tensor(np.random.RandomState(7).randn(1, 3, 8, 8)
+                     .astype(np.float32))
+    assert tuple(blk(x).shape) == (1, 8, 4, 4)
+
+
+def test_read_file_raises_with_guidance():
+    with pytest.raises(NotImplementedError, match="host file IO"):
+        V.read_file("x.jpg")

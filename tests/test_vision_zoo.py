@@ -54,20 +54,52 @@ def test_googlenet_aux_heads():
     assert aux1 is None and aux2 is None
 
 
-def test_zoo_trains_one_step():
-    m = M.mobilenet_v2(scale=0.25, num_classes=3)
+@pytest.mark.parametrize("factory,size", [
+    (lambda: M.mobilenet_v2(scale=0.25, num_classes=3), 32),
+    (lambda: M.vgg11(num_classes=3), 32),
+    (lambda: M.squeezenet1_1(num_classes=3), 64),
+    (lambda: M.densenet121(num_classes=3), 32),
+    (lambda: M.shufflenet_v2_x0_25(num_classes=3), 32),
+    (lambda: M.inception_v3(num_classes=3), 75),
+], ids=["mobilenet", "vgg", "squeezenet", "densenet", "shufflenet",
+        "inception"])
+def test_zoo_trains_one_step(factory, size):
+    # every family must backprop to its EARLIEST conv — catches tape
+    # breaks at block boundaries (raw-jnp concat/reshape regressions)
+    m = factory()
     m.train()
     opt = pt.optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
-    x = _img(2, 32)
+    x = _img(2, size)
     y = pt.to_tensor(np.array([0, 2]))
     loss = pt.nn.functional.cross_entropy(m(x), y).mean()
     loss.backward()
-    grads = [p for p in m.parameters() if p._grad is not None]
-    assert len(grads) > 20
+    params = m.parameters()
+    with_grad = [p for p in params if p._grad is not None
+                 and float(np.abs(np.asarray(p._grad.data)).max()) > 0]
+    assert len(with_grad) > 0.8 * len(
+        [p for p in params if not p.stop_gradient]), \
+        f"only {len(with_grad)}/{len(params)} params got gradients"
+    first_conv = next(p for p in params if p._data.ndim == 4)
+    assert first_conv._grad is not None
     opt.step()
     opt.clear_grad()
     loss2 = pt.nn.functional.cross_entropy(m(x), y).mean()
     assert np.isfinite(float(loss2))
+
+
+def test_googlenet_trains_with_aux():
+    m = M.googlenet(num_classes=3)
+    m.train()
+    x = _img(2, 64)
+    y = pt.to_tensor(np.array([0, 2]))
+    out, aux1, aux2 = m(x)
+    loss = (pt.nn.functional.cross_entropy(out, y).mean()
+            + 0.3 * pt.nn.functional.cross_entropy(aux1, y).mean()
+            + 0.3 * pt.nn.functional.cross_entropy(aux2, y).mean())
+    loss.backward()
+    params = m.parameters()
+    with_grad = [p for p in params if p._grad is not None]
+    assert len(with_grad) > 0.8 * len(params)
 
 
 def test_zoo_eval_deterministic_with_dropout():
