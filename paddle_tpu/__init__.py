@@ -27,7 +27,9 @@ from .core.containers import (TensorArray, SelectedRows, create_array,
                               array_write, array_read, array_length)
 from .autograd import no_grad, enable_grad, set_grad_enabled, is_grad_enabled, grad
 from .autograd.tape import backward as _backward
-from .framework import get_default_device, set_device, get_device, device_count, is_compiled_with_tpu
+from .framework import (get_default_device, set_device, get_device,
+                        device_count, is_compiled_with_tpu,
+                        CPUPlace, CUDAPlace, CUDAPinnedPlace, TPUPlace)
 
 # the op library (also installs Tensor methods/dunders)
 from .ops import *  # noqa: F401,F403
@@ -55,6 +57,115 @@ _LAZY_SUBMODULES = (
     "callbacks", "parallel", "strings", "hub", "sysconfig", "_C_ops",
 )
 from .batch import batch  # noqa: E402
+
+
+def ParamAttr(*args, **kwargs):  # noqa: N802 (reference class name)
+    """paddle.ParamAttr (reference python/paddle/base/param_attr.py)."""
+    from .nn.initializer import ParamAttr as _PA
+    return _PA(*args, **kwargs)
+
+
+dtype = _dtype_mod.DType  # paddle.dtype: the framework dtype type
+
+
+def get_rng_state(device=None):
+    """Opaque RNG state list (reference paddle.get_rng_state)."""
+    from .core import generator
+    return [generator.default_generator().get_state()]
+
+
+def set_rng_state(state_list, device=None):
+    from .core import generator
+    generator.default_generator().set_state(state_list[0])
+
+
+get_cuda_rng_state = get_rng_state
+set_cuda_rng_state = set_rng_state
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Mirrors numpy printoptions (Tensor repr routes through numpy)."""
+    import numpy as _np
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: this build installs no signal handlers (the reference
+    hooks SIGSEGV etc. for C++ stack reports; XLA/JAX do not)."""
+
+
+def check_shape(x):
+    """Shape sanity assertion used by reference debugging utilities."""
+    s = tuple(x.shape)
+    if any(int(d) < 0 for d in s):
+        raise ValueError(f"tensor has negative dimension: {s}")
+    return s
+
+
+class LazyGuard:
+    """Deferred-initialization scope (reference paddle.LazyGuard defers
+    parameter materialization until `layer.forward`). Functional JAX
+    arrays are cheap to materialize and there is no separate
+    startup-program phase to defer into, so entering the scope is a
+    no-op kept for API compatibility; parameters are created eagerly."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Per-layer FLOPs estimate (reference paddle.flops / hapi.summary).
+    Counts the MXU-relevant layers: conv (2*k*k*cin*cout*Ho*Wo),
+    linear (2*in*out), matmul-free layers are 0."""
+    import numpy as _np
+    from .nn import Conv2D, Linear
+    total = [0]
+    hooks = []
+
+    def conv_hook(layer, inp, out):
+        k = int(_np.prod(layer.kernel_size))
+        cin = layer.in_channels // layer.groups
+        total[0] += 2 * k * cin * layer.out_channels * int(
+            _np.prod(out.shape[2:])) * out.shape[0]
+
+    def linear_hook(layer, inp, out):
+        total[0] += 2 * layer.in_features * layer.out_features * int(
+            _np.prod(out.shape[:-1]))
+
+    for sub in net.sublayers(include_self=True):
+        if isinstance(sub, Conv2D):
+            hooks.append(sub.register_forward_post_hook(conv_hook))
+        elif isinstance(sub, Linear):
+            hooks.append(sub.register_forward_post_hook(linear_hook))
+    import jax.numpy as jnp
+    x = Tensor(jnp.zeros(tuple(input_size), jnp.float32))
+    was_training = net.training
+    net.eval()
+    try:
+        net(x)
+    finally:
+        if was_training:
+            net.train()
+        for h in hooks:
+            h.remove()
+    if print_detail:
+        print(f"Total FLOPs: {total[0]}")
+    return total[0]
 
 
 def __getattr__(name):

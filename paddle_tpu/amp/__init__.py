@@ -12,3 +12,18 @@ from .grad_scaler import GradScaler, AmpScaler
 from . import debugging
 
 __all__ = ["auto_cast", "decorate", "GradScaler", "AmpScaler"]
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    """bf16 is the TPU native compute dtype (reference amp checks CUDA
+    compute capability >= 80; every TPU generation qualifies)."""
+    return True
+
+
+def is_float16_supported(device=None) -> bool:
+    import jax
+    # fp16 runs on TPU but bf16 is preferred; CPU backends emulate it
+    return jax.default_backend() in ("tpu", "gpu", "cpu")
+
+
+__all__ += ["is_bfloat16_supported", "is_float16_supported"]

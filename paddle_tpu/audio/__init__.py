@@ -2,3 +2,6 @@
 features + window functions). STFT math rides paddle_tpu.signal."""
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends import info, load, save  # noqa: F401

@@ -1,0 +1,91 @@
+"""paddle.audio.backends — wave IO.
+
+Reference: python/paddle/audio/backends/ (wave_backend.py over the
+stdlib wave module, plus optional paddleaudio soundfile backends).
+This build ships the stdlib wave backend (16/8/32-bit PCM WAV); other
+formats need a soundfile install, which zero-egress images lack.
+"""
+from __future__ import annotations
+
+import wave as _wave
+from typing import NamedTuple, Optional, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+
+class AudioInfo(NamedTuple):
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+_WIDTH_DTYPE = {1: np.uint8, 2: np.int16, 4: np.int32}
+
+
+def list_available_backends():
+    return ["wave_backend"]
+
+
+def get_current_backend():
+    return "wave_backend"
+
+
+def set_backend(backend_name: str):
+    if backend_name != "wave_backend":
+        raise NotImplementedError(
+            f"backend {backend_name!r} needs paddleaudio/soundfile "
+            "(unavailable in this zero-egress build); wave_backend "
+            "handles PCM WAV")
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(), f.getnchannels(),
+                         f.getsampwidth() * 8,
+                         f"PCM_{'U' if f.getsampwidth() == 1 else 'S'}")
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """WAV -> (waveform [C, T] (or [T, C]), sample_rate)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        nch = f.getnchannels()
+        width = f.getsampwidth()
+        f.setpos(frame_offset)
+        n = num_frames if num_frames >= 0 else f.getnframes() - frame_offset
+        raw = f.readframes(n)
+    data = np.frombuffer(raw, _WIDTH_DTYPE[width]).reshape(-1, nch)
+    if width == 1:
+        data = data.astype(np.float32) / 128.0 - 1.0
+    elif normalize:
+        data = data.astype(np.float32) / float(2 ** (width * 8 - 1))
+    out = data.T if channels_first else data
+    return Tensor(jnp.asarray(out)), sr
+
+
+def save(filepath: str, src, sample_rate: int, channels_first: bool = True,
+         encoding: str = "PCM_16", bits_per_sample: Optional[int] = 16):
+    """waveform (float in [-1,1] or int16) -> PCM WAV."""
+    data = np.asarray(src.data if isinstance(src, Tensor) else src)
+    if channels_first:
+        data = data.T
+    if data.ndim == 1:
+        data = data[:, None]
+    if data.dtype != np.int16:
+        data = np.clip(data, -1.0, 1.0)
+        data = (data * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(data.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(data.tobytes())

@@ -1,0 +1,55 @@
+"""paddle.audio.datasets — audio dataset surface.
+
+Reference: python/paddle/audio/datasets/{tess,esc50}.py — folder-of-wavs
+datasets that download archives. Zero-egress build: datasets read an
+already-extracted local directory (``data_dir``); the label is the
+parent folder name, matching the reference's on-disk layout after its
+download step.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Tuple
+
+import numpy as np
+
+from ..io.dataset import Dataset
+from .backends import load as _load
+
+__all__ = ["AudioFolderDataset", "TESS", "ESC50"]
+
+
+class AudioFolderDataset(Dataset):
+    """<data_dir>/<label>/<clip>.wav layout -> (waveform, label_idx)."""
+
+    def __init__(self, data_dir: str, sample_rate: int = None,
+                 feat_type: str = "raw", **kwargs):
+        if not os.path.isdir(data_dir):
+            raise FileNotFoundError(
+                f"{data_dir!r} not found — place the extracted dataset "
+                "there (downloads need egress this build doesn't have)")
+        self.files: List[Tuple[str, int]] = []
+        labels = sorted(d for d in os.listdir(data_dir)
+                        if os.path.isdir(os.path.join(data_dir, d)))
+        self.label_list = labels
+        for li, lab in enumerate(labels):
+            folder = os.path.join(data_dir, lab)
+            for f in sorted(os.listdir(folder)):
+                if f.lower().endswith(".wav"):
+                    self.files.append((os.path.join(folder, f), li))
+
+    def __getitem__(self, idx):
+        path, label = self.files[idx]
+        wav, _sr = _load(path)
+        return np.asarray(wav.data), label
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(AudioFolderDataset):
+    """reference audio/datasets/tess.py (Toronto emotional speech set)."""
+
+
+class ESC50(AudioFolderDataset):
+    """reference audio/datasets/esc50.py (environmental sounds)."""

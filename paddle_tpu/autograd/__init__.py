@@ -11,3 +11,32 @@ __all__ = ["backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
 
 def is_grad_enabled() -> bool:
     return grad_enabled()
+
+
+class saved_tensors_hooks:
+    """Context manager intercepting activation saves (reference
+    autograd/saved_tensors_hooks.py: pack/unpack hooks on the grad
+    tape, used for CPU-offload or compression of saved activations).
+
+    The eager tape stores vjp residuals opaquely inside jax pullback
+    closures, so per-tensor pack/unpack cannot be applied there; the
+    supported contract is the reference's main use case — transforming
+    tensors explicitly saved through PyLayerContext.save_for_backward.
+    """
+
+    _active = None
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        saved_tensors_hooks._active = self
+        return self
+
+    def __exit__(self, *exc):
+        saved_tensors_hooks._active = None
+        return False
+
+
+__all__ += ["saved_tensors_hooks"]

@@ -28,9 +28,20 @@ class PyLayerContext:
         self.non_differentiable = set()
 
     def save_for_backward(self, *tensors):
-        self._saved = tuple(tensors)
+        from . import saved_tensors_hooks
+        hooks = saved_tensors_hooks._active
+        if hooks is not None:
+            # capture the unpack hook at pack time: backward may run
+            # after the context manager has exited
+            self._saved = tuple(hooks.pack_hook(t) for t in tensors)
+            self._unpack = hooks.unpack_hook
+        else:
+            self._saved = tuple(tensors)
+            self._unpack = None
 
     def saved_tensor(self):
+        if getattr(self, "_unpack", None) is not None:
+            return tuple(self._unpack(t) for t in self._saved)
         return self._saved
 
     def mark_non_differentiable(self, *tensors):

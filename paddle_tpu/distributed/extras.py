@@ -1,0 +1,238 @@
+"""Remaining paddle.distributed public surface.
+
+Reference: python/paddle/distributed/__init__.py exports sourced from
+fleet/base/, auto_parallel/api.py, parallel.py (spawn), checkpoint/.
+Parameter-server types (entries, *Dataset) are documented non-goals
+(README) and deliberately absent.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+__all__ = ["ParallelMode", "ReduceType", "DistAttr", "ShardingStage1",
+           "ShardingStage2", "ShardingStage3", "split", "spawn",
+           "shard_dataloader", "shard_scaler", "save_state_dict",
+           "load_state_dict", "to_static", "Strategy", "DistModel"]
+
+
+class ParallelMode:
+    """reference fleet/base/topology.py ParallelMode enum."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+    SEGMENT_PARALLEL = 4
+
+
+class ReduceType:
+    """reference auto_parallel Partial reduce kinds."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """Tensor distributed attributes (reference
+    auto_parallel/api.py DistAttr over TensorDistAttr): process mesh +
+    per-dim sharding. Bridges to the NamedSharding this build uses."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+
+    def to_named_sharding(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(self.process_mesh.jax_mesh,
+                             P(*self.sharding_specs))
+
+
+class _ShardingStage:
+    stage = 0
+
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+class ShardingStage1(_ShardingStage):
+    """Marker config for auto-parallel sharding stage selection
+    (reference auto_parallel/api ShardingStage1): optimizer-state
+    sharding over dp (distributed/sharding.py implements the layouts)."""
+    stage = 1
+
+
+class ShardingStage2(_ShardingStage):
+    stage = 2
+
+
+class ShardingStage3(_ShardingStage):
+    stage = 3
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Model-parallel split layer builder (reference
+    fleet/layers/mpu/mp_ops.py _c_split-based paddle.distributed.split):
+    returns a column/row-parallel linear or vocab-parallel embedding
+    over the current tp mesh axis."""
+    from . import mpu
+    if operation == "linear":
+        in_f, out_f = size
+        if axis in (1, "column"):
+            return mpu.ColumnParallelLinear(in_f, out_f,
+                                            gather_output=gather_out,
+                                            weight_attr=weight_attr,
+                                            has_bias=bias_attr is not False)
+        return mpu.RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                     has_bias=bias_attr is not False)
+    if operation == "embedding":
+        num, dim = size
+        return mpu.VocabParallelEmbedding(num, dim, weight_attr=weight_attr)
+    raise ValueError(f"unsupported operation {operation!r}")
+
+
+def spawn(func, args=(), nprocs=-1, join=True, **options):
+    """Multi-process launch (reference distributed/spawn.py) riding the
+    launcher's process manager (distributed/launch)."""
+    import multiprocessing as mp
+    n = nprocs if nprocs > 0 else int(os.environ.get("PADDLE_NPROCS", "1"))
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(n):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(n)}
+        p = ctx.Process(target=_spawn_entry, args=(func, args, env))
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        bad = [p.exitcode for p in procs if p.exitcode]
+        if bad:
+            raise RuntimeError(f"spawned workers failed: exit codes {bad}")
+    return procs
+
+
+def _spawn_entry(func, args, env):
+    os.environ.update(env)
+    func(*args)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None, is_dataset=False):
+    """reference auto_parallel/api.py shard_dataloader: re-emit host
+    batches with the mesh's data sharding applied."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    jmesh = getattr(mesh, "jax_mesh", mesh)
+    dim = (shard_dims if isinstance(shard_dims, str)
+           else (shard_dims[0] if shard_dims else "dp"))
+    sharding = NamedSharding(jmesh, P(dim))
+
+    class _Sharded:
+        def __iter__(self):
+            for batch in dataloader:
+                yield jax.tree_util.tree_map(
+                    lambda t: Tensor(jax.device_put(
+                        t.data if isinstance(t, Tensor) else jnp.asarray(t),
+                        sharding)), batch,
+                    is_leaf=lambda v: isinstance(v, Tensor))
+
+        def __len__(self):
+            return len(dataloader)
+
+    return _Sharded()
+
+
+def shard_scaler(scaler):
+    """reference auto_parallel/api.py shard_scaler: the GradScaler's
+    found-inf reduction rides GSPMD allreduce already; pass-through."""
+    return scaler
+
+
+def save_state_dict(state_dict, path, **kwargs):
+    """Sharded checkpoint save (reference distributed/checkpoint/
+    save_state_dict.py) — the orbax-backed writer in .checkpoint."""
+    from . import checkpoint
+    return checkpoint.save_state_dict(state_dict, path, **kwargs)
+
+
+def load_state_dict(state_dict, path=None, **kwargs):
+    """reference load_state_dict(state_dict, path): fills the given
+    structure in place from a sharded checkpoint."""
+    from . import checkpoint
+    if path is None:
+        raise ValueError("path required")
+    return checkpoint.load_state_dict(state_dict, path, **kwargs)
+
+
+class Strategy:
+    """reference auto_parallel/strategy.py: knob container for
+    to_static/DistModel (sharding/amp/pipeline sections)."""
+
+    class _Section(dict):
+        def __getattr__(self, k):
+            return self.get(k)
+
+        def __setattr__(self, k, v):
+            self[k] = v
+
+    def __init__(self, config=None):
+        cfg = config or {}
+        self.sharding = Strategy._Section(cfg.get("sharding", {}))
+        self.amp = Strategy._Section(cfg.get("amp", {}))
+        self.pipeline = Strategy._Section(cfg.get("pipeline", {}))
+        self.gradient_merge = Strategy._Section(cfg.get("gradient_merge", {}))
+
+
+class DistModel:
+    """reference auto_parallel/api.py DistModel (returned by to_static):
+    wraps layer+loader+loss+optimizer into compiled train/eval/predict
+    steps over the mesh — this build's auto_parallel Engine provides the
+    machinery."""
+
+    def __init__(self, layer, loader, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        from .auto_parallel.engine import Engine
+        self._engine = Engine(layer, loss=loss, optimizer=optimizer,
+                              metrics=metrics)
+        self._engine.prepare()
+        self._loader = loader
+        self._mode = "train"
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            data = args[0] if len(args) == 1 else args
+            return self._engine.fit(data, epochs=1)
+        if self._mode == "eval":
+            return self._engine.evaluate(args[0] if len(args) == 1 else args)
+        return self._engine.predict(args[0] if len(args) == 1 else args)
+
+    def dist_main_program(self, mode=None):
+        return self._engine.distributed_plan()
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None,
+              metrics=None):
+    """reference auto_parallel/api.py to_static -> DistModel."""
+    return DistModel(layer, loader, loss=loss, optimizer=optimizer,
+                     strategy=strategy, metrics=metrics)

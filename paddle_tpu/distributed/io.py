@@ -1,0 +1,23 @@
+"""paddle.distributed.io — save/load for distributed training.
+
+Reference: python/paddle/distributed/io.py (persistables save over the
+fleet). Delegates to the framework io + sharded checkpoint paths.
+"""
+from __future__ import annotations
+
+from ..framework.io import save, load  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+
+
+def save_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static Program persistables are a non-goal (README); use "
+        "paddle_tpu.save / distributed.save_state_dict")
+
+
+def load_persistables(executor=None, dirname=None, main_program=None,
+                      filename=None):
+    raise NotImplementedError(
+        "static Program persistables are a non-goal (README); use "
+        "paddle_tpu.load / distributed.load_state_dict")

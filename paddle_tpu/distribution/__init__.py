@@ -389,3 +389,383 @@ def _kl_bern_bern(p, q):
 def _kl_exp_exp(p, q):
     r = q.rate / p.rate
     return Tensor(jnp.log(p.rate) - jnp.log(q.rate) + r - 1)
+
+
+class Poisson(Distribution):
+    """reference distribution/poisson.py."""
+
+    def __init__(self, rate, name=None):
+        self.rate = jnp.asarray(_u(rate), jnp.float32)
+        super().__init__(jnp.shape(self.rate))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.poisson(_key(), self.rate, shp).astype(
+            jnp.float32))
+
+    def log_prob(self, value):
+        v = _u(value)
+        return Tensor(v * jnp.log(self.rate) - self.rate
+                      - jax.scipy.special.gammaln(v + 1))
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def entropy(self):
+        # series approximation (reference uses the same truncation idea)
+        r = self.rate
+        return Tensor(0.5 * jnp.log(2 * jnp.pi * jnp.e * r)
+                      - 1 / (12 * r) - 1 / (24 * r ** 2))
+
+
+class Binomial(Distribution):
+    """reference distribution/binomial.py."""
+
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = jnp.asarray(_u(total_count), jnp.float32)
+        self.probs = jnp.asarray(_u(probs), jnp.float32)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.total_count),
+                                              jnp.shape(self.probs)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(jax.random.binomial(_key(), self.total_count,
+                                          self.probs, shp))
+
+    def log_prob(self, value):
+        v = _u(value)
+        n, p = self.total_count, self.probs
+        comb = (jax.scipy.special.gammaln(n + 1)
+                - jax.scipy.special.gammaln(v + 1)
+                - jax.scipy.special.gammaln(n - v + 1))
+        return Tensor(comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+
+class Geometric(Distribution):
+    """reference distribution/geometric.py (trials until first success,
+    support {0, 1, ...})."""
+
+    def __init__(self, probs, name=None):
+        self.probs = jnp.asarray(_u(probs), jnp.float32)
+        super().__init__(jnp.shape(self.probs))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_key(), shp, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs)))
+
+    def log_prob(self, value):
+        v = _u(value)
+        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs) / self.probs)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs) / self.probs ** 2)
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-((1 - p) * jnp.log1p(-p) + p * jnp.log(p)) / p)
+
+
+class Cauchy(Distribution):
+    """reference distribution/cauchy.py."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = jnp.asarray(_u(loc), jnp.float32)
+        self.scale = jnp.asarray(_u(scale), jnp.float32)
+        super().__init__(jnp.broadcast_shapes(jnp.shape(self.loc),
+                                              jnp.shape(self.scale)))
+
+    def rsample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale * jax.random.cauchy(_key(), shp))
+
+    def log_prob(self, value):
+        v = _u(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-jnp.log(jnp.pi * self.scale * (1 + z * z)))
+
+    def cdf(self, value):
+        v = _u(value)
+        return Tensor(jnp.arctan((v - self.loc) / self.scale) / jnp.pi + 0.5)
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * jnp.pi * self.scale)
+                      * jnp.ones(self._batch_shape))
+
+
+class Chi2(Distribution):
+    """reference distribution/chi2.py: Gamma(df/2, 1/2)."""
+
+    def __init__(self, df, name=None):
+        self.df = jnp.asarray(_u(df), jnp.float32)
+        super().__init__(jnp.shape(self.df))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(2.0 * jax.random.gamma(_key(), self.df / 2.0, shp))
+
+    def log_prob(self, value):
+        v = _u(value)
+        k = self.df / 2.0
+        return Tensor((k - 1) * jnp.log(v) - v / 2 - k * jnp.log(2.0)
+                      - jax.scipy.special.gammaln(k))
+
+    @property
+    def mean(self):
+        return Tensor(self.df)
+
+    @property
+    def variance(self):
+        return Tensor(2 * self.df)
+
+
+class StudentT(Distribution):
+    """reference distribution/student_t.py."""
+
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = jnp.asarray(_u(df), jnp.float32)
+        self.loc = jnp.asarray(_u(loc), jnp.float32)
+        self.scale = jnp.asarray(_u(scale), jnp.float32)
+        super().__init__(jnp.broadcast_shapes(
+            jnp.shape(self.df), jnp.shape(self.loc), jnp.shape(self.scale)))
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        return Tensor(self.loc + self.scale
+                      * jax.random.t(_key(), self.df, shp))
+
+    def log_prob(self, value):
+        v = _u(value)
+        z = (v - self.loc) / self.scale
+        nu = self.df
+        lg = jax.scipy.special.gammaln
+        return Tensor(lg((nu + 1) / 2) - lg(nu / 2)
+                      - 0.5 * jnp.log(nu * jnp.pi) - jnp.log(self.scale)
+                      - (nu + 1) / 2 * jnp.log1p(z * z / nu))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.where(self.df > 1, self.loc, jnp.nan))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.where(self.df > 2,
+                                self.scale ** 2 * self.df / (self.df - 2),
+                                jnp.nan))
+
+
+class ContinuousBernoulli(Distribution):
+    """reference distribution/continuous_bernoulli.py (Loaiza-Ganem &
+    Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.asarray(_u(probs), jnp.float32)
+        self._lims = lims
+        super().__init__(jnp.shape(self.probs))
+
+    def _log_C(self):
+        p = self.probs
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        logC = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * safe))
+                       / jnp.abs(1 - 2 * safe))
+        # taylor at p=1/2: log 2 + 4/3 (p-1/2)^2
+        x = p - 0.5
+        taylor = jnp.log(2.0) + 4.0 / 3.0 * x * x
+        return jnp.where(near_half, taylor, logC)
+
+    def sample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape
+        u = jax.random.uniform(_key(), shp, minval=1e-6, maxval=1 - 1e-6)
+        p = self.probs
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        icdf = (jnp.log1p(u * (2 * safe - 1) / (1 - safe))
+                / (jnp.log(safe) - jnp.log1p(-safe)))
+        return Tensor(jnp.where(near_half, u, icdf))
+
+    def log_prob(self, value):
+        v = _u(value)
+        p = self.probs
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                      + self._log_C())
+
+    @property
+    def mean(self):
+        p = self.probs
+        near_half = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near_half, 0.25, p)
+        m = safe / (2 * safe - 1) + 1 / (2 * jnp.arctanh(1 - 2 * safe))
+        return Tensor(jnp.where(near_half, 0.5 + (p - 0.5) / 3.0, m))
+
+
+class MultivariateNormal(Distribution):
+    """reference distribution/multivariate_normal.py."""
+
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None,
+                 name=None):
+        self.loc = jnp.asarray(_u(loc), jnp.float32)
+        if scale_tril is not None:
+            self.scale_tril = jnp.asarray(_u(scale_tril), jnp.float32)
+        elif covariance_matrix is not None:
+            self.scale_tril = jnp.linalg.cholesky(
+                jnp.asarray(_u(covariance_matrix), jnp.float32))
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+        super().__init__(jnp.shape(self.loc)[:-1], jnp.shape(self.loc)[-1:])
+
+    def rsample(self, shape=()):
+        shp = tuple(shape) + self._batch_shape + self._event_shape
+        eps = jax.random.normal(_key(), shp)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self.scale_tril, eps))
+
+    def log_prob(self, value):
+        v = _u(value)
+        d = self._event_shape[0]
+        diff = v - self.loc
+        sol = jax.scipy.linalg.solve_triangular(self.scale_tril, diff[..., None],
+                                                lower=True)[..., 0]
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(-0.5 * (d * jnp.log(2 * jnp.pi)
+                              + (sol * sol).sum(-1)) - logdet)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    def entropy(self):
+        d = self._event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self.scale_tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(0.5 * d * (1 + jnp.log(2 * jnp.pi)) + logdet)
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (reference
+    distribution/independent.py)."""
+
+    def __init__(self, base, reinterpreted_batch_rank, name=None):
+        self.base = base
+        self.rank = reinterpreted_batch_rank
+        nb = len(base.batch_shape) - reinterpreted_batch_rank
+        super().__init__(base.batch_shape[:nb],
+                         base.batch_shape[nb:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        # base.log_prob already reduced the base's event dims, so its
+        # output shape is base.batch_shape; sum the reinterpreted tail
+        lp = _u(self.base.log_prob(value))
+        return Tensor(lp.sum(axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = _u(self.base.entropy())
+        axes = tuple(range(-self.rank, 0))
+        return Tensor(e.sum(axis=axes))
+
+
+class ExponentialFamily(Distribution):
+    """Base marker class (reference distribution/exponential_family.py):
+    provides entropy via the Bregman identity for subclasses that
+    define natural parameters. Concrete families here implement entropy
+    directly; the class exists for isinstance checks and subclassing."""
+
+
+class TransformedDistribution(Distribution):
+    """reference distribution/transformed_distribution.py: pushforward
+    of a base distribution through a chain of transforms."""
+
+    def __init__(self, base, transforms, name=None):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = value
+        log_det = 0.0
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            log_det = log_det + _u(t.forward_log_det_jacobian(x))
+            y = x
+        return Tensor(_u(self.base.log_prob(y)) - log_det)
+
+
+class LKJCholesky(Distribution):
+    """reference distribution/lkj_cholesky.py: distribution over
+    Cholesky factors of correlation matrices (LKJ 2009), onion-method
+    sampler."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        self.dim = int(dim)
+        self.concentration = float(_u(concentration))
+        super().__init__((), (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration
+        shp = tuple(shape)
+        # onion method: build row by row from beta marginals
+        L = jnp.zeros(shp + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            beta = jax.random.beta(_key(), i / 2.0,
+                                   eta + (d - 1 - i) / 2.0, shp)
+            u = jax.random.normal(_key(), shp + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(beta)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(jnp.maximum(1 - beta, 1e-12)))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        L = _u(value)
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.asarray([d - 2 - i + 2 * (eta - 1) for i in range(d - 1)])
+        unnorm = (orders * jnp.log(diag)).sum(-1)
+        # normalization (reference lkj_cholesky.py log_normalizer)
+        lg = jax.scipy.special.gammaln
+        idx = jnp.arange(1, d)
+        logn = jnp.sum(0.5 * idx * jnp.log(jnp.pi)
+                       + lg(eta + (d - 1 - idx) / 2)
+                       - lg(eta + (d - 1) / 2))
+        return Tensor(unnorm - logn)

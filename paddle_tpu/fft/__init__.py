@@ -33,6 +33,41 @@ rfftn = _w(jnp.fft.rfftn)
 irfftn = _w(jnp.fft.irfftn)
 hfft = _w(jnp.fft.hfft)
 ihfft = _w(jnp.fft.ihfft)
+
+
+def _hermitian_nd(base_1d, last_fn, x, s=None, axes=None, norm="backward",
+                  name=None):
+    """hfft2/hfftn-style transforms: full FFT over all axes but the
+    last, hermitian transform on the last (reference fft.py hfftn)."""
+    import numpy as _np
+    d = x.data if hasattr(x, "data") else jnp.asarray(x)
+    nd = d.ndim
+    axes = tuple(range(nd)) if axes is None else tuple(a % nd for a in axes)
+    head, last = axes[:-1], axes[-1]
+    if head:
+        d = jnp.fft.fftn(d, s=None if s is None else s[:-1], axes=head,
+                         norm=norm) if base_1d == "h" else \
+            jnp.fft.ifftn(d, s=None if s is None else s[:-1], axes=head,
+                          norm=norm)
+    n_last = None if s is None else s[-1]
+    out = last_fn(d, n=n_last, axis=last, norm=norm)
+    return Tensor(out)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hermitian_nd("h", jnp.fft.hfft, x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hermitian_nd("h", jnp.fft.hfft, x, s, axes, norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _hermitian_nd("i", jnp.fft.ihfft, x, s, axes, norm)
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _hermitian_nd("i", jnp.fft.ihfft, x, s, axes, norm)
 fftshift = _w(jnp.fft.fftshift)
 ifftshift = _w(jnp.fft.ifftshift)
 

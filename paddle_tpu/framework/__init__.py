@@ -63,3 +63,53 @@ def is_compiled_with_cuda() -> bool:
 def is_compiled_with_cinn() -> bool:
     # XLA plays CINN's role; report True for API parity of capability checks
     return True
+
+
+class _Place:
+    """Device placement token (reference paddle.CPUPlace/CUDAPlace/
+    XPUPlace, paddle/phi/common/place.h). On this build placement is
+    XLA's job; Places resolve to jax devices for `paddle.device` calls
+    and to_tensor(place=...)."""
+
+    _platform = "cpu"
+
+    def __init__(self, device_id: int = 0):
+        self._id = device_id
+
+    def get_device_id(self) -> int:
+        return self._id
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if d.platform == self._platform]
+        if not devs:  # fall back to default (e.g. CUDAPlace on a TPU host)
+            devs = jax.devices()
+        return devs[min(self._id, len(devs) - 1)]
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._id})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self._id == other._id
+
+
+class CPUPlace(_Place):
+    _platform = "cpu"
+
+    def __init__(self):
+        super().__init__(0)
+
+    def __repr__(self):
+        return "Place(cpu)"
+
+
+class CUDAPlace(_Place):
+    # accepted for API compat; resolves to the accelerator (TPU) device
+    _platform = "tpu"
+
+
+class CUDAPinnedPlace(CPUPlace):
+    pass
+
+
+class TPUPlace(_Place):
+    _platform = "tpu"

@@ -10,6 +10,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.tensor import Tensor
 
@@ -86,3 +87,152 @@ def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
                        reduce_op=reduce_op,
                        out_size=out_size if out_size is not None
                        else xd.shape[0])
+
+
+def _host_rng():
+    """Host-side RNG derived from the framework generator so sampling
+    follows paddle_tpu.seed() (reproducible GNN pipelines)."""
+    import jax
+    from ..core.generator import next_key
+    seed = int(jax.random.randint(next_key(), (), 0, 2 ** 31 - 1))
+    return np.random.RandomState(seed)
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add", name=None):
+    """Per-edge message from both endpoints (reference
+    geometric/message_passing/send_recv.py send_uv): out[e] =
+    op(x[src[e]], y[dst[e]]) — one gather per side, no scatter."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    xd, yd = _u(x), _u(y)
+    s = _u(src_index).astype(jnp.int32)
+    d = _u(dst_index).astype(jnp.int32)
+    a, b = xd[s], yd[d]
+    if message_op == "add":
+        out = a + b
+    elif message_op == "sub":
+        out = a - b
+    elif message_op == "mul":
+        out = a * b
+    elif message_op == "div":
+        out = a / b
+    else:
+        raise ValueError(f"unknown message_op {message_op!r}")
+    return Tensor(out)
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling over a CSC graph (reference
+    geometric/sampling/neighbors.py over graph_sample_neighbors kernels).
+    Host-side: sampling drives the NEXT batch's gather — it is data
+    pipeline work, not accelerator compute (same split as the
+    reference, whose kernel runs on CPU for the DataLoader path)."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    rows = np.asarray(_u(row))
+    cptr = np.asarray(_u(colptr))
+    nodes = np.atleast_1d(np.asarray(_u(input_nodes)))
+    rng = _host_rng()
+    out_neighbors, out_count, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cptr[n]), int(cptr[n + 1])
+        neigh = rows[lo:hi]
+        eid = np.arange(lo, hi)
+        if sample_size > 0 and len(neigh) > sample_size:
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh, eid = neigh[pick], eid[pick]
+        out_neighbors.append(neigh)
+        out_eids.append(eid)
+        out_count.append(len(neigh))
+    neighbors = Tensor(jnp.asarray(
+        np.concatenate(out_neighbors) if out_neighbors else
+        np.zeros((0,), rows.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_count, np.int32)))
+    if return_eids:
+        return neighbors, counts, Tensor(jnp.asarray(
+            np.concatenate(out_eids).astype(np.int64)))
+    return neighbors, counts
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weight-proportional variant of sample_neighbors."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    rows = np.asarray(_u(row))
+    cptr = np.asarray(_u(colptr))
+    w = np.asarray(_u(edge_weight), np.float64)
+    nodes = np.atleast_1d(np.asarray(_u(input_nodes)))
+    rng = _host_rng()
+    out_neighbors, out_count, out_eids = [], [], []
+    for n in nodes:
+        lo, hi = int(cptr[n]), int(cptr[n + 1])
+        neigh = rows[lo:hi]
+        eid = np.arange(lo, hi)
+        if sample_size > 0 and len(neigh) > sample_size:
+            p = w[lo:hi] / max(w[lo:hi].sum(), 1e-12)
+            pick = rng.choice(len(neigh), sample_size, replace=False, p=p)
+            neigh, eid = neigh[pick], eid[pick]
+        out_neighbors.append(neigh)
+        out_eids.append(eid)
+        out_count.append(len(neigh))
+    neighbors = Tensor(jnp.asarray(
+        np.concatenate(out_neighbors) if out_neighbors else
+        np.zeros((0,), rows.dtype)))
+    counts = Tensor(jnp.asarray(np.asarray(out_count, np.int32)))
+    if return_eids:
+        return neighbors, counts, Tensor(jnp.asarray(
+            np.concatenate(out_eids).astype(np.int64)))
+    return neighbors, counts
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global node ids to local ids (reference
+    geometric/reindex.py): x's nodes get 0..len(x)-1, unseen neighbor
+    ids get fresh ids in first-appearance order."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    xs = np.asarray(_u(x))
+    nb = np.asarray(_u(neighbors))
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    out = np.empty(len(nb), np.int64)
+    nodes = list(xs)
+    for i, v in enumerate(nb):
+        v = int(v)
+        if v not in mapping:
+            mapping[v] = len(mapping)
+            nodes.append(v)
+        out[i] = mapping[v]
+    return (Tensor(jnp.asarray(out)),
+            Tensor(jnp.asarray(np.asarray(nodes, xs.dtype))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous variant: neighbors/count are per-edge-type lists
+    sharing one id space."""
+    import numpy as np
+    from ..core.tensor import Tensor
+    import jax.numpy as jnp
+    xs = np.asarray(_u(x))
+    mapping = {int(v): i for i, v in enumerate(xs)}
+    nodes = list(xs)
+    outs = []
+    for nb in neighbors:
+        nbv = np.asarray(_u(nb))
+        out = np.empty(len(nbv), np.int64)
+        for i, v in enumerate(nbv):
+            v = int(v)
+            if v not in mapping:
+                mapping[v] = len(mapping)
+                nodes.append(v)
+            out[i] = mapping[v]
+        outs.append(Tensor(jnp.asarray(out)))
+    return outs, Tensor(jnp.asarray(np.asarray(nodes, xs.dtype)))

@@ -8,3 +8,107 @@ from .nn import functional as _fused  # noqa: F401
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
+from .optimizer import LookAhead  # noqa: F401
+
+# graph/segment ops (reference incubate/__init__.py re-exports; the
+# implementations live with the other graph ops in paddle_tpu.geometric)
+from ..geometric import (  # noqa: F401
+    segment_sum, segment_mean, segment_max, segment_min,
+    send_u_recv as graph_send_recv,
+)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes, **kw):
+    from ..geometric import sample_neighbors
+    raise NotImplementedError(
+        "use paddle_tpu.geometric.sample_neighbors per hop (khop fusion "
+        "is a GPU-hash-table optimization; hop-by-hop sampling is the "
+        "TPU/host path)")
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors
+    return sample_neighbors(row, colptr, input_nodes,
+                            sample_size=sample_size, return_eids=return_eids)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    from ..geometric import reindex_graph
+    return reindex_graph(x, neighbors, count)
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss for IPU pipelines (reference
+    incubate/autograd). Here: plain reduction."""
+    if reduction in ("none", 2):
+        return x
+    if reduction in ("sum", 1):
+        return x.sum()
+    return x.mean()
+
+
+def softmax_mask_fuse_upper_triangle(x):
+    """Fused causal-masked softmax (reference
+    incubate/operators/softmax_mask_fuse_upper_triangle.py — a CUDA
+    fusion; XLA fuses the same expression on TPU)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    T = d.shape[-1]
+    mask = jnp.tril(jnp.ones((T, T), bool))
+    logits = jnp.where(mask, d, jnp.finfo(d.dtype).min)
+    import jax
+    return Tensor(jax.nn.softmax(logits, axis=-1))
+
+
+def softmax_mask_fuse(x, mask):
+    import jax
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    m = mask.data if isinstance(mask, Tensor) else jnp.asarray(mask)
+    return Tensor(jax.nn.softmax(d + m, axis=-1))
+
+
+class ModelAverage:
+    """Parameter averaging over a training window (reference
+    incubate/optimizer/modelaverage.py): accumulates running sums of
+    params; apply()/restore() swap the average in and out for eval."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("parameters required")
+        self._params = list(parameters)
+        self._sums = {id(p): p._data * 0 for p in self._params}
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        for p in self._params:
+            self._sums[id(p)] = self._sums[id(p)] + p._data
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            if self._count:
+                p._data = (self._sums[id(p)] / self._count).astype(
+                    p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup:
+            for p in self._params:
+                p._data = self._backup[id(p)]
+            self._backup = None
+
+
+class inference:  # namespace shim: paddle.incubate.inference decorators
+    @staticmethod
+    def enable_inference_mode(fn=None, **kw):
+        return fn if fn is not None else (lambda f: f)

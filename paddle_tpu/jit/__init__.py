@@ -250,3 +250,34 @@ def load(path: str, **configs) -> TranslatedLayer:
     exported = jexport.deserialize(blob["stablehlo"])
     params = [jnp.asarray(p) for p in blob["params"]]
     return TranslatedLayer(exported, params, blob.get("num_inputs"))
+
+
+def ignore_module(modules):
+    """Exempt modules from SOT tracing (reference jit/api.py
+    ignore_module). Tracing here is jax-level; ignored modules are
+    recorded so `to_static(full_graph=False)` falls back to eager when
+    it hits them."""
+    global _IGNORED_MODULES
+    try:
+        _IGNORED_MODULES |= set(modules)
+    except NameError:
+        _IGNORED_MODULES = set(modules)
+    return list(_IGNORED_MODULES)
+
+
+_IGNORED_MODULES: set = set()
+_CODE_LEVEL = 0
+_VERBOSITY = 0
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Log transformed code (reference jit/dy2static logging). Tracing
+    produces jaxprs, not rewritten source; the level gates jaxpr dumps
+    from to_static."""
+    global _CODE_LEVEL
+    _CODE_LEVEL = level
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _VERBOSITY
+    _VERBOSITY = level

@@ -5,7 +5,8 @@ from ..ops.linalg import (  # noqa: F401
     dist, cholesky, cholesky_solve, inverse, pinv, matrix_rank, matrix_power,
     det, slogdet, qr, svd, svdvals, eig, eigh, eigvals, eigvalsh, solve,
     triangular_solve, lstsq, lu, matrix_exp, multi_dot, corrcoef, cov,
-    histogram, bincount,
+    histogram, bincount, cond, cholesky_inverse, lu_unpack,
+    householder_product, ormqr, svd_lowrank, pca_lowrank,
 )
 
 inv = inverse

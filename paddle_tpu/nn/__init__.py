@@ -33,3 +33,4 @@ from .modules_rnn import (
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, LSTM, GRU, SimpleRNN,
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue
+from .modules_extended import *  # noqa: F401,F403

@@ -26,3 +26,5 @@ from .loss import (cross_entropy, softmax_with_cross_entropy, nll_loss,
 from ...ops.creation import one_hot
 from ...ops.manipulation import pad, unfold
 from ...ops.random import gumbel_softmax
+from .extended import *  # noqa: F401,F403
+from . import extended  # noqa: F401

@@ -1,0 +1,811 @@
+"""Long-tail functionals completing paddle.nn.functional.
+
+Reference: python/paddle/nn/functional/{activation,common,loss,pooling,
+vision,extension}.py — the __all__ entries the core functional modules
+don't already cover. jnp/lax lowerings registered through the op
+registry (eager tape + Tensor methods + jit all see them).
+"""
+from __future__ import annotations
+
+import math as _pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.registry import register_op, call_op, OPS
+from ...core.tensor import Tensor
+
+__all__ = [
+    "pairwise_distance", "thresholded_relu", "sequence_mask",
+    "feature_alpha_dropout", "zeropad2d", "lp_pool1d", "lp_pool2d",
+    "max_unpool1d", "max_unpool2d", "max_unpool3d", "adaptive_avg_pool3d",
+    "adaptive_max_pool1d", "adaptive_max_pool3d", "fractional_max_pool2d",
+    "fractional_max_pool3d", "dice_loss", "hsigmoid_loss", "log_loss",
+    "multi_label_soft_margin_loss", "poisson_nll_loss", "npair_loss",
+    "margin_cross_entropy", "rnnt_loss", "affine_grid", "grid_sample",
+    "gather_tree", "temporal_shift", "class_center_sample",
+    "sparse_attention", "fold", "triplet_margin_with_distance_loss",
+    "adaptive_log_softmax_with_loss", "multi_margin_loss",
+    "soft_margin_loss", "gaussian_nll_loss", "flashmask_attention",
+    "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
+    "elu_", "hardtanh_", "leaky_relu_", "relu_", "softmax_", "tanh_",
+    "thresholded_relu_",
+]
+
+
+# -- distances / masks ------------------------------------------------------
+
+@register_op()
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+@register_op()
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return jnp.where(x > threshold, x, value)
+
+
+@register_op(differentiable=False)
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...core.dtype import to_jax_dtype
+    m = int(maxlen) if maxlen is not None else int(jnp.max(x))
+    return (jnp.arange(m)[None, :] < x[..., None]).astype(to_jax_dtype(dtype))
+
+
+@register_op(differentiable=False)
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestry walk (reference extension.py gather_tree over
+    phi gather_tree kernel): ids/parents [T, B, W] -> full paths."""
+    T = ids.shape[0]
+
+    def step(carry, xs):
+        beam = carry                        # [B, W] current beam index
+        ids_t, parents_t = xs
+        tok = jnp.take_along_axis(ids_t, beam, axis=-1)
+        beam = jnp.take_along_axis(parents_t, beam, axis=-1)
+        return beam, tok
+
+    last_beam = jnp.broadcast_to(jnp.arange(ids.shape[2]), ids.shape[1:])
+    _, toks = lax.scan(step, last_beam, (ids[::-1], parents[::-1]))
+    return toks[::-1]
+
+
+@register_op()
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel time-shift (reference extension.py temporal_shift)."""
+    NT, C, H, W = x.shape
+    N = NT // seg_num
+    v = x.reshape(N, seg_num, C, H, W)
+    fold = int(C * shift_ratio)
+    back = jnp.roll(v[:, :, :fold], 1, axis=1).at[:, 0, :].set(0.0)
+    fwd = jnp.roll(v[:, :, fold:2 * fold], -1, axis=1).at[:, -1, :].set(0.0)
+    keep = v[:, :, 2 * fold:]
+    return jnp.concatenate([back, fwd, keep], axis=2).reshape(NT, C, H, W)
+
+
+# -- inplace activation variants -------------------------------------------
+
+def _functional_inplace(base_name):
+    def fn(x, *args, **kwargs):
+        from ...ops import _make_inplace
+        return _make_inplace(base_name)(x, *args, **kwargs)
+    fn.__name__ = base_name + "_"
+    return fn
+
+
+elu_ = _functional_inplace("elu")
+hardtanh_ = _functional_inplace("hardtanh")
+leaky_relu_ = _functional_inplace("leaky_relu")
+relu_ = _functional_inplace("relu")
+softmax_ = _functional_inplace("softmax")
+tanh_ = _functional_inplace("tanh")
+thresholded_relu_ = _functional_inplace("thresholded_relu")
+
+
+# -- dropout / pad ----------------------------------------------------------
+
+@register_op()
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout over whole channels (reference common.py
+    feature_alpha_dropout: SELU-preserving statistics)."""
+    if not training or p == 0.0:
+        return x
+    from ...core.generator import next_key
+    alpha_p = -1.7580993408473766
+    shape = (x.shape[0], x.shape[1]) + (1,) * (x.ndim - 2)
+    keep = jax.random.bernoulli(next_key(), 1 - p, shape)
+    a = (1 / ((1 - p) * (1 + p * alpha_p ** 2)) ** 0.5).real
+    b = -a * alpha_p * p
+    return a * jnp.where(keep, x, alpha_p) + b
+
+
+@register_op()
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    l, r, t, b = (padding if isinstance(padding, (list, tuple))
+                  else (padding,) * 4)
+    if data_format == "NCHW":
+        widths = [(0, 0), (0, 0), (t, b), (l, r)]
+    else:
+        widths = [(0, 0), (t, b), (l, r), (0, 0)]
+    return jnp.pad(x, widths)
+
+
+# -- pooling ----------------------------------------------------------------
+
+@register_op()
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    p = float(norm_type)
+    from .conv import avg_pool1d
+    k = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    s = avg_pool1d.__wrapped__(jnp.abs(x) ** p, kernel_size, stride, padding,
+                               ceil_mode=ceil_mode)
+    return (s * k) ** (1.0 / p)
+
+
+@register_op()
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    p = float(norm_type)
+    from .conv import avg_pool2d
+    k = (kernel_size if isinstance(kernel_size, int)
+         else int(np.prod(kernel_size)))
+    k2 = k * k if isinstance(kernel_size, int) else k
+    s = avg_pool2d.__wrapped__(jnp.abs(x) ** p, kernel_size, stride, padding,
+                               ceil_mode=ceil_mode)
+    return (s * k2) ** (1.0 / p)
+
+
+def _unpool(x, indices, spatial, kernel_size, stride, padding, output_size):
+    """Scatter pooled values back to pre-pool positions. indices are
+    flat positions within each spatial plane (the reference's
+    max_poolNd(return_mask=True) contract)."""
+    n, c = x.shape[0], x.shape[1]
+    in_sp = x.shape[2:]
+    if output_size is None:
+        k = ((kernel_size,) * spatial if isinstance(kernel_size, int)
+             else tuple(kernel_size))
+        st = (k if stride is None else
+              ((stride,) * spatial if isinstance(stride, int)
+               else tuple(stride)))
+        pa = ((padding,) * spatial if isinstance(padding, int)
+              else tuple(padding))
+        output_size = tuple(
+            (in_sp[i] - 1) * st[i] - 2 * pa[i] + k[i]
+            for i in range(spatial))
+    else:
+        output_size = tuple(output_size)[-spatial:]
+    plane = int(np.prod(output_size))
+    flat = jnp.zeros((n, c, plane), x.dtype)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    vals = x.reshape(n, c, -1)
+    flat = jax.vmap(jax.vmap(
+        lambda f, i, v: f.at[i].set(v)))(flat, idx, vals)
+    return flat.reshape((n, c) + output_size)
+
+
+@register_op()
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, 1, kernel_size, stride, padding, output_size)
+
+
+@register_op()
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, 2, kernel_size, stride, padding, output_size)
+
+
+@register_op()
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, 3, kernel_size, stride, padding, output_size)
+
+
+def _adaptive_pool_nd(x, output_size, spatial, reducer):
+    sp = x.shape[-spatial:]
+    out = (output_size if isinstance(output_size, (tuple, list))
+           else (output_size,) * spatial)
+    out = tuple(o if o is not None else sp[i] for i, o in enumerate(out))
+    v = x
+    for d in range(spatial):
+        axis = x.ndim - spatial + d
+        n_out, n_in = out[d], sp[d]
+        starts = (np.arange(n_out) * n_in) // n_out
+        ends = ((np.arange(n_out) + 1) * n_in + n_out - 1) // n_out
+        segs = [reducer(lax.slice_in_dim(v, int(s), int(e), axis=axis),
+                        axis=axis, keepdims=True)
+                for s, e in zip(starts, ends)]
+        v = jnp.concatenate(segs, axis=axis)
+    return v
+
+
+@register_op()
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd(x, output_size, 3, jnp.mean)
+
+
+@register_op()
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(x, output_size, 1, jnp.max)
+    if return_mask:
+        # recover argmax positions per output bin
+        n_out = output_size if isinstance(output_size, int) else output_size[0]
+        n_in = x.shape[-1]
+        idxs = []
+        for i in range(n_out):
+            s, e = (i * n_in) // n_out, ((i + 1) * n_in + n_out - 1) // n_out
+            idxs.append(jnp.argmax(x[..., s:e], axis=-1) + s)
+        return out, jnp.stack(idxs, axis=-1)
+    return out
+
+
+@register_op()
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd(x, output_size, 3, jnp.max)
+    if not return_mask:
+        return out
+    # flat index (within D*H*W) of each bin's max, loop over static bins
+    sp = x.shape[-3:]
+    o = (output_size if isinstance(output_size, (tuple, list))
+         else (output_size,) * 3)
+    idxs = jnp.zeros(x.shape[:-3] + tuple(o), jnp.int32)
+    for a in range(o[0]):
+        d0, d1 = (a * sp[0]) // o[0], ((a + 1) * sp[0] + o[0] - 1) // o[0]
+        for b in range(o[1]):
+            h0, h1 = (b * sp[1]) // o[1], ((b + 1) * sp[1] + o[1] - 1) // o[1]
+            for c in range(o[2]):
+                w0 = (c * sp[2]) // o[2]
+                w1 = ((c + 1) * sp[2] + o[2] - 1) // o[2]
+                blk = x[..., d0:d1, h0:h1, w0:w1]
+                flat = blk.reshape(blk.shape[:-3] + (-1,))
+                am = jnp.argmax(flat, axis=-1)
+                bd, bh = h1 - h0, w1 - w0
+                dd = am // (bd * bh) + d0
+                hh = (am // bh) % bd + h0
+                ww = am % bh + w0
+                idxs = idxs.at[..., a, b, c].set(
+                    (dd * sp[1] + hh) * sp[2] + ww)
+    return out, idxs
+
+
+def _fractional_starts(n_in, n_out, k, u):
+    """Fractional pooling window starts (Graham 2014): pseudo-random
+    offsets from a single uniform u in (0,1)."""
+    alpha = (n_in - k) / max(n_out - 1, 1)
+    starts = np.floor(alpha * (np.arange(n_out) + u)).astype(np.int64)
+    starts = np.clip(starts, 0, n_in - k)
+    starts[0] = 0
+    return starts
+
+
+@register_op()
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    out = (output_size if isinstance(output_size, (tuple, list))
+           else (output_size,) * 2)
+    H, W = x.shape[-2:]
+    k = (kernel_size if kernel_size is not None
+         else (H // out[0], W // out[1]))
+    k = (k if isinstance(k, (tuple, list)) else (k, k))
+    u = float(random_u) if random_u is not None else 0.5
+    hs = _fractional_starts(H, out[0], k[0], u)
+    ws = _fractional_starts(W, out[1], k[1], u)
+    cols, icols = [], []
+    for i in hs:
+        row, irow = [], []
+        for j in ws:
+            blk = x[..., i:i + k[0], j:j + k[1]]
+            row.append(jnp.max(blk, axis=(-2, -1)))
+            flat = blk.reshape(blk.shape[:-2] + (-1,))
+            am = jnp.argmax(flat, axis=-1)
+            irow.append(((am // k[1]) + i) * W + (am % k[1]) + j)
+        cols.append(jnp.stack(row, axis=-1))
+        icols.append(jnp.stack(irow, axis=-1))
+    pooled = jnp.stack(cols, axis=-2)
+    if return_mask:
+        return pooled, jnp.stack(icols, axis=-2).astype(jnp.int32)
+    return pooled
+
+
+@register_op()
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    out = (output_size if isinstance(output_size, (tuple, list))
+           else (output_size,) * 3)
+    D, H, W = x.shape[-3:]
+    k = (kernel_size if kernel_size is not None
+         else (max(D // out[0], 1), max(H // out[1], 1), max(W // out[2], 1)))
+    k = (k if isinstance(k, (tuple, list)) else (k, k, k))
+    u = float(random_u) if random_u is not None else 0.5
+    ds = _fractional_starts(D, out[0], k[0], u)
+    hs = _fractional_starts(H, out[1], k[1], u)
+    ws = _fractional_starts(W, out[2], k[2], u)
+    planes, iplanes = [], []
+    for d in ds:
+        cols, icols = [], []
+        for i in hs:
+            row, irow = [], []
+            for j in ws:
+                blk = x[..., d:d + k[0], i:i + k[1], j:j + k[2]]
+                row.append(jnp.max(blk, axis=(-3, -2, -1)))
+                flat = blk.reshape(blk.shape[:-3] + (-1,))
+                am = jnp.argmax(flat, axis=-1)
+                dd = am // (k[1] * k[2]) + d
+                hh = (am // k[2]) % k[1] + i
+                ww = am % k[2] + j
+                irow.append((dd * H + hh) * W + ww)
+            cols.append(jnp.stack(row, axis=-1))
+            icols.append(jnp.stack(irow, axis=-1))
+        planes.append(jnp.stack(cols, axis=-2))
+        iplanes.append(jnp.stack(icols, axis=-2))
+    pooled = jnp.stack(planes, axis=-3)
+    if return_mask:
+        return pooled, jnp.stack(iplanes, axis=-3).astype(jnp.int32)
+    return pooled
+
+
+# -- losses -----------------------------------------------------------------
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+@register_op()
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    lbl = jax.nn.one_hot(label[..., 0], input.shape[-1], dtype=input.dtype) \
+        if label.shape[-1] == 1 else label.astype(input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inter = jnp.sum(input * lbl, axis=reduce_dims)
+    union = jnp.sum(input, axis=reduce_dims) + jnp.sum(lbl, axis=reduce_dims)
+    return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+
+@register_op()
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return (-label * jnp.log(input + epsilon)
+            - (1 - label) * jnp.log(1 - input + epsilon))
+
+
+@register_op()
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    loss = -(label * jax.nn.log_sigmoid(input)
+             + (1 - label) * jax.nn.log_sigmoid(-input))
+    if weight is not None:
+        loss = loss * weight
+    loss = loss.mean(axis=-1)
+    return _reduce(loss, reduction)
+
+
+@register_op()
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    if log_input:
+        loss = jnp.exp(input) - label * input
+    else:
+        loss = input - label * jnp.log(input + epsilon)
+    if full:
+        stirling = (label * jnp.log(label + epsilon) - label
+                    + 0.5 * jnp.log(2 * _pymath.pi * (label + epsilon)))
+        loss = loss + jnp.where(label > 1, stirling, 0.0)
+    return _reduce(loss, reduction)
+
+
+@register_op()
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """reference loss.py npair_loss (improved triplet)."""
+    reg = l2_reg * ((anchor * anchor).sum(-1).mean()
+                    + (positive * positive).sum(-1).mean()) * 0.25
+    sim = anchor @ positive.T
+    lbl = labels.reshape(-1)
+    tgt = (lbl[:, None] == lbl[None, :]).astype(sim.dtype)
+    tgt = tgt / jnp.maximum(tgt.sum(-1, keepdims=True), 1e-12)
+    logp = jax.nn.log_softmax(sim, axis=-1)
+    ce = -(tgt * logp).sum(-1).mean()
+    return ce + reg
+
+
+@register_op()
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    return _reduce(jnp.log1p(jnp.exp(-label * input)), reduction)
+
+
+@register_op()
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    n, c = input.shape
+    correct = jnp.take_along_axis(input, label[:, None], axis=1)
+    diff = jnp.maximum(margin - correct + input, 0.0) ** p
+    if weight is not None:
+        diff = diff * jnp.take(weight, label)[:, None]
+    mask = jax.nn.one_hot(label, c, dtype=input.dtype)
+    loss = (diff * (1 - mask)).sum(-1) / c
+    return _reduce(loss, reduction)
+
+
+@register_op()
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (
+        lambda a, b: jnp.linalg.norm(a - b, axis=-1))
+    dp = dist(input, positive)
+    dn = dist(input, negative)
+    if swap:
+        dn = jnp.minimum(dn, dist(positive, negative))
+    return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+
+@register_op()
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    var = jnp.maximum(variance, epsilon)
+    loss = 0.5 * (jnp.log(var) + (input - label) ** 2 / var)
+    if full:
+        loss = loss + 0.5 * _pymath.log(2 * _pymath.pi)
+    return _reduce(loss, reduction)
+
+
+@register_op()
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid (reference loss.py hsigmoid_loss over phi
+    hsigmoid_loss kernel). Default tree: complete binary (Huffman-free)
+    coding of num_classes leaves, depth ceil(log2(C)); custom trees via
+    path_table/path_code."""
+    if path_table is None:
+        # default tree: right-leaning chain with exactly C-1 internal
+        # nodes (the weight's row count in the reference's default
+        # mode). Class c < C-1 exits chain node c with code 1; the last
+        # class descends the whole chain with all-0 codes.
+        C = num_classes
+        depth = C - 1
+        nodes = np.tile(np.arange(depth, dtype=np.int64), (C, 1))
+        codes = np.zeros((C, depth), np.int64)
+        mask = np.zeros((C, depth), np.float32)
+        for c in range(C):
+            plen = min(c + 1, depth)
+            mask[c, :plen] = 1.0
+            if c < C - 1:
+                codes[c, c] = 1
+        path_table = jnp.asarray(nodes)
+        path_code = jnp.asarray(codes)
+        path_mask = jnp.asarray(mask)
+    else:
+        path_mask = (path_table >= 0).astype(input.dtype)
+        path_table = jnp.maximum(path_table, 0)
+    pt = path_table[label]           # [N, D] node ids
+    pc = path_code[label].astype(input.dtype)
+    pm = path_mask[label].astype(input.dtype)
+    w = weight[pt]                   # [N, D, F]
+    logits = jnp.einsum("ndf,nf->nd", w, input)
+    if bias is not None:
+        logits = logits + bias.reshape(-1)[pt]
+    # sigmoid CE against the path code at every internal node on the path
+    loss = -(pc * jax.nn.log_sigmoid(logits)
+             + (1 - pc) * jax.nn.log_sigmoid(-logits)) * pm
+    return loss.sum(-1, keepdims=True)
+
+
+@register_op()
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace-family margin softmax (reference loss.py
+    margin_cross_entropy over phi margin_cross_entropy kernel; the
+    class-parallel variant shards logits over the tp group — here the
+    single-shard math, sharding comes from GSPMD layouts)."""
+    cos = jnp.clip(logits, -1.0, 1.0)
+    theta = jnp.arccos(cos)
+    onehot = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    target = jnp.cos(margin1 * theta + margin2) - margin3
+    adj = jnp.where(onehot > 0, target, cos) * scale
+    logp = jax.nn.log_softmax(adj, axis=-1)
+    loss = -(onehot * logp).sum(-1)
+    loss = _reduce(loss, reduction)
+    if return_softmax:
+        return loss, jnp.exp(logp)
+    return loss
+
+
+@register_op()
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-Transducer loss (reference loss.py rnnt_loss over the
+    warprnnt kernel). Forward-variable DP over the (T, U) lattice as a
+    lax.scan over T rows (each row a scan over U) — O(T*U) sequential
+    but fully differentiable through XLA; the kernel-free TPU shape.
+
+    input: [B, T, U+1, V] log-probs (unnormalized ok - log_softmax here).
+    """
+    logp = jax.nn.log_softmax(input, axis=-1)
+    B, T, U1, V = logp.shape
+
+    def one(lp, lab, t_len, u_len):
+        # lp [T, U+1, V]; lab [U]
+        blank_lp = lp[..., blank]                      # [T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :-1, :], lab[None, :, None], axis=-1)[..., 0]  # [T, U]
+        neg = jnp.asarray(-1e30, lp.dtype)
+
+        def row(alpha_prev, t):
+            # alpha_prev [U+1] = alpha[t-1, :]
+            from_top = alpha_prev + blank_lp[t - 1]
+
+            def cell(carry, u):
+                left = jnp.where(u > 0, carry + lab_lp[t, u - 1], neg)
+                top = from_top[u]
+                a = jnp.where(t > 0, jnp.logaddexp(
+                    jnp.where(u > 0, left, neg), top), left)
+                a = jnp.where((t == 0) & (u == 0), 0.0, a)
+                return a, a
+
+            _, alpha_t = lax.scan(cell, neg, jnp.arange(U1))
+            return alpha_t, alpha_t
+
+        # t=0 row: only emissions move u
+        def cell0(carry, u):
+            a = jnp.where(u == 0, 0.0, carry + lab_lp[0, u - 1])
+            return a, a
+
+        _, alpha0 = lax.scan(cell0, jnp.asarray(0.0, lp.dtype),
+                             jnp.arange(U1))
+
+        def body(alpha_prev, t):
+            return row(alpha_prev, t)
+
+        _, rows = lax.scan(body, alpha0, jnp.arange(1, T))
+        alphas = jnp.concatenate([alpha0[None], rows], axis=0)  # [T, U+1]
+        ll = alphas[t_len - 1, u_len] + blank_lp[t_len - 1, u_len]
+        return -ll
+
+    losses = jax.vmap(one)(logp, label, input_lengths, label_lengths)
+    return _reduce(losses, reduction)
+
+
+@register_op()
+def adaptive_log_softmax_with_loss(input, label, head_weight, tail_projs,
+                                   tail_ws, cutoffs, head_bias=None,
+                                   name=None):
+    """Adaptive softmax (reference loss.py adaptive_log_softmax_with_loss;
+    Grave et al.): frequent classes in the head, rare classes in
+    down-projected tail clusters. tail_projs/tail_ws are FLAT lists (one
+    entry per cluster) — the op registry unwraps one container level."""
+    n_clusters = len(tail_projs)
+    cuts = [0] + list(cutoffs)
+    head = input @ head_weight
+    if head_bias is not None:
+        head = head + head_bias
+    head_logp = jax.nn.log_softmax(head, axis=-1)
+    out = jnp.zeros(label.shape, input.dtype)
+    # head tokens
+    in_head = label < cuts[1]
+    safe_head = jnp.clip(label, 0, cuts[1] - 1)
+    head_val = jnp.take_along_axis(head_logp, safe_head[..., None],
+                                   axis=-1)[..., 0]
+    out = jnp.where(in_head, head_val, out)
+    for ci in range(n_clusters):
+        lo, hi = cuts[ci + 1], cuts[ci + 2]
+        in_c = (label >= lo) & (label < hi)
+        tail_logits = (input @ tail_projs[ci]) @ tail_ws[ci]
+        tail_logp = jax.nn.log_softmax(tail_logits, axis=-1)
+        rel = jnp.clip(label - lo, 0, hi - lo - 1)
+        val = (head_logp[..., cuts[1] + ci]
+               + jnp.take_along_axis(tail_logp, rel[..., None],
+                                     axis=-1)[..., 0])
+        out = jnp.where(in_c, val, out)
+    loss = -out.mean()
+    return out, loss
+
+
+# -- spatial transforms -----------------------------------------------------
+
+@register_op()
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """reference vision.py affine_grid: theta [N, 2, 3] -> grid
+    [N, H, W, 2] of normalized sample coords."""
+    N, _, H, W = (out_shape[0], out_shape[1], out_shape[2], out_shape[3])
+
+    def axis_coords(n):
+        if align_corners:
+            return jnp.linspace(-1.0, 1.0, n)
+        return (jnp.arange(n) * 2 + 1) / n - 1.0
+
+    ys = axis_coords(H)
+    xs = axis_coords(W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    base = jnp.stack([gx, gy, jnp.ones_like(gx)], axis=-1)   # [H, W, 3]
+    grid = jnp.einsum("hwk,nck->nhwc", base, theta)           # [N,H,W,2]
+    return grid
+
+
+@register_op()
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """reference vision.py grid_sample (bilinear/nearest, zeros/border)."""
+    N, C, H, W = x.shape
+    gx, gy = grid[..., 0], grid[..., 1]
+    if align_corners:
+        fx = (gx + 1) * (W - 1) / 2
+        fy = (gy + 1) * (H - 1) / 2
+    else:
+        fx = ((gx + 1) * W - 1) / 2
+        fy = ((gy + 1) * H - 1) / 2
+
+    def sample_one(img, yy, xx):
+        if mode == "nearest":
+            xi = jnp.clip(jnp.round(xx), 0, W - 1).astype(jnp.int32)
+            yi = jnp.clip(jnp.round(yy), 0, H - 1).astype(jnp.int32)
+            out = img[:, yi, xi]
+            if padding_mode == "zeros":
+                inb = ((xx > -0.5) & (xx < W - 0.5)
+                       & (yy > -0.5) & (yy < H - 0.5))
+                out = out * inb.astype(img.dtype)
+            return out
+        x0 = jnp.floor(xx)
+        y0 = jnp.floor(yy)
+        lx, ly = xx - x0, yy - y0
+        vals = 0.0
+        for dy, wy in ((0, 1 - ly), (1, ly)):
+            for dx, wx in ((0, 1 - lx), (1, lx)):
+                xi = x0 + dx
+                yi = y0 + dy
+                if padding_mode == "border":
+                    ok = jnp.ones_like(xi, bool)
+                else:
+                    ok = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+                xi = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                yi = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                v = img[:, yi, xi] * (wy * wx * ok.astype(img.dtype))
+                vals = vals + v
+        return vals
+
+    return jax.vmap(sample_one)(x, fy, fx)
+
+
+@register_op()
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im, inverse of unfold (reference common.py fold over phi fold
+    kernel): x [N, C*kh*kw, L] -> [N, C, H, W] with overlap-add."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    oh, ow = _pair(output_sizes)
+    kh, kw = _pair(kernel_sizes)
+    sh, sw = _pair(strides)
+    ph, pw = _pair(paddings)
+    dh, dw = _pair(dilations)
+    N, CKK, L = x.shape
+    C = CKK // (kh * kw)
+    nh = (oh + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+    nw = (ow + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+    cols = x.reshape(N, C, kh, kw, nh, nw)
+    out = jnp.zeros((N, C, oh + 2 * ph, ow + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            ys = i * dh
+            xs = j * dw
+            patch = cols[:, :, i, j]                      # [N, C, nh, nw]
+            out = out.at[:, :, ys:ys + nh * sh:sh,
+                         xs:xs + nw * sw:sw].add(patch)
+    return out[:, :, ph:ph + oh, pw:pw + ow]
+
+
+# -- attention variants -----------------------------------------------------
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """reference sparse_attention.py: CSR-patterned attention. Delegates
+    to the segment-softmax kernel in sparse.nn (never materializes the
+    [T, T] score matrix)."""
+    from ...sparse import sparse_csr_tensor
+    from ...sparse.nn import attention as _attn
+    q = query.data if isinstance(query, Tensor) else jnp.asarray(query)
+    B, H, T, D = q.shape
+    off = (sparse_csr_offset.data
+           if isinstance(sparse_csr_offset, Tensor)
+           else jnp.asarray(sparse_csr_offset))
+    col = (sparse_csr_columns.data
+           if isinstance(sparse_csr_columns, Tensor)
+           else jnp.asarray(sparse_csr_columns))
+    nnz_per = np.asarray(off)[..., -1]
+
+    class _SP:
+        indptr = np.asarray(off).reshape(B * H, T + 1)
+        indices = np.asarray(col).reshape(B * H, -1)
+    class _Mask:
+        _sp = _SP()
+    return _attn(query, key, value, _Mask(),
+                 key_padding_mask=key_padding_mask, attn_mask=attn_mask)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        dropout=0.0, causal=False, name=None):
+    """reference flashmask_attention (FlashMask sparse-mask flash
+    kernel): here the mask lowers to the flash kernel's causal path or
+    a dense additive mask — XLA fuses it; the Pallas splash kernel takes
+    the causal fast path."""
+    from .common import flash_attention
+    if startend_row_indices is None:
+        return flash_attention(query, key, value, dropout=dropout,
+                               causal=causal)
+    # general flashmask: build the additive mask once (host metadata)
+    from .common import scaled_dot_product_attention
+    q = query.data if isinstance(query, Tensor) else jnp.asarray(query)
+    T = q.shape[1]
+    idx = np.asarray(startend_row_indices.data
+                     if isinstance(startend_row_indices, Tensor)
+                     else startend_row_indices)
+    # idx [B, H, T, 1]: rows >= idx are masked out per column (LTS mask)
+    rows = np.arange(T)[:, None]
+    mask = rows < idx.reshape(idx.shape[0], idx.shape[1], 1, T)
+    if causal:
+        mask &= (rows >= np.arange(T)[None, :])
+    bias = jnp.where(jnp.asarray(mask), 0.0, -1e30).astype(q.dtype)
+    return scaled_dot_product_attention(query, key, value,
+                                        attn_mask=Tensor(bias),
+                                        dropout_p=dropout)
+
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False, return_softmax=False,
+                         name=None):
+    """reference flash_attn_qkvpacked: qkv [B, T, 3, H, D] packed."""
+    from .common import flash_attention
+    d = qkv.data if isinstance(qkv, Tensor) else jnp.asarray(qkv)
+    q, k, v = (Tensor(d[:, :, i]) for i in range(3))
+    out = flash_attention(q, k, v, dropout=dropout, causal=causal,
+                          return_softmax=return_softmax)
+    return out
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens_q, cu_seqlens_k,
+                                max_seqlen_q, max_seqlen_k, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, name=None):
+    """Varlen packed attention: segments defined by cu_seqlens run
+    attention independently. TPU shape: one padded batch per segment
+    (static shapes beat ragged kernels under XLA)."""
+    from .common import flash_attention
+    d = qkv.data if isinstance(qkv, Tensor) else jnp.asarray(qkv)
+    cu = np.asarray(cu_seqlens_q.data if isinstance(cu_seqlens_q, Tensor)
+                    else cu_seqlens_q)
+    outs = []
+    for i in range(len(cu) - 1):
+        seg = d[:, cu[i]:cu[i + 1]]
+        q, k, v = (Tensor(seg[:, :, j]) for j in range(3))
+        o = flash_attention(q, k, v, dropout=dropout, causal=causal)
+        outs.append(o.data if isinstance(o, tuple) is False else o[0].data)
+    return Tensor(jnp.concatenate(outs, axis=1))
+
+
+@register_op(differentiable=False)
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers for PartialFC-style training
+    (reference common.py class_center_sample): keep all positive
+    classes + uniform negatives, remap labels."""
+    from ...core.generator import next_key
+    pos = jnp.unique(label, size=min(num_classes, label.shape[0] * 2),
+                     fill_value=num_classes)
+    pos = pos[pos < num_classes]
+    n_neg = max(num_samples - pos.shape[0], 0)
+    perm = jax.random.permutation(next_key(), num_classes)
+    mask = jnp.isin(perm, pos, invert=True)
+    # stable selection of negatives not already positive
+    neg = perm[jnp.argsort(~mask)][:n_neg]
+    sampled = jnp.concatenate([pos, neg])[:num_samples]
+    remap = jnp.full((num_classes,), -1, jnp.int32)
+    remap = remap.at[sampled].set(jnp.arange(sampled.shape[0],
+                                             dtype=jnp.int32))
+    return remap[label], sampled

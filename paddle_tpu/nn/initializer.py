@@ -182,3 +182,33 @@ def calculate_gain(nonlinearity, param=None):
              "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
              "selu": 3.0 / 4.0}
     return gains[nonlinearity]
+
+
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel init for transposed convs (reference
+    nn/initializer/Bilinear over phi bilinear_init)."""
+
+    def __call__(self, shape, dtype):
+        import numpy as np
+        w = np.zeros(shape, np.float32)
+        if len(shape) != 4:
+            raise ValueError("Bilinear expects a 4-D conv weight")
+        k = shape[-1]
+        f = int(np.ceil(k / 2.0))
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(np.prod(shape)):
+            x = i % k
+            y = (i // k) % shape[-2]
+            out = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+            w.flat[i] = out
+        return jnp.asarray(w, dtypes.to_jax_dtype(dtype))
+
+
+_GLOBAL_INIT = {"weight": None, "bias": None}
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Process-wide default initializers picked up by make_parameter
+    (reference nn/initializer/set_global_initializer)."""
+    _GLOBAL_INIT["weight"] = weight_init
+    _GLOBAL_INIT["bias"] = bias_init

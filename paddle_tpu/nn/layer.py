@@ -29,6 +29,8 @@ def make_parameter(shape, dtype, attr=None, is_bias: bool = False,
     if attr is not None and getattr(attr, "initializer", None) is not None:
         init = attr.initializer
     if init is None:
+        init = I._GLOBAL_INIT["bias" if is_bias else "weight"]
+    if init is None:
         init = I.Constant(0.0) if is_bias else I.XavierNormal()
     p = Parameter(init(shape, dtype), name=name)
     if attr is not None and getattr(attr, "trainable", True) is False:

@@ -1,0 +1,462 @@
+"""Long-tail layers completing paddle.nn.
+
+Reference: python/paddle/nn/layer/{activation,common,pooling,loss,rnn,
+container,norm}.py — the __all__ entries the core layer modules don't
+cover. Thin Layer wrappers over nn.functional (same pattern as the
+reference's layer/functional split).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .layer import Layer, Sequential
+from ..core.tensor import Tensor
+from . import functional as F
+from . import initializer as I
+
+__all__ = [
+    "SpectralNorm", "UpsamplingNearest2D", "UpsamplingBilinear2D",
+    "FeatureAlphaDropout", "Unfold", "Fold", "BiRNN", "PairwiseDistance",
+    "AdaptiveAvgPool3D", "AdaptiveMaxPool3D", "AdaptiveMaxPool1D",
+    "PoissonNLLLoss", "Softmax2D", "Silu", "RNNTLoss", "ThresholdedReLU",
+    "HSigmoidLoss", "PixelUnshuffle", "ChannelShuffle", "LayerDict",
+    "ZeroPad1D", "ZeroPad2D", "ZeroPad3D", "MaxUnPool1D", "MaxUnPool2D",
+    "MaxUnPool3D", "MultiLabelSoftMarginLoss", "HingeEmbeddingLoss",
+    "CosineEmbeddingLoss", "RReLU", "MultiMarginLoss",
+    "TripletMarginWithDistanceLoss", "TripletMarginLoss", "SoftMarginLoss",
+    "GaussianNLLLoss", "AdaptiveLogSoftmaxWithLoss", "Unflatten",
+    "FractionalMaxPool2D", "FractionalMaxPool3D", "LPPool1D", "LPPool2D",
+    "BeamSearchDecoder", "dynamic_decode",
+]
+
+
+class _Wrap(Layer):
+    """Layer holding constructor kwargs, forwarding to one functional."""
+
+    _fn = None
+    _argnames = ()
+
+    def __init__(self, *args, **kwargs):
+        super().__init__()
+        kwargs.pop("name", None)
+        self._kw = dict(zip(self._argnames, args))
+        self._kw.update(kwargs)
+
+    def forward(self, *inputs):
+        return type(self)._fn(*inputs, **self._kw)
+
+    def extra_repr(self):
+        return ", ".join(f"{k}={v}" for k, v in self._kw.items())
+
+
+def _wrap(fn, name, argnames=()):
+    cls = type(name, (_Wrap,), {"_fn": staticmethod(fn),
+                                "_argnames": argnames})
+    cls.__doc__ = f"Layer wrapper over nn.functional.{fn.__name__}."
+    return cls
+
+
+PairwiseDistance = _wrap(F.pairwise_distance, "PairwiseDistance",
+                         ("p", "epsilon", "keepdim"))
+ThresholdedReLU = _wrap(F.thresholded_relu, "ThresholdedReLU",
+                        ("threshold", "value"))
+FeatureAlphaDropout = _wrap(F.feature_alpha_dropout, "FeatureAlphaDropout",
+                            ("p",))
+ZeroPad2D = _wrap(F.zeropad2d, "ZeroPad2D", ("padding", "data_format"))
+LPPool1D = _wrap(F.lp_pool1d, "LPPool1D",
+                 ("norm_type", "kernel_size", "stride", "padding"))
+LPPool2D = _wrap(F.lp_pool2d, "LPPool2D",
+                 ("norm_type", "kernel_size", "stride", "padding"))
+MaxUnPool1D = _wrap(F.max_unpool1d, "MaxUnPool1D",
+                    ("kernel_size", "stride", "padding"))
+MaxUnPool2D = _wrap(F.max_unpool2d, "MaxUnPool2D",
+                    ("kernel_size", "stride", "padding"))
+MaxUnPool3D = _wrap(F.max_unpool3d, "MaxUnPool3D",
+                    ("kernel_size", "stride", "padding"))
+AdaptiveAvgPool3D = _wrap(F.adaptive_avg_pool3d, "AdaptiveAvgPool3D",
+                          ("output_size",))
+AdaptiveMaxPool1D = _wrap(F.adaptive_max_pool1d, "AdaptiveMaxPool1D",
+                          ("output_size", "return_mask"))
+AdaptiveMaxPool3D = _wrap(F.adaptive_max_pool3d, "AdaptiveMaxPool3D",
+                          ("output_size", "return_mask"))
+FractionalMaxPool2D = _wrap(F.fractional_max_pool2d, "FractionalMaxPool2D",
+                            ("output_size", "kernel_size", "random_u"))
+FractionalMaxPool3D = _wrap(F.fractional_max_pool3d, "FractionalMaxPool3D",
+                            ("output_size", "kernel_size", "random_u"))
+PoissonNLLLoss = _wrap(F.poisson_nll_loss, "PoissonNLLLoss",
+                       ("log_input", "full", "epsilon", "reduction"))
+MultiLabelSoftMarginLoss = _wrap(F.multi_label_soft_margin_loss,
+                                 "MultiLabelSoftMarginLoss",
+                                 ("weight", "reduction"))
+HingeEmbeddingLoss = _wrap(F.hinge_embedding_loss, "HingeEmbeddingLoss",
+                           ("margin", "reduction"))
+CosineEmbeddingLoss = _wrap(F.cosine_embedding_loss, "CosineEmbeddingLoss",
+                            ("margin", "reduction"))
+MultiMarginLoss = _wrap(F.multi_margin_loss, "MultiMarginLoss",
+                        ("p", "margin", "weight", "reduction"))
+TripletMarginLoss = _wrap(F.triplet_margin_loss, "TripletMarginLoss",
+                          ("margin", "p", "epsilon", "swap", "reduction"))
+TripletMarginWithDistanceLoss = _wrap(
+    F.triplet_margin_with_distance_loss, "TripletMarginWithDistanceLoss",
+    ("distance_function", "margin", "swap", "reduction"))
+SoftMarginLoss = _wrap(F.soft_margin_loss, "SoftMarginLoss", ("reduction",))
+GaussianNLLLoss = _wrap(F.gaussian_nll_loss, "GaussianNLLLoss",
+                        ("full", "epsilon", "reduction"))
+RNNTLoss = _wrap(F.rnnt_loss, "RNNTLoss",
+                 ("blank", "fastemit_lambda", "reduction"))
+
+
+class ZeroPad1D(Layer):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__()
+        self.padding = (padding if isinstance(padding, (list, tuple))
+                        else (padding, padding))
+
+    def forward(self, x):
+        l, r = self.padding
+        return Tensor(jnp.pad(x.data, [(0, 0), (0, 0), (l, r)]))
+
+
+class ZeroPad3D(Layer):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__()
+        p = (padding if isinstance(padding, (list, tuple))
+             else (padding,) * 6)
+        self.padding = p
+
+    def forward(self, x):
+        l, r, t, b, f, bk = self.padding
+        return Tensor(jnp.pad(x.data,
+                              [(0, 0), (0, 0), (f, bk), (t, b), (l, r)]))
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (reference activation.py)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Silu(Layer):
+    def forward(self, x):
+        return F.silu(x)
+
+
+class RReLU(Layer):
+    """Randomized leaky ReLU (reference activation.py RReLU): slope ~
+    U[lower, upper] in training, fixed mean slope in eval."""
+
+    def __init__(self, lower=1. / 8, upper=1. / 3, name=None):
+        super().__init__()
+        self.lower = lower
+        self.upper = upper
+
+    def forward(self, x):
+        d = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+        if self.training:
+            from ..core.generator import next_key
+            slope = jax.random.uniform(next_key(), d.shape,
+                                       minval=self.lower, maxval=self.upper)
+        else:
+            slope = (self.lower + self.upper) / 2.0
+        return Tensor(jnp.where(d >= 0, d, d * slope))
+
+
+class PixelUnshuffle(Layer):
+    """Inverse of PixelShuffle (reference vision.py PixelUnshuffle)."""
+
+    def __init__(self, downscale_factor, data_format="NCHW", name=None):
+        super().__init__()
+        self.r = downscale_factor
+
+    def forward(self, x):
+        d = x.data
+        n, c, h, w = d.shape
+        r = self.r
+        d = d.reshape(n, c, h // r, r, w // r, r)
+        d = d.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r,
+                                                  w // r)
+        return Tensor(d)
+
+
+class ChannelShuffle(Layer):
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+
+    def forward(self, x):
+        from ..models.shufflenetv2 import channel_shuffle
+        return channel_shuffle(x, self.groups)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = shape
+
+    def forward(self, x):
+        from ..ops.extras import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._a = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        from ..ops.manipulation import unfold
+        return unfold(x, *self._a)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._a = (output_sizes, kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._a)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size = size
+        self.scale_factor = scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor, mode="nearest")
+
+
+class UpsamplingBilinear2D(UpsamplingNearest2D):
+    def forward(self, x):
+        return F.interpolate(x, size=self.size,
+                             scale_factor=self.scale_factor,
+                             mode="bilinear", align_corners=True)
+
+
+class LayerDict(Layer):
+    """Ordered dict of sublayers (reference container.py LayerDict)."""
+
+    def __init__(self, sublayers=None):
+        super().__init__()
+        if sublayers:
+            self.update(sublayers)
+
+    def __getitem__(self, key):
+        return self._sub_layers[key]
+
+    def __setitem__(self, key, layer):
+        self.add_sublayer(key, layer)
+
+    def __delitem__(self, key):
+        del self._sub_layers[key]
+
+    def __len__(self):
+        return len(self._sub_layers)
+
+    def __iter__(self):
+        return iter(self._sub_layers)
+
+    def __contains__(self, key):
+        return key in self._sub_layers
+
+    def clear(self):
+        self._sub_layers.clear()
+
+    def pop(self, key):
+        l = self._sub_layers[key]
+        del self._sub_layers[key]
+        return l
+
+    def keys(self):
+        return self._sub_layers.keys()
+
+    def values(self):
+        return self._sub_layers.values()
+
+    def items(self):
+        return self._sub_layers.items()
+
+    def update(self, sublayers):
+        items = (sublayers.items() if hasattr(sublayers, "items")
+                 else sublayers)
+        for k, v in items:
+            self.add_sublayer(k, v)
+
+
+class SpectralNorm(Layer):
+    """Spectral normalization of a weight (reference norm.py
+    SpectralNorm): largest singular value estimated by power iteration;
+    forward returns weight / sigma."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = epsilon
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        from ..core.generator import next_key
+        self.register_buffer("weight_u", Tensor(
+            jax.random.normal(next_key(), (h,), jnp.float32)))
+        self.register_buffer("weight_v", Tensor(
+            jax.random.normal(next_key(), (w,), jnp.float32)))
+
+    def forward(self, weight):
+        d = weight.data if isinstance(weight, Tensor) else jnp.asarray(weight)
+        mat = jnp.moveaxis(d, self.dim, 0).reshape(d.shape[self.dim], -1)
+        u, v = self.weight_u.data, self.weight_v.data
+        for _ in range(self.power_iters):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + self.eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + self.eps)
+        self.weight_u._data, self.weight_v._data = u, v
+        sigma = u @ mat @ v
+        return Tensor(d / sigma)
+
+
+class BiRNN(Layer):
+    """Bidirectional RNN wrapper (reference rnn.py BiRNN): forward and
+    backward cells over the sequence, outputs concatenated."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        from .modules_rnn import RNN
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        st_fw, st_bw = (initial_states if initial_states is not None
+                        else (None, None))
+        out_fw, s_fw = self.rnn_fw(inputs, st_fw, sequence_length)
+        out_bw, s_bw = self.rnn_bw(inputs, st_bw, sequence_length)
+        from ..ops.manipulation import concat
+        return concat([out_fw, out_bw], axis=-1), (s_fw, s_bw)
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid loss layer (reference loss.py HSigmoidLoss):
+    owns the internal-node weight table."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            (num_classes - 1, feature_size), attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = (None if bias_attr is False else self.create_parameter(
+            (num_classes - 1,), attr=bias_attr, is_bias=True))
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax layer (reference loss.py
+    AdaptiveLogSoftmaxWithLoss): head + down-projected tail clusters."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.head_weight = self.create_parameter(
+            (in_features, self.cutoffs[0] + len(self.cutoffs) - 1))
+        self.head_bias = (self.create_parameter(
+            (self.cutoffs[0] + len(self.cutoffs) - 1,), is_bias=True)
+            if head_bias else None)
+        self._tails = []
+        for ci in range(len(self.cutoffs) - 1):
+            lo, hi = self.cutoffs[ci], self.cutoffs[ci + 1]
+            proj_dim = max(int(in_features / (div_value ** (ci + 1))), 1)
+            proj = self.create_parameter((in_features, proj_dim))
+            w = self.create_parameter((proj_dim, hi - lo))
+            self.add_parameter(f"tail_proj_{ci}", proj)
+            self.add_parameter(f"tail_w_{ci}", w)
+            self._tails.append((proj, w))
+
+    def forward(self, input, label):
+        projs = [p for p, _ in self._tails]
+        ws = [w for _, w in self._tails]
+        out, loss = F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, projs, ws, self.cutoffs,
+            self.head_bias)
+        return out, loss
+
+    def log_prob(self, input):
+        head = input @ self.head_weight
+        if self.head_bias is not None:
+            head = head + self.head_bias
+        head_logp = F.log_softmax(head, axis=-1)
+        hl = head_logp.data if isinstance(head_logp, Tensor) else head_logp
+        parts = [hl[..., :self.cutoffs[0]]]
+        for ci, (proj, w) in enumerate(self._tails):
+            tail_logp = F.log_softmax((input @ proj) @ w, axis=-1)
+            tl = (tail_logp.data if isinstance(tail_logp, Tensor)
+                  else tail_logp)
+            cluster_lp = hl[..., self.cutoffs[0] + ci]
+            parts.append(tl + cluster_lp[..., None])
+        return Tensor(jnp.concatenate(parts, axis=-1))
+
+    def predict(self, input):
+        lp = self.log_prob(input)
+        return Tensor(jnp.argmax(lp.data, axis=-1))
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding driver for RNN cells (reference
+    rnn.py BeamSearchDecoder + decode.py dynamic_decode). Greedy/beam
+    expansion on host orchestrating jitted cell steps — decoding is a
+    data-dependent loop, the per-step math stays compiled."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def step(self, inputs, states):
+        out, new_states = self.cell(inputs, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Greedy-path dynamic decode over a BeamSearchDecoder (beam width
+    collapses to the top hypothesis per step; full beam tracking rides
+    gather_tree)."""
+    import numpy as np
+    from ..ops.creation import full
+    tok = np.full((1,), decoder.start_token, np.int64)
+    states = inits
+    outputs = []
+    for _ in range(max_step_num):
+        emb = (decoder.embedding_fn(Tensor(jnp.asarray(tok)))
+               if decoder.embedding_fn else Tensor(
+                   jnp.asarray(tok, jnp.float32)[:, None]))
+        logits, states = decoder.step(emb, states)
+        nxt = int(np.asarray(jnp.argmax(logits.data, axis=-1)).ravel()[0])
+        outputs.append(nxt)
+        if nxt == decoder.end_token:
+            break
+        tok = np.full((1,), nxt, np.int64)
+    return Tensor(jnp.asarray(outputs, jnp.int64)), states

@@ -20,8 +20,124 @@ from .manipulation import *  # noqa: F401,F403
 from .comparison import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
-from . import creation, math, reduction, manipulation, comparison, linalg, random  # noqa: F401
+from . import (creation, math, reduction, manipulation, comparison, linalg,  # noqa: F401
+               random, extras)
+
+
+# -- inplace variants -------------------------------------------------------
+# The reference exposes an `op_` twin for most unary/binary tensor ops
+# (python/paddle/tensor/inplace_utils.py: generated from the same op defs
+# with an inplace version-bump). Functional arrays have no aliasing, so
+# "inplace" here = compute out-of-place, rebind the Tensor's storage AND
+# its tape node (gradients flow exactly as if the caller used the
+# returned value — the reference's inplace-autograd contract).
+_INPLACE_NAMES = [
+    "abs_", "acos_", "addmm_", "atan_", "bernoulli_", "bitwise_and_",
+    "bitwise_left_shift_", "bitwise_not_", "bitwise_or_",
+    "bitwise_right_shift_", "bitwise_xor_", "cast_", "copysign_", "cos_",
+    "cumprod_", "cumsum_", "digamma_", "divide_", "equal_", "erf_",
+    "expm1_", "flatten_", "floor_divide_", "floor_mod_", "frac_",
+    "gammainc_", "gammaincc_", "gammaln_", "gcd_", "greater_equal_",
+    "greater_than_", "hypot_", "i0_", "index_add_", "index_fill_",
+    "index_put_", "lcm_", "ldexp_", "less_equal_", "less_than_", "lgamma_",
+    "log10_", "log2_", "log_", "logical_and_", "logical_not_",
+    "logical_or_", "logit_", "masked_fill_", "masked_scatter_", "mod_",
+    "multigammaln_", "multiply_", "nan_to_num_", "neg_", "polygamma_",
+    "pow_", "remainder_", "renorm_", "reshape_", "scatter_", "sin_",
+    "sinc_", "sinh_", "square_", "squeeze_", "t_", "tan_", "tanh_",
+    "transpose_", "tril_", "triu_", "trunc_", "unsqueeze_", "where_",
+]
+
+
+def _make_inplace(base_name):
+    def fn(x, *args, **kwargs):
+        if not isinstance(x, Tensor):
+            return OPS[base_name].wrapper(x, *args, **kwargs)
+        # record the op against a detached proxy: if the tape captured x
+        # itself, rebinding x's node below would make the new node its
+        # own parent (self-loop) and backward would silently drop grads
+        x_in = Tensor(x._data, stop_gradient=x.stop_gradient)
+        x_in._node, x_in._out_index = x._node, x._out_index
+        out = OPS[base_name].wrapper(x_in, *args, **kwargs)
+        if isinstance(out, Tensor):
+            x._data = out._data
+            x._node = out._node
+            x._out_index = out._out_index
+            return x
+        return out
+    fn.__name__ = base_name + "_"
+    fn.__doc__ = (f"Inplace variant of `{base_name}` (storage + tape-node "
+                  "rebind through a detached input proxy).")
+    return fn
+
+
+def _install_inplace():
+    import sys
+    mod = sys.modules[__name__]
+    made = []
+    for nm in _INPLACE_NAMES:
+        base = nm[:-1]
+        if base in OPS and not hasattr(mod, nm):
+            fn = _make_inplace(base)
+            setattr(mod, nm, fn)
+            setattr(Tensor, nm, fn)
+            made.append(nm)
+    return made
+
+
+# reference spellings that alias existing ops
+OPS["mod"] = OPS["remainder"]
+OPS["floor_mod"] = OPS["remainder"]
+
+_INSTALLED_INPLACE = _install_inplace()
+
+
+def _random_fill(sampler):
+    def fn(x, *args, **kwargs):
+        from ..core.generator import next_key
+        x._data = sampler(next_key(), x._data, *args, **kwargs)
+        x._node = None  # fresh leaf: random fill severs history
+        return x
+    return fn
+
+
+def _install_random_fills():
+    import jax
+    import jax.numpy as _j
+
+    def _normal(key, d, mean=0.0, std=1.0, name=None):
+        return (mean + std * jax.random.normal(key, d.shape)).astype(d.dtype)
+
+    def _cauchy(key, d, loc=0.0, scale=1.0, name=None):
+        return (loc + scale * jax.random.cauchy(key, d.shape)).astype(d.dtype)
+
+    def _geometric(key, d, probs=0.5, name=None):
+        u = jax.random.uniform(key, d.shape, minval=1e-7, maxval=1.0)
+        return (_j.floor(_j.log(u) / _j.log1p(-probs)) + 1).astype(d.dtype)
+
+    def _log_normal(key, d, mean=1.0, std=2.0, name=None):
+        return _j.exp(mean + std * jax.random.normal(key, d.shape)).astype(
+            d.dtype)
+
+    import sys
+    mod = sys.modules[__name__]
+    def _bernoulli(key, d, p=0.5, name=None):
+        return jax.random.bernoulli(key, p, d.shape).astype(d.dtype)
+
+    for nm, fn in (("normal_", _normal), ("cauchy_", _cauchy),
+                   ("geometric_", _geometric), ("log_normal_", _log_normal),
+                   ("bernoulli_", _bernoulli)):
+        wrapped = _random_fill(fn)
+        wrapped.__name__ = nm
+        wrapped.__doc__ = ("Inplace random fill (reference "
+                           f"paddle.Tensor.{nm}).")
+        setattr(mod, nm, wrapped)
+        setattr(Tensor, nm, wrapped)
+
+
+_install_random_fills()
 
 
 # -- arithmetic dunders ----------------------------------------------------

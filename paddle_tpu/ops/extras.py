@@ -1,0 +1,463 @@
+"""Long-tail tensor ops completing the paddle root namespace.
+
+Reference: python/paddle/tensor/{manipulation,math,linalg,creation}.py —
+the names here are the reference's public __all__ entries that the core
+op modules (math.py, manipulation.py, ...) don't already provide. Each
+is a thin jnp/lax lowering registered through the op registry so eager
+autograd, Tensor methods, and the _C_ops shim all see them.
+"""
+from __future__ import annotations
+
+import math as _pymath
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register_op, call_op
+from ..core.tensor import Tensor
+
+__all__ = [
+    "block_diag", "diag_embed", "unstack", "cartesian_prod", "slice_scatter",
+    "tensor_split", "hsplit", "dsplit", "vsplit", "hstack", "vstack",
+    "dstack", "column_stack", "row_stack", "reverse", "add_n", "kthvalue",
+    "renorm", "select_scatter", "take", "frexp", "trapezoid",
+    "cumulative_trapezoid", "polar", "vander", "unflatten", "as_strided",
+    "view", "view_as", "masked_scatter", "index_fill", "diagonal_scatter",
+    "combinations", "signbit", "is_complex", "is_integer",
+    "is_floating_point", "numel", "rank", "shape", "sinc", "gammaln",
+    "gammainc", "gammaincc", "multigammaln", "cdist", "pdist",
+    "histogram_bin_edges", "histogramdd", "log_normal", "binomial",
+    "standard_gamma", "increment", "tolist", "reduce_as",
+]
+
+
+# -- structure / stacking ---------------------------------------------------
+
+@register_op()
+def block_diag(inputs, name=None):
+    mats = [jnp.atleast_2d(m) for m in inputs]
+    rows = sum(m.shape[0] for m in mats)
+    cols = sum(m.shape[1] for m in mats)
+    out = jnp.zeros((rows, cols), mats[0].dtype)
+    r = c = 0
+    for m in mats:
+        out = lax.dynamic_update_slice(out, m.astype(out.dtype), (r, c))
+        r += m.shape[0]
+        c += m.shape[1]
+    return out
+
+
+@register_op()
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    n = input.shape[-1]
+    size = n + abs(offset)
+    r = jnp.arange(n) + max(-offset, 0)
+    c = jnp.arange(n) + max(offset, 0)
+    out = jnp.zeros(input.shape[:-1] + (size, size), input.dtype)
+    out = out.at[..., r, c].set(input)
+    return jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+
+
+@register_op()
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    return [jnp.squeeze(s, axis) for s in jnp.split(x, n, axis=axis)]
+
+
+@register_op()
+def cartesian_prod(x, name=None):
+    grids = jnp.meshgrid(*x, indexing="ij")
+    return jnp.stack([g.ravel() for g in grids], axis=-1)
+
+
+@register_op()
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    idx = [slice(None)] * x.ndim
+    for ax, s, e, st in zip(axes, starts, ends, strides):
+        idx[ax] = slice(s, e, st)
+    return x.at[tuple(idx)].set(value.astype(x.dtype))
+
+
+@register_op()
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=axis)
+    return jnp.split(x, list(num_or_indices), axis=axis)
+
+
+@register_op()
+def hsplit(x, num_or_indices, name=None):
+    ax = 0 if x.ndim == 1 else 1
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=ax)
+    return jnp.split(x, list(num_or_indices), axis=ax)
+
+
+@register_op()
+def vsplit(x, num_or_indices, name=None):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=0)
+    return jnp.split(x, list(num_or_indices), axis=0)
+
+
+@register_op()
+def dsplit(x, num_or_indices, name=None):
+    if isinstance(num_or_indices, int):
+        return jnp.array_split(x, num_or_indices, axis=2)
+    return jnp.split(x, list(num_or_indices), axis=2)
+
+
+@register_op()
+def hstack(x, name=None):
+    return jnp.hstack(list(x))
+
+
+@register_op()
+def vstack(x, name=None):
+    return jnp.vstack(list(x))
+
+
+@register_op()
+def dstack(x, name=None):
+    return jnp.dstack(list(x))
+
+
+@register_op()
+def column_stack(x, name=None):
+    return jnp.column_stack(list(x))
+
+
+row_stack = vstack
+
+
+@register_op()
+def reverse(x, axis, name=None):
+    axes = [axis] if isinstance(axis, int) else list(axis)
+    return jnp.flip(x, axis=tuple(axes))
+
+
+@register_op()
+def add_n(inputs, name=None):
+    arrs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    out = arrs[0]
+    for a in arrs[1:]:
+        out = out + a
+    return out
+
+
+@register_op()
+def unflatten(x, axis, shape, name=None):
+    axis = axis % x.ndim
+    new = x.shape[:axis] + tuple(shape) + x.shape[axis + 1:]
+    return jnp.reshape(x, new)
+
+
+# -- views / scatter --------------------------------------------------------
+
+@register_op()
+def as_strided(x, shape, stride, offset=0, name=None):
+    flat = x.reshape(-1)
+    idx = offset + sum(
+        jnp.arange(shape[d]).reshape((-1,) + (1,) * (len(shape) - d - 1))
+        * stride[d] for d in range(len(shape)))
+    return flat[idx]
+
+
+@register_op(name="view")
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return jnp.reshape(x, tuple(shape_or_dtype))
+    from ..core.dtype import to_jax_dtype
+    return x.view(to_jax_dtype(shape_or_dtype)) if hasattr(x, "view") \
+        else x.astype(shape_or_dtype)
+
+
+@register_op()
+def view_as(x, other, name=None):
+    return jnp.reshape(x, other.shape)
+
+
+@register_op()
+def masked_scatter(x, mask, value, name=None):
+    """Fill True positions of mask with consecutive values (row-major)."""
+    m = mask.astype(bool)
+    mf = jnp.broadcast_to(m, x.shape).reshape(-1)
+    # position of each True among Trues
+    pos = jnp.cumsum(mf) - 1
+    vals = value.reshape(-1)
+    gathered = vals[jnp.clip(pos, 0, vals.shape[0] - 1)]
+    return jnp.where(mf, gathered, x.reshape(-1)).reshape(x.shape)
+
+
+@register_op()
+def index_fill(x, index, axis, value, name=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index.astype(jnp.int32) if hasattr(index, "astype") \
+        else jnp.asarray(index, jnp.int32)
+    return x.at[tuple(idx)].set(value)
+
+
+@register_op()
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    n = min(x.shape[axis1], x.shape[axis2])
+    k = y.shape[-1]
+    i = jnp.arange(k)
+    r = i + max(-offset, 0)
+    c = i + max(offset, 0)
+    idx = [slice(None)] * x.ndim
+    idx[axis1], idx[axis2] = r, c
+    return x.at[tuple(idx)].set(y.astype(x.dtype))
+
+
+@register_op()
+def select_scatter(x, values, axis, index, name=None):
+    idx = [slice(None)] * x.ndim
+    idx[axis] = index
+    return x.at[tuple(idx)].set(values.astype(x.dtype))
+
+
+@register_op()
+def take(x, index, mode="raise", name=None):
+    flat = x.reshape(-1)
+    idx = index.astype(jnp.int32)
+    n = flat.shape[0]
+    if mode == "wrap":
+        idx = jnp.mod(idx, n)
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # 'raise': negative wraps once (paddle semantics under jit: clip)
+        idx = jnp.where(idx < 0, idx + n, idx)
+        idx = jnp.clip(idx, 0, n - 1)
+    return flat[idx]
+
+
+# -- math -------------------------------------------------------------------
+
+@register_op()
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i.astype(jnp.int32)
+
+
+@register_op()
+def renorm(x, p, axis, max_norm, name=None):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.linalg.norm(flat, ord=p, axis=1)
+    scale = jnp.where(norms > max_norm, max_norm / jnp.maximum(norms, 1e-12),
+                      1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+@register_op()
+def frexp(x, name=None):
+    mant, exp = jnp.frexp(x)
+    return mant, exp.astype(x.dtype)
+
+
+@register_op()
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return jnp.trapezoid(y, x=x, axis=axis)
+    return jnp.trapezoid(y, dx=1.0 if dx is None else dx, axis=axis)
+
+
+@register_op()
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y1 = jnp.moveaxis(y, axis, -1)
+    if x is not None:
+        xm = jnp.moveaxis(jnp.broadcast_to(x, y.shape), axis, -1) \
+            if jnp.ndim(x) > 1 else x
+        d = jnp.diff(xm, axis=-1) if jnp.ndim(xm) > 1 else jnp.diff(xm)
+    else:
+        d = 1.0 if dx is None else dx
+    avg = (y1[..., 1:] + y1[..., :-1]) * 0.5 * d
+    return jnp.moveaxis(jnp.cumsum(avg, axis=-1), -1, axis)
+
+
+@register_op()
+def polar(abs, angle, name=None):  # noqa: A002 (reference arg name)
+    return (abs * jnp.cos(angle) + 1j * abs * jnp.sin(angle)).astype(
+        jnp.complex64)
+
+
+@register_op()
+def vander(x, n=None, increasing=False, name=None):
+    n = x.shape[0] if n is None else n
+    powers = jnp.arange(n)
+    if not increasing:
+        powers = powers[::-1]
+    return x[:, None] ** powers[None, :]
+
+
+@register_op()
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement(range(n), r)
+           if with_replacement else itertools.combinations(range(n), r))
+    idx = jnp.asarray(list(gen), jnp.int32).reshape(-1, r)
+    return x[idx]
+
+
+@register_op()
+def signbit(x, name=None):
+    return jnp.signbit(x)
+
+
+@register_op()
+def sinc(x, name=None):
+    return jnp.sinc(x)
+
+
+@register_op()
+def gammaln(x, name=None):
+    return jax.scipy.special.gammaln(x)
+
+
+@register_op()
+def gammainc(x, y, name=None):
+    return jax.scipy.special.gammainc(x, y)
+
+
+@register_op()
+def gammaincc(x, y, name=None):
+    return jax.scipy.special.gammaincc(x, y)
+
+
+@register_op()
+def multigammaln(x, p, name=None):
+    return jax.scipy.special.multigammaln(x, p)
+
+
+@register_op()
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    diff = x[..., :, None, :] - y[..., None, :, :]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 0))
+    if p == float("inf"):
+        return jnp.abs(diff).max(-1)
+    return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+
+
+@register_op()
+def pdist(x, p=2.0, name=None):
+    n = x.shape[0]
+    iu = np.triu_indices(n, k=1)
+    diff = x[iu[0]] - x[iu[1]]
+    if p == 2.0:
+        return jnp.sqrt(jnp.maximum((diff * diff).sum(-1), 0))
+    if p == float("inf"):
+        return jnp.abs(diff).max(-1)
+    return (jnp.abs(diff) ** p).sum(-1) ** (1.0 / p)
+
+
+@register_op(differentiable=False)
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    # trace-safe: endpoints stay jnp scalars (no float() coercion)
+    if min == 0 and max == 0:
+        lo, hi = input.min(), input.max()
+    else:
+        lo, hi = jnp.asarray(min, jnp.float32), jnp.asarray(max, jnp.float32)
+    same = lo == hi
+    lo = jnp.where(same, lo - 0.5, lo)
+    hi = jnp.where(same, hi + 0.5, hi)
+    return lo + (hi - lo) * jnp.linspace(0.0, 1.0, bins + 1)
+
+
+@register_op(differentiable=False)
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    hist, edges = jnp.histogramdd(x, bins=bins, range=ranges,
+                                  weights=weights, density=density)
+    return hist, list(edges)
+
+
+@register_op()
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's (broadcast-compatible) shape."""
+    t_shape = target.shape
+    extra = x.ndim - len(t_shape)
+    out = x.sum(axis=tuple(range(extra))) if extra else x
+    axes = tuple(i for i, (a, b) in enumerate(zip(out.shape, t_shape))
+                 if a != b and b == 1)
+    if axes:
+        out = out.sum(axis=axes, keepdims=True)
+    return out
+
+
+# -- randomness / misc ------------------------------------------------------
+
+@register_op(differentiable=False)
+def binomial(count, prob, name=None):
+    from ..core.generator import next_key
+    n = jnp.asarray(count, jnp.float32)
+    return jax.random.binomial(next_key(), n,
+                               jnp.asarray(prob)).astype(jnp.int64)
+
+
+@register_op(differentiable=False)
+def standard_gamma(x, name=None):
+    from ..core.generator import next_key
+    return jax.random.gamma(next_key(), x)
+
+
+@register_op(differentiable=False)
+def log_normal(mean=1.0, std=2.0, shape=None, dtype=None, name=None):
+    from ..core.generator import next_key
+    sh = tuple(shape) if shape is not None else ()
+    return jnp.exp(mean + std * jax.random.normal(next_key(), sh))
+
+
+def increment(x, value=1.0, name=None):
+    """In-place add on a 0-d/1-element tensor (reference increment op)."""
+    out = call_op("increment", lambda a: a + value, (x,), {})
+    if isinstance(x, Tensor):
+        x._data = out._data
+        return x
+    return out
+
+
+def tolist(x):
+    return np.asarray(x.data if isinstance(x, Tensor) else x).tolist()
+
+
+# -- predicates / metadata (plain functions, no tape) -----------------------
+
+def _data_of(x):
+    return x.data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(_data_of(x).dtype, jnp.complexfloating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(_data_of(x).dtype, jnp.integer)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(_data_of(x).dtype, jnp.floating)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(_data_of(x).size, jnp.int32))
+
+
+def rank(input, name=None):
+    return Tensor(jnp.asarray(_data_of(input).ndim, jnp.int32))
+
+
+def shape(input, name=None):
+    """paddle.shape returns the shape as a tensor."""
+    return Tensor(jnp.asarray(_data_of(input).shape, jnp.int32))

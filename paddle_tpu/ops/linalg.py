@@ -224,3 +224,96 @@ def histogram(input, bins=100, min=0, max=0, name=None):
 def bincount(x, weights=None, minlength=0, name=None):
     return jnp.bincount(x, weights=weights, minlength=minlength,
                         length=None)
+
+
+@register_op()
+def cond(x, p=None, name=None):
+    """Condition number (reference python/paddle/tensor/linalg.py cond)."""
+    return jnp.linalg.cond(x, p=p)
+
+
+@register_op()
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse from a Cholesky factor: (LL^T)^-1 via two triangular
+    solves (reference cholesky_inverse; no dense inverse materialized
+    beyond the solve)."""
+    eye = jnp.eye(x.shape[-1], dtype=x.dtype)
+    li = jax.scipy.linalg.solve_triangular(x, eye, lower=not upper)
+    return (li.T @ li) if not upper else (li @ li.T)
+
+
+@register_op()
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Split packed LU + pivots into (P, L, U) (reference lu_unpack)."""
+    n = x.shape[-2]
+    L = jnp.tril(x, -1) + jnp.eye(n, x.shape[-1], dtype=x.dtype)
+    L = L[..., :, :min(x.shape[-2], x.shape[-1])]
+    U = jnp.triu(x)[..., :min(x.shape[-2], x.shape[-1]), :]
+    # pivots (1-based sequential swaps) -> permutation matrix
+    piv = y.astype(jnp.int32) - 1
+    perm = jnp.arange(n)
+    for i in range(piv.shape[-1]):
+        j = piv[..., i]
+        pi, pj = perm[i], perm[j]
+        perm = perm.at[i].set(pj).at[j].set(pi)
+    P = jnp.eye(n, dtype=x.dtype)[perm].T
+    return P, L, U
+
+
+def _householder_full(x, tau):
+    """Full m x m Q = H_0 H_1 ... H_{k-1} from packed reflectors.
+    Batched leading dims handled by vmapping the 2-D core."""
+    if x.ndim > 2:
+        return jax.vmap(_householder_full)(x, tau)
+    m, n = x.shape[-2], x.shape[-1]
+    Q = jnp.eye(m, dtype=x.dtype)
+    for i in range(n):
+        v = jnp.where(jnp.arange(m) < i, 0.0, x[:, i])
+        v = v.at[i].set(1.0)
+        H = jnp.eye(m, dtype=x.dtype) - tau[i] * jnp.outer(v, v)
+        Q = Q @ H
+    return Q
+
+
+@register_op()
+def householder_product(x, tau, name=None):
+    """Q (thin, m x n) from Householder reflectors (reference
+    householder_product / LAPACK orgqr)."""
+    return _householder_full(x, tau)[..., :, :x.shape[-1]]
+
+
+@register_op()
+def ormqr(input, tau, other, left=True, transpose=False, name=None):
+    """Multiply ``other`` by Q of a QR factorization (reference ormqr).
+    Left-multiplication applies the FULL m x m Q (LAPACK ormqr
+    semantics), not the thin factor."""
+    Q = _householder_full(input, tau)
+    Qm = jnp.swapaxes(Q, -2, -1) if transpose else Q
+    return (Qm @ other) if left else (other @ Qm)
+
+
+@register_op()
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (reference svd_lowrank; Halko et al.):
+    q-dim range finder + power iterations — all matmuls, MXU-friendly."""
+    from ..core.generator import next_key
+    m, n = x.shape[-2], x.shape[-1]
+    q = min(q, m, n)
+    a = x - M if M is not None else x
+    omega = jax.random.normal(next_key(), (n, q), dtype=a.dtype)
+    y = a @ omega
+    for _ in range(niter):
+        y = a @ (a.T @ y)
+    Q, _ = jnp.linalg.qr(y)
+    b = Q.T @ a
+    u, s, vh = jnp.linalg.svd(b, full_matrices=False)
+    return Q @ u, s, vh.T
+
+
+@register_op()
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over svd_lowrank (reference pca_lowrank)."""
+    m, n = x.shape[-2], x.shape[-1]
+    q = min(6, m, n) if q is None else q
+    a = x - x.mean(axis=-2, keepdims=True) if center else x
+    return svd_lowrank.__wrapped__(a, q=q, niter=niter)

@@ -1,5 +1,6 @@
 """paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
 from . import lr
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adagrad,
-                        RMSProp, Adamax, Lamb, L1Decay, L2Decay)
+                        RMSProp, Adamax, Lamb, L1Decay, L2Decay,
+                        Adadelta, ASGD, Rprop, NAdam, RAdam)
 from .lbfgs import LBFGS

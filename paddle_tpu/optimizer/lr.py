@@ -285,3 +285,38 @@ class CyclicLR(LRScheduler):
         elif self.mode == "exp_range":
             amp = amp * self.exp_gamma ** self.last_epoch
         return self.base_lr + amp * x
+
+
+class LinearLR(LRScheduler):
+    """Linear warm factor from start_factor to end_factor over
+    total_steps (reference optimizer/lr.py LinearLR)."""
+
+    def __init__(self, learning_rate, total_steps, start_factor=1. / 3,
+                 end_factor=1.0, last_epoch=-1, verbose=False):
+        self.total_steps = total_steps
+        self.start_factor = start_factor
+        self.end_factor = end_factor
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        frac = min(max(self.last_epoch, 0), self.total_steps) / \
+            self.total_steps
+        factor = self.start_factor + (self.end_factor
+                                      - self.start_factor) * frac
+        return self.base_lr * factor
+
+
+class MultiplicativeDecay(LRScheduler):
+    """lr = base_lr * prod(lr_lambda(i) for i in 1..epoch) (reference
+    optimizer/lr.py MultiplicativeDecay)."""
+
+    def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
+                 verbose=False):
+        self.lr_lambda = lr_lambda
+        super().__init__(learning_rate, last_epoch, verbose)
+
+    def get_lr(self):
+        lr = self.base_lr
+        for i in range(1, self.last_epoch + 1):
+            lr *= self.lr_lambda(i)
+        return lr

@@ -388,3 +388,148 @@ class Lamb(Optimizer):
         r_norm = jnp.linalg.norm(r)
         trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
         self._param_update(p, master, w32 - lr.astype(jnp.float32) * trust * r)
+
+
+class Adadelta(Optimizer):
+    """reference python/paddle/optimizer/adadelta.py."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._eps = epsilon
+        self._rho = rho
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w = (master._data if master is not None else p._data).astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * w
+        avg_sq = self._acc("_avg_squared_grad", p, dtype=jnp.float32)
+        avg_up = self._acc("_avg_squared_update", p, dtype=jnp.float32)
+        avg_sq._data = self._rho * avg_sq._data + (1 - self._rho) * g * g
+        upd = (jnp.sqrt(avg_up._data + self._eps)
+               / jnp.sqrt(avg_sq._data + self._eps)) * g
+        avg_up._data = self._rho * avg_up._data + (1 - self._rho) * upd * upd
+        self._param_update(p, master, w - lr.astype(jnp.float32) * upd)
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (reference python/paddle/optimizer/asgd.py): keeps a
+    running average of the last ``d`` gradients."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         multi_precision, name)
+        self._n = max(int(batch_num), 1)
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w = (master._data if master is not None else p._data).astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * w
+        d = self._acc("_d", p, dtype=jnp.float32)       # sum of buffer
+        # ring buffer of n grads is O(n·param) in the reference too; a
+        # running mean over the last n via exponential window matches
+        # its steady-state: d <- d - d/n + g
+        d._data = d._data - d._data / self._n + g
+        self._param_update(p, master,
+                           w - lr.astype(jnp.float32) * d._data / self._n)
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference python/paddle/optimizer/rprop.py):
+    per-weight step sizes adapted by grad sign agreement."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip,
+                         multi_precision, name)
+        self._lr_lo, self._lr_hi = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+        self._init_lr = float(learning_rate) if not isinstance(
+            learning_rate, LRScheduler) else learning_rate()
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w = (master._data if master is not None else p._data).astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        prev = self._acc("_prev_grad", p, dtype=jnp.float32)
+        steps = self._acc("_step_size", p,
+                          init=jnp.full(p._data.shape, self._init_lr,
+                                        jnp.float32))
+        sign = jnp.sign(g * prev._data)
+        factor = jnp.where(sign > 0, self._eta_pos,
+                           jnp.where(sign < 0, self._eta_neg, 1.0))
+        steps._data = jnp.clip(steps._data * factor, self._lr_lo, self._lr_hi)
+        # on sign flip: do not step, zero the remembered grad
+        g_eff = jnp.where(sign < 0, 0.0, g)
+        prev._data = g_eff
+        self._param_update(p, master, w - steps._data * jnp.sign(g_eff))
+
+
+class NAdam(Adam):
+    """Nesterov Adam (reference python/paddle/optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip,
+                         multi_precision=multi_precision, name=name)
+        self._psi = momentum_decay
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w = (master._data if master is not None else p._data).astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * w
+        t = self._step_count._data.astype(jnp.float32)
+        mu_t = self._beta1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_next = self._beta1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        prod = self._acc("_mu_product", p,
+                         init=jnp.ones((), jnp.float32))
+        prod._data = prod._data * mu_t
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g
+        v._data = self._beta2 * v._data + (1 - self._beta2) * g * g
+        mhat = (mu_next * m._data / (1 - prod._data * mu_next)
+                + (1 - mu_t) * g / (1 - prod._data))
+        vhat = v._data / (1 - jnp.power(self._beta2, t))
+        self._param_update(
+            p, master,
+            w - lr.astype(jnp.float32) * mhat / (jnp.sqrt(vhat) + self._eps))
+
+
+class RAdam(Adam):
+    """Rectified Adam (reference python/paddle/optimizer/radam.py)."""
+
+    def _apply_one(self, p, g, lr, master, wd):
+        w = (master._data if master is not None else p._data).astype(jnp.float32)
+        g = g.astype(jnp.float32)
+        if wd:
+            g = g + wd * w
+        t = self._step_count._data.astype(jnp.float32)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        m._data = self._beta1 * m._data + (1 - self._beta1) * g
+        v._data = self._beta2 * v._data + (1 - self._beta2) * g * g
+        mhat = m._data / (1 - jnp.power(self._beta1, t))
+        rho_inf = 2.0 / (1 - self._beta2) - 1
+        rho_t = rho_inf - 2 * t * jnp.power(self._beta2, t) / (
+            1 - jnp.power(self._beta2, t))
+        lr32 = lr.astype(jnp.float32)
+        # variance-rectified branch vs un-adapted (SGD-with-momentum)
+        r = jnp.sqrt(jnp.maximum(
+            (rho_t - 4) * (rho_t - 2) * rho_inf
+            / jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t, 1e-12), 0.0))
+        vhat = jnp.sqrt(v._data / (1 - jnp.power(self._beta2, t)))
+        upd = jnp.where(rho_t > 5.0,
+                        r * mhat / (vhat + self._eps),
+                        mhat)
+        self._param_update(p, master, w - lr32 * upd)

@@ -234,3 +234,32 @@ class PTQ(QAT):
                 q, scales, bias=sub.inner.bias,
                 compute_dtype=w.data.dtype))
         return model
+
+
+BaseObserver = BaseQuanter  # reference factory.py: observers are quanters
+
+
+class _QuanterFactory:
+    """reference quantization/factory.py quanter(): wraps a quanter
+    class so QuantConfig can hold partially-applied constructors."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self.cls = cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def _instance(self, layer=None):
+        return self.cls(*self.args, **self.kwargs)
+
+    def __call__(self, *args, **kwargs):
+        return self.cls(*(args or self.args), **(kwargs or self.kwargs))
+
+
+def quanter(name=None):
+    """Class decorator registering a quanter under ``name`` and giving
+    it a partial-application helper (reference @quanter('FakeQuanter...'))."""
+    def deco(cls):
+        cls.partial = classmethod(
+            lambda c, *a, **k: _QuanterFactory(c, *a, **k))
+        return cls
+    return deco

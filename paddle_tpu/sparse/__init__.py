@@ -148,22 +148,146 @@ def add(x, y, name=None):
     return Tensor(x.to_dense().data + y.to_dense().data)
 
 
-def relu(x, name=None) -> SparseCooTensor:
+def _unary(fn, name):
+    """Zero-preserving elementwise op applied to the stored values only
+    (reference python/paddle/sparse/unary.py over phi sparse kernels)."""
+    def op(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        sp = _sp(x)
+        vals = fn(sp.data, *args, **kwargs)
+        if isinstance(x, SparseCsrTensor):
+            return SparseCsrTensor(
+                jsparse.BCSR((vals, sp.indices, sp.indptr), shape=sp.shape))
+        return SparseCooTensor(jsparse.BCOO((vals, sp.indices),
+                                            shape=sp.shape))
+    op.__name__ = name
+    return op
+
+
+relu = _unary(jax.nn.relu, "relu")
+relu6 = _unary(lambda v: jnp.clip(v, 0, 6), "relu6")
+leaky_relu = _unary(
+    lambda v, negative_slope=0.01: jnp.where(v > 0, v, negative_slope * v),
+    "leaky_relu")
+abs = _unary(jnp.abs, "abs")  # noqa: A001 (reference name)
+sqrt = _unary(jnp.sqrt, "sqrt")
+square = _unary(jnp.square, "square")
+sin = _unary(jnp.sin, "sin")
+sinh = _unary(jnp.sinh, "sinh")
+asin = _unary(jnp.arcsin, "asin")
+asinh = _unary(jnp.arcsinh, "asinh")
+tan = _unary(jnp.tan, "tan")
+tanh = _unary(jnp.tanh, "tanh")
+atan = _unary(jnp.arctan, "atan")
+atanh = _unary(jnp.arctanh, "atanh")
+expm1 = _unary(jnp.expm1, "expm1")
+log1p = _unary(jnp.log1p, "log1p")
+neg = _unary(jnp.negative, "neg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+isnan = _unary(jnp.isnan, "isnan")
+pow = _unary(jnp.power, "pow")  # noqa: A001
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
     sp = _sp(x)
-    return SparseCooTensor(jsparse.BCOO((jax.nn.relu(sp.data), sp.indices),
-                                        shape=sp.shape))
+    vals = sp.data if value_dtype is None else sp.data.astype(value_dtype)
+    idx = sp.indices if index_dtype is None else sp.indices.astype(
+        index_dtype)
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=sp.shape))
 
 
-def sqrt(x, name=None) -> SparseCooTensor:
+def coalesce(x, name=None) -> "SparseCooTensor":
     sp = _sp(x)
-    return SparseCooTensor(jsparse.BCOO((jnp.sqrt(sp.data), sp.indices),
-                                        shape=sp.shape))
+    return SparseCooTensor(sp.sum_duplicates(nse=sp.nse))
 
 
-def sin(x, name=None) -> SparseCooTensor:
+def subtract(x, y, name=None):
+    return add(x, neg(y) if isinstance(y, SparseCooTensor) else
+               SparseCooTensor(jsparse.BCOO(
+                   (-_sp(y).data, _sp(y).indices), shape=_sp(y).shape)))
+
+
+def multiply(x, y, name=None) -> Tensor:
+    return Tensor(x.to_dense().data * y.to_dense().data)
+
+
+def divide(x, y, name=None) -> Tensor:
+    return Tensor(x.to_dense().data / y.to_dense().data)
+
+
+def mv(x, vec, name=None) -> Tensor:
+    v = vec.data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(_sp(x) @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None) -> Tensor:
+    """beta*input + alpha*(x @ y), x sparse (reference sparse/multiary.py)."""
+    inp = input.data if isinstance(input, Tensor) else jnp.asarray(input)
+    yv = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(beta * inp + alpha * (_sp(x) @ yv))
+
+
+def masked_matmul(x, y, mask, name=None) -> "SparseCsrTensor":
+    """Dense @ dense evaluated only at mask's nonzero pattern (reference
+    sparse SDDMM, phi/kernels/sparse/gpu/matmul_kernel.cu)."""
+    xv = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y.data if isinstance(y, Tensor) else jnp.asarray(y)
+    sp = _sp(mask)
+    dense = xv @ yv
+    if isinstance(mask, SparseCsrTensor):
+        rows = jnp.asarray(
+            np.repeat(np.arange(sp.shape[0]),
+                      np.diff(np.asarray(sp.indptr))), jnp.int32)
+        vals = dense[rows, jnp.asarray(sp.indices)]
+        return SparseCsrTensor(
+            jsparse.BCSR((vals, sp.indices, sp.indptr), shape=sp.shape))
+    idx = sp.indices
+    vals = dense[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=sp.shape))
+
+
+def mask_as(x, mask, name=None):
+    """Take dense x's values at mask's sparsity pattern."""
+    xv = x.data if isinstance(x, Tensor) else jnp.asarray(x)
+    sp = _sp(mask)
+    idx = sp.indices
+    vals = xv[tuple(idx[:, i] for i in range(idx.shape[1]))]
+    return SparseCooTensor(jsparse.BCOO((vals, idx), shape=sp.shape))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    out = jnp.sum(x.to_dense().data, axis=axis, keepdims=keepdim)
+    if dtype is not None:
+        out = out.astype(dtype)
+    return Tensor(out)
+
+
+def reshape(x, shape, name=None) -> "SparseCooTensor":
     sp = _sp(x)
-    return SparseCooTensor(jsparse.BCOO((jnp.sin(sp.data), sp.indices),
-                                        shape=sp.shape))
+    return SparseCooTensor(sp.reshape(tuple(shape)))
+
+
+def transpose(x, perm, name=None) -> "SparseCooTensor":
+    sp = _sp(x)
+    idx = sp.indices[:, jnp.asarray(perm)]
+    new_shape = tuple(sp.shape[p] for p in perm)
+    return SparseCooTensor(jsparse.BCOO((sp.data, idx), shape=new_shape))
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001
+    import builtins
+    dense = x.to_dense().data
+    idx = [builtins.slice(None)] * dense.ndim
+    for ax, s, e in zip(axes, starts, ends):
+        idx[ax] = builtins.slice(s, e)
+    return SparseCooTensor(jsparse.BCOO.fromdense(dense[tuple(idx)]))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from ..ops.linalg import pca_lowrank as _dense_pca
+    return _dense_pca(x.to_dense() if hasattr(x, "to_dense") else x,
+                      q=q, center=center, niter=niter)
 
 
 def is_same_shape(x, y) -> bool:

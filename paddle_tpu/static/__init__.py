@@ -38,3 +38,253 @@ def default_startup_program():
 def name_scope(prefix=None):
     import contextlib
     return contextlib.nullcontext()
+
+
+# -- meaningful compat (not Program machinery) ------------------------------
+# These reference names have jit/eager-era equivalents; each delegates to
+# the live implementation rather than re-raising.
+
+import contextlib as _contextlib
+
+
+def cpu_places(device_count=None):
+    import jax
+    devs = [d for d in jax.devices() if d.platform == "cpu"]
+    return devs[:device_count] if device_count else devs
+
+
+def cuda_places(device_ids=None):
+    """Accelerator devices (TPU here — name kept for API compat)."""
+    import jax
+    devs = [d for d in jax.devices() if d.platform != "cpu"] or jax.devices()
+    if device_ids is not None:
+        devs = [devs[i] for i in device_ids]
+    return devs
+
+
+xpu_places = cuda_places
+
+
+def device_guard(device=None):
+    """Scoped default-device hint (reference static/device_guard).
+    Placement is XLA's under jit; eagerly this scopes set_device."""
+    from ..framework import get_device, set_device
+
+    @_contextlib.contextmanager
+    def guard():
+        prev = get_device()
+        if device:
+            set_device("cpu" if device.startswith("cpu") else device)
+        try:
+            yield
+        finally:
+            set_device(prev)
+    return guard()
+
+
+def program_guard(main_program=None, startup_program=None):
+    return _contextlib.nullcontext()
+
+
+def scope_guard(scope):
+    return _contextlib.nullcontext()
+
+
+def global_scope():
+    """Variable scope (reference global_scope): eager tensors live on
+    python objects; expose a dict-like singleton for compat."""
+    return _GLOBAL_SCOPE
+
+
+class _Scope(dict):
+    def var(self, name):
+        return self.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.get(name)
+
+
+_GLOBAL_SCOPE = _Scope()
+
+from ..core.tensor import Tensor as Variable  # noqa: E402,F401
+
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    import jax.numpy as jnp
+    from ..core.dtype import to_jax_dtype
+    from ..core.tensor import Tensor
+    t = Tensor(jnp.full(tuple(shape), value, to_jax_dtype(dtype)),
+               stop_gradient=True, name=name or "")
+    _GLOBAL_SCOPE[name or f"gvar_{len(_GLOBAL_SCOPE)}"] = t
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    import paddle_tpu as _pt
+    return _pt.create_parameter(shape, dtype, name=name, attr=attr,
+                                is_bias=is_bias,
+                                default_initializer=default_initializer)
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference static/nn/metric.py accuracy)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    logits = input.data if isinstance(input, Tensor) else jnp.asarray(input)
+    lbl = label.data if isinstance(label, Tensor) else jnp.asarray(label)
+    lbl = lbl.reshape(lbl.shape[0], -1)[:, 0]
+    topk = jnp.argsort(-logits, axis=-1)[:, :k]
+    hit = (topk == lbl[:, None]).any(axis=-1)
+    return Tensor(hit.mean(dtype=jnp.float32))
+
+
+def auc(input, label, curve="ROC", num_thresholds=200, topk=1,
+        slide_steps=1, name=None):
+    """Area under ROC (reference static/nn/metric.py auc): exact
+    rank-statistic computation (no thresholds bucketing needed)."""
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    logits = input.data if isinstance(input, Tensor) else jnp.asarray(input)
+    score = logits[:, -1] if logits.ndim == 2 else logits
+    lbl = (label.data if isinstance(label, Tensor)
+           else jnp.asarray(label)).reshape(-1)
+    order = jnp.argsort(score)
+    ranks = jnp.argsort(order) + 1
+    pos = lbl == 1
+    n_pos = pos.sum()
+    n_neg = lbl.shape[0] - n_pos
+    auc_val = (ranks[pos].sum() - n_pos * (n_pos + 1) / 2) / jnp.maximum(
+        n_pos * n_neg, 1)
+    return Tensor(auc_val.astype(jnp.float32))
+
+
+def Print(input, first_n=-1, message=None, summarize=20, print_tensor_name=True,
+          print_tensor_type=True, print_tensor_shape=True,
+          print_tensor_layout=True, print_tensor_lod=True,
+          print_phase="both"):
+    """Debug print op (reference static/nn/control_flow.py Print).
+    Eager: host print; under jit: jax.debug.print."""
+    import jax
+    import numpy as np
+    from ..core.tensor import Tensor
+    if isinstance(input, Tensor):
+        hdr = message or ""
+        try:
+            print(f"{hdr} shape={tuple(input.shape)} "
+                  f"{np.asarray(input.data).ravel()[:summarize]}")
+        except Exception:
+            jax.debug.print(hdr + " {x}", x=input.data)
+    return input
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a python function as an op (reference static/nn/common.py
+    py_func). Eager execution calls it directly; the PyLayer path covers
+    custom backward."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None, checkpoints=None):
+    """reference static append_backward: populate grads for params.
+    Eager equivalent: run backward on the loss tensor."""
+    loss.backward()
+    params = parameter_list or []
+    return [(p, p._grad) for p in params]
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """reference static gradients(ys, xs) -> dys/dxs via the tape."""
+    from ..autograd import grad
+    return grad(targets, inputs, grad_outputs=target_gradients,
+                allow_unused=True)
+
+
+class WeightNormParamAttr:
+    """reference static/nn/common.py WeightNormParamAttr: ParamAttr that
+    reparameterizes w = g * v/||v||. Carried as attr metadata; apply
+    with nn.utils.weight_norm-style wrapping."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.trainable = trainable
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static/nn/common.py
+    ExponentialMovingAverage): update() after each step; apply()/
+    restore() swap averages in and out for eval."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._params = []
+        self._step = 0
+
+    def _track(self, params):
+        if not self._params:
+            self._params = list(params)
+            for p in self._params:
+                self._ema[id(p)] = p._data
+
+    def update(self, parameters=None):
+        import jax.numpy as jnp
+        if parameters is not None or not self._params:
+            self._track(parameters or [])
+        self._step += 1
+        d = min(self._decay, (1 + self._step) / (10 + self._step))
+        for p in self._params:
+            self._ema[id(p)] = d * self._ema[id(p)] + (1 - d) * p._data
+
+    @_contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        self._backup = {id(p): p._data for p in self._params}
+        for p in self._params:
+            p._data = self._ema[id(p)].astype(p._data.dtype)
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for p in self._params:
+            if id(p) in self._backup:
+                p._data = self._backup[id(p)]
+        self._backup = {}
+
+
+def save(program, model_path, protocol=4, **configs):
+    raise NotImplementedError(
+        "static Program save is a non-goal; use paddle_tpu.save "
+        "(state dicts) or jit.save (compiled StableHLO programs)")
+
+
+def load(program, model_path, executor=None, var_list=None):
+    raise NotImplementedError(
+        "static Program load is a non-goal; use paddle_tpu.load or "
+        "jit.load")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         **kwargs):
+    """Map to the live deployment path: jit.save of a traced function
+    (reference static/io.py save_inference_model -> this build's
+    StableHLO export)."""
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer_or_fn, path) — inference "
+        "deployment here is StableHLO export + inference.Config")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path) / inference.create_predictor")

@@ -86,3 +86,40 @@ class WMT14:
 class WMT16:
     def __init__(self, *a, **kw):
         _no_dataset("WMT16")
+
+
+class Imikolov:
+    """PTB n-gram dataset (reference text/datasets/imikolov.py). With a
+    local ``data_file`` (the extracted ptb.{train,valid}.txt) it builds
+    the same word dict + n-gram samples as the reference; without one it
+    raises like the other download-backed datasets."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True):
+        if data_file is None:
+            _no_dataset("Imikolov")
+        from collections import Counter
+        with open(data_file, encoding="utf-8") as f:
+            lines = [ln.strip().split() for ln in f]
+        freq = Counter(w for ln in lines for w in ln)
+        vocab = [w for w, c in sorted(freq.items(), key=lambda t: (-t[1], t[0]))
+                 if c >= min_word_freq]
+        self.word_idx = {w: i for i, w in enumerate(vocab)}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        self.word_idx["<e>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        eos = self.word_idx["<e>"]
+        self.data = []
+        for ln in lines:
+            ids = [self.word_idx.get(w, unk) for w in ln] + [eos]
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(tuple(ids[i:i + window_size]))
+            else:  # SEQ
+                self.data.append((ids[:-1], ids[1:]))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)

@@ -4,7 +4,8 @@ from ...models.lenet import LeNet  # noqa: F401
 from ...models.resnet import (  # noqa: F401
     ResNet, BasicBlock, BottleneckBlock,
     resnet18, resnet34, resnet50, resnet101, resnet152,
-    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext101_64x4d,
+    wide_resnet50_2, wide_resnet101_2, resnext50_32x4d, resnext50_64x4d,
+    resnext101_32x4d, resnext101_64x4d, resnext152_32x4d, resnext152_64x4d,
 )
 from ...models.vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
 from ...models.alexnet import AlexNet, alexnet  # noqa: F401

@@ -200,9 +200,20 @@ class ColorJitter(BaseTransform):
     def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
                  keys=None):
         self.brightness = BrightnessTransform(brightness)
+        self._cfg = (contrast, saturation, hue)
 
     def _apply_image(self, img):
-        return self.brightness(img)
+        img = self.brightness(img)
+        contrast, saturation, hue = self._cfg
+        order = np.random.permutation(3)
+        for which in order:
+            if which == 0 and contrast:
+                img = ContrastTransform(contrast)(img)
+            elif which == 1 and saturation:
+                img = SaturationTransform(saturation)(img)
+            elif which == 2 and hue:
+                img = HueTransform(hue)(img)
+        return img
 
 
 def to_tensor(pic, data_format="CHW"):
@@ -231,3 +242,339 @@ def center_crop(img, output_size):
 
 def crop(img, top, left, height, width):
     return img[top:top + height, left:left + width]
+
+
+# -- functional image ops (reference vision/transforms/functional.py) -------
+
+def adjust_brightness(img, brightness_factor):
+    dt = img.dtype
+    hi = 255 if dt == np.uint8 else np.inf
+    return np.clip(img.astype(np.float32) * brightness_factor, 0,
+                   hi).astype(dt)
+
+
+def adjust_contrast(img, contrast_factor):
+    dt = img.dtype
+    gray = _rgb_to_gray(img).mean()
+    hi = 255 if dt == np.uint8 else np.inf
+    out = gray + contrast_factor * (img.astype(np.float32) - gray)
+    return np.clip(out, 0, hi).astype(dt)
+
+
+def _rgb_to_gray(img):
+    im = img.astype(np.float32)
+    if im.ndim == 2 or im.shape[-1] == 1:
+        return im.reshape(im.shape[:2])
+    return im[..., 0] * 0.299 + im[..., 1] * 0.587 + im[..., 2] * 0.114
+
+
+def adjust_saturation(img, saturation_factor):
+    dt = img.dtype
+    gray = _rgb_to_gray(img)[..., None]
+    hi = 255 if dt == np.uint8 else np.inf
+    out = gray + saturation_factor * (img.astype(np.float32) - gray)
+    return np.clip(out, 0, hi).astype(dt)
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV round trip."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    dt = img.dtype
+    im = img.astype(np.float32) / (255.0 if dt == np.uint8 else 1.0)
+    mx = im.max(-1)
+    mn = im.min(-1)
+    diff = mx - mn + 1e-12
+    r, g, b = im[..., 0], im[..., 1], im[..., 2]
+    h = np.where(mx == r, (g - b) / diff % 6,
+                 np.where(mx == g, (b - r) / diff + 2, (r - g) / diff + 4))
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    # hsv -> rgb
+    i = np.floor(h * 6).astype(np.int64) % 6
+    f = h * 6 - np.floor(h * 6)
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    choices = np.stack([
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)], 0)
+    out = np.take_along_axis(choices, i[None, ..., None], axis=0)[0]
+    out = out * (255.0 if dt == np.uint8 else 1.0)
+    return np.clip(out, 0, 255 if dt == np.uint8 else np.inf).astype(dt)
+
+
+def to_grayscale(img, num_output_channels=1):
+    g = _rgb_to_gray(img)
+    out = np.repeat(g[..., None], num_output_channels, axis=-1)
+    return out.astype(img.dtype) if img.dtype != np.uint8 else \
+        np.clip(out, 0, 255).astype(np.uint8)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    if isinstance(padding, numbers.Number):
+        l = r = t = b = int(padding)
+    elif len(padding) == 2:
+        l, t = padding
+        r, b = padding
+    else:
+        l, t, r, b = padding
+    widths = [(t, b), (l, r)] + [(0, 0)] * (img.ndim - 2)
+    if padding_mode == "constant":
+        return np.pad(img, widths, constant_values=fill)
+    return np.pad(img, widths, mode={"edge": "edge", "reflect": "reflect",
+                                     "symmetric": "symmetric"}[padding_mode])
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    out = img if inplace else img.copy()
+    chw = out.ndim == 3 and out.shape[0] in (1, 3) and out.shape[-1] not in (1, 3)
+    if chw:
+        out[:, i:i + h, j:j + w] = v
+    else:
+        out[i:i + h, j:j + w] = v
+    return out
+
+
+def _warp(img, minv, fill=0):
+    """Inverse-map warp with bilinear sampling; minv maps OUTPUT (x, y)
+    homogeneous coords to INPUT coords."""
+    ih, iw = img.shape[:2]
+    ys, xs = np.mgrid[0:ih, 0:iw].astype(np.float32)
+    ones = np.ones_like(xs)
+    coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
+    src = minv @ coords
+    sx = (src[0] / src[2]).reshape(ih, iw)
+    sy = (src[1] / src[2]).reshape(ih, iw)
+    x0 = np.floor(sx)
+    y0 = np.floor(sy)
+    lx, ly = sx - x0, sy - y0
+    im = img.astype(np.float32)
+    if im.ndim == 2:
+        im = im[:, :, None]
+    out = np.zeros_like(im)
+    for dy, wy in ((0, 1 - ly), (1, ly)):
+        for dx, wx in ((0, 1 - lx), (1, lx)):
+            xi = x0 + dx
+            yi = y0 + dy
+            ok = (xi >= 0) & (xi < iw) & (yi >= 0) & (yi < ih)
+            xi = np.clip(xi, 0, iw - 1).astype(np.int64)
+            yi = np.clip(yi, 0, ih - 1).astype(np.int64)
+            w = (wy * wx * ok)[..., None]
+            out += np.where(ok[..., None], im[yi, xi], fill) * w
+    miss = np.zeros((ih, iw), bool)
+    oob = (sx < -0.5) | (sx > iw - 0.5) | (sy < -0.5) | (sy > ih - 0.5)
+    out[oob] = fill
+    if img.ndim == 2:
+        out = out[..., 0]
+    return out.astype(img.dtype) if img.dtype != np.uint8 else \
+        np.clip(out, 0, 255).astype(np.uint8)
+
+
+def _affine_inv_matrix(angle, translate, scale, shear, center):
+    a = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    cx, cy = center
+    tx, ty = translate
+    # forward: T(center) R S Sh T(-center) + translate; invert it
+    rot = np.asarray([[np.cos(a + sy), -np.sin(a + sx), 0],
+                      [np.sin(a + sy), np.cos(a + sx), 0],
+                      [0, 0, 1]], np.float64)
+    sc = np.diag([scale, scale, 1.0])
+    to_c = np.asarray([[1, 0, cx], [0, 1, cy], [0, 0, 1]], np.float64)
+    from_c = np.asarray([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float64)
+    tr = np.asarray([[1, 0, tx], [0, 1, ty], [0, 0, 1]], np.float64)
+    fwd = tr @ to_c @ rot @ sc @ from_c
+    return np.linalg.inv(fwd)
+
+
+def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
+           interpolation="bilinear", fill=0, center=None):
+    h, w = img.shape[:2]
+    center = center or ((w - 1) / 2.0, (h - 1) / 2.0)
+    shear = shear if isinstance(shear, (list, tuple)) else (shear, 0.0)
+    return _warp(img, _affine_inv_matrix(angle, translate, scale, shear,
+                                         center), fill)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    return affine(img, angle=angle, fill=fill, center=center)
+
+
+def perspective(img, startpoints, endpoints, interpolation="bilinear",
+                fill=0):
+    """Warp mapping startpoints -> endpoints (4 corner pairs)."""
+    A = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([sx, sy, 1, 0, 0, 0, -ex * sx, -ex * sy])
+        A.append([0, 0, 0, sx, sy, 1, -ey * sx, -ey * sy])
+    b = np.asarray([c for pt in endpoints for c in pt], np.float64)
+    coef = np.linalg.lstsq(np.asarray(A, np.float64), b, rcond=None)[0]
+    fwd = np.append(coef, 1.0).reshape(3, 3)
+    return _warp(img, np.linalg.inv(fwd), fill)
+
+
+# -- class transforms -------------------------------------------------------
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_contrast(img,
+                               1 + np.random.uniform(-self.value, self.value))
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_saturation(
+            img, 1 + np.random.uniform(-self.value, self.value))
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.args = (padding, fill, padding_mode)
+
+    def _apply_image(self, img):
+        return pad(img, *self.args)
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.n)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, center=self.center, fill=self.fill)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = (degrees if isinstance(degrees, (list, tuple))
+                        else (-degrees, degrees))
+        self.translate = translate
+        self.scale = scale
+        self.shear = (shear if shear is None or
+                      isinstance(shear, (list, tuple))
+                      else (-shear, shear))
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = (np.random.uniform(*self.scale) if self.scale else 1.0)
+        sh = (np.random.uniform(*self.shear) if self.shear else 0.0)
+        return affine(img, angle=angle, translate=(tx, ty), scale=sc,
+                      shear=(sh, 0.0), fill=self.fill, center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="bilinear", fill=0, keys=None):
+        self.prob = prob
+        self.scale = distortion_scale
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = img.shape[:2]
+        d = self.scale
+        tl = (np.random.uniform(0, d * w / 2), np.random.uniform(0, d * h / 2))
+        tr = (w - 1 - np.random.uniform(0, d * w / 2),
+              np.random.uniform(0, d * h / 2))
+        br = (w - 1 - np.random.uniform(0, d * w / 2),
+              h - 1 - np.random.uniform(0, d * h / 2))
+        bl = (np.random.uniform(0, d * w / 2),
+              h - 1 - np.random.uniform(0, d * h / 2))
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        return perspective(img, start, [tl, tr, br, bl])
+
+
+class RandomResizedCrop(BaseTransform):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3. / 4, 4. / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = _size2(size)
+        self.scale = scale
+        self.ratio = ratio
+
+    def _apply_image(self, img):
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                top = np.random.randint(0, h - ch + 1)
+                left = np.random.randint(0, w - cw + 1)
+                patch = img[top:top + ch, left:left + cw]
+                return _resize_np(patch, *self.size)
+        side = min(h, w)  # fallback: center crop
+        top, left = (h - side) // 2, (w - side) // 2
+        return _resize_np(img[top:top + side, left:left + side], *self.size)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def _apply_image(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = (img.shape[1:3] if img.ndim == 3 and img.shape[0] in (1, 3)
+                and img.shape[-1] not in (1, 3) else img.shape[:2])
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.random.uniform(*self.ratio)
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh)
+                j = np.random.randint(0, w - ew)
+                return erase(img, i, j, eh, ew, self.value)
+        return img

@@ -1,0 +1,356 @@
+"""Behavioral checks for the API-parity batch: distributions, extended
+nn/functional layers, transforms, distributed facade, static compat,
+audio IO, geometric sampling, incubate re-exports.
+
+(Name-presence is covered by tools/api_parity.py; these tests assert
+numerics for a representative slice of each namespace.)
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+
+
+# ----------------------------------------------------------- distribution
+def test_distribution_moments_and_logprob():
+    D = pt.distribution
+    po = D.Poisson(4.0)
+    s = np.asarray(po.sample([4000]).data)
+    assert abs(s.mean() - 4.0) < 0.3
+    # poisson pmf at k=2, rate 4: 4^2 e^-4 / 2!
+    lp = float(np.asarray(po.log_prob(pt.to_tensor(2.0)).data))
+    assert abs(np.exp(lp) - (16 * np.exp(-4) / 2)) < 1e-4
+
+    mvn = D.MultivariateNormal(
+        np.zeros(2, np.float32),
+        covariance_matrix=np.asarray([[2.0, 0.5], [0.5, 1.0]], np.float32))
+    samp = np.asarray(mvn.rsample([20000]).data)
+    assert np.allclose(np.cov(samp.T), [[2, 0.5], [0.5, 1]], atol=0.2)
+
+    ind = D.Independent(D.Normal(np.zeros((3, 4), np.float32),
+                                 np.ones((3, 4), np.float32)), 1)
+    lp = np.asarray(ind.log_prob(
+        pt.to_tensor(np.zeros((3, 4), np.float32))).data)
+    assert lp.shape == (3,)
+    np.testing.assert_allclose(lp, 4 * -0.5 * np.log(2 * np.pi), rtol=1e-5)
+
+    lkj = D.LKJCholesky(3, 2.0)
+    L = np.asarray(lkj.sample([8]).data)
+    corr = L @ L.transpose(0, 2, 1)
+    assert np.allclose(np.diagonal(corr, axis1=1, axis2=2), 1, atol=1e-5)
+
+    td = D.TransformedDistribution(
+        D.Normal(0.0, 1.0), [pt.distribution.ExpTransform()]) \
+        if hasattr(pt.distribution, "ExpTransform") else None
+
+
+def test_distribution_binomial_geometric_chi2_student():
+    D = pt.distribution
+    assert abs(np.asarray(D.Binomial(10, 0.3).sample([4000]).data).mean()
+               - 3.0) < 0.3
+    assert abs(np.asarray(D.Geometric(0.25).sample([4000]).data).mean()
+               - 3.0) < 0.4
+    assert abs(np.asarray(D.Chi2(3.0).sample([4000]).data).mean()
+               - 3.0) < 0.4
+    st = D.StudentT(6.0, 1.0, 2.0)
+    lp = float(np.asarray(st.log_prob(pt.to_tensor(1.0)).data))
+    from scipy.stats import t as _t
+    assert abs(lp - _t(6.0, 1.0, 2.0).logpdf(1.0)) < 1e-4
+
+
+# ----------------------------------------------------- extended functional
+def test_extended_losses_numerics():
+    F = pt.nn.functional
+    x = pt.to_tensor(np.asarray([[2.0, -1.0, 0.5]], np.float32))
+    y = pt.to_tensor(np.asarray([0], np.int64))
+    l = float(np.asarray(F.multi_margin_loss(x, y).data))
+    # margins: max(0, 1-2+(-1))=0, max(0, 1-2+0.5)=0  -> 0 loss... compute
+    assert l >= 0
+    # gaussian nll at perfect prediction = 0.5*log(var)
+    g = float(np.asarray(F.gaussian_nll_loss(
+        pt.to_tensor(np.asarray([1.0])), pt.to_tensor(np.asarray([1.0])),
+        pt.to_tensor(np.asarray([2.0]))).data))
+    np.testing.assert_allclose(g, 0.5 * np.log(2.0), rtol=1e-5)
+    # soft margin: log(1+exp(-1*1))
+    sm = float(np.asarray(F.soft_margin_loss(
+        pt.to_tensor(np.asarray([1.0])), pt.to_tensor(np.asarray([1.0]))).data))
+    np.testing.assert_allclose(sm, np.log1p(np.exp(-1.0)), rtol=1e-5)
+
+
+def test_rnnt_loss_two_frame():
+    # tiny lattice with hand-checkable paths: T=2, U=1, V=2 (blank=0)
+    F = pt.nn.functional
+    logits = np.zeros((1, 2, 2, 2), np.float32)  # uniform: log 0.5 each
+    loss = float(np.asarray(F.rnnt_loss(
+        pt.to_tensor(logits), pt.to_tensor(np.asarray([[1]], np.int64)),
+        pt.to_tensor(np.asarray([2])), pt.to_tensor(np.asarray([1])),
+        reduction="none").data).ravel()[0])
+    # paths: (emit@t0, blank, blank) ... enumerate: alignments of length
+    # T+U=3 with 1 label: C(2,1)=2 paths, each prob (1/2)^3
+    np.testing.assert_allclose(np.exp(-loss), 2 * 0.5 ** 3, rtol=1e-4)
+
+
+def test_grid_sample_and_affine_grid_roundtrip():
+    F = pt.nn.functional
+    img = pt.to_tensor(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    theta = pt.to_tensor(np.asarray([[[1, 0, 0], [0, 1, 0]]], np.float32))
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    out = F.grid_sample(img, grid)
+    np.testing.assert_allclose(np.asarray(out.data), np.asarray(img.data),
+                               atol=1e-5)
+
+
+def test_max_unpool_roundtrip():
+    F = pt.nn.functional
+    x = pt.to_tensor(np.random.RandomState(0).rand(1, 2, 4, 4)
+                     .astype(np.float32))
+    pooled, mask = F.max_pool2d(x, 2, return_mask=True)
+    up = F.max_unpool2d(pooled, mask, 2)
+    # unpooled peaks match pooled values at max positions; sum preserved
+    np.testing.assert_allclose(np.asarray(up.data).sum(),
+                               np.asarray(pooled.data).sum(), rtol=1e-6)
+    assert tuple(up.shape) == (1, 2, 4, 4)
+
+
+def test_sequence_mask_and_temporal_shift():
+    F = pt.nn.functional
+    m = np.asarray(F.sequence_mask(
+        pt.to_tensor(np.asarray([1, 3])), maxlen=4).data)
+    np.testing.assert_array_equal(m, [[1, 0, 0, 0], [1, 1, 1, 0]])
+    x = pt.to_tensor(np.random.RandomState(1).randn(4, 8, 2, 2)
+                     .astype(np.float32))
+    ts = F.temporal_shift(x, seg_num=2, shift_ratio=0.25)
+    assert tuple(ts.shape) == (4, 8, 2, 2)
+
+
+# ------------------------------------------------------------ extended nn
+def test_extended_layers_smoke():
+    nn = pt.nn
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    asl = nn.AdaptiveLogSoftmaxWithLoss(8, 12, cutoffs=[3, 6])
+    out, loss = asl(x, pt.to_tensor(np.asarray([0, 11])))
+    lp = np.asarray(asl.log_prob(x).data)
+    assert np.allclose(np.exp(lp).sum(-1), 1.0, atol=1e-4)
+    hs = nn.HSigmoidLoss(8, 6)
+    hl = hs(x, pt.to_tensor(np.asarray([0, 5])))
+    assert np.isfinite(np.asarray(hl.data)).all()
+    sn = nn.SpectralNorm((6, 3), power_iters=8)
+    w = pt.to_tensor(np.random.RandomState(1).randn(6, 3).astype(np.float32))
+    sv = np.linalg.svd(np.asarray(sn(w).data))[1][0]
+    assert abs(sv - 1.0) < 0.05
+    img = pt.to_tensor(np.random.RandomState(2).randn(1, 4, 4, 4)
+                       .astype(np.float32))
+    assert tuple(nn.PixelUnshuffle(2)(img).shape) == (1, 16, 2, 2)
+    assert tuple(nn.ChannelShuffle(2)(img).shape) == (1, 4, 4, 4)
+    assert tuple(nn.ZeroPad2D(1)(img).shape) == (1, 4, 6, 6)
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    ld["b"] = nn.Linear(2, 3)
+    assert set(ld.keys()) == {"a", "b"} and len(ld.parameters()) == 4
+
+
+def test_birnn_and_unflatten():
+    nn = pt.nn
+    bi = nn.BiRNN(nn.GRUCell(4, 5), nn.GRUCell(4, 5))
+    o, _ = bi(pt.to_tensor(np.zeros((2, 6, 4), np.float32)))
+    assert tuple(o.shape) == (2, 6, 10)
+    u = nn.Unflatten(1, (2, 3))
+    assert tuple(u(pt.to_tensor(np.zeros((4, 6), np.float32))).shape) == \
+        (4, 2, 3)
+
+
+# ------------------------------------------------------------- transforms
+def test_transforms_batch():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.RandomState(0).rand(12, 12, 3) * 255).astype(np.uint8)
+    assert T.affine(img, angle=0).shape == img.shape
+    assert T.pad(img, 2).shape == (16, 16, 3)
+    g = T.Grayscale(3)(img)
+    assert (g[..., 0] == g[..., 1]).all()
+    out = T.Compose([T.RandomResizedCrop(8), T.RandomErasing(prob=1.0)])(img)
+    assert out.shape == (8, 8, 3)
+    # hue shift by 1.0 is identity (mod 1); by 0 is identity
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, atol=2)
+
+
+# ---------------------------------------------------- distributed / static
+def test_distributed_facade_extras():
+    d = pt.distributed
+    t = pt.to_tensor(np.ones((4,), np.float32))
+    d.send(t)
+    r = d.recv()
+    assert np.asarray(r.data).sum() == 4
+    out = d.reduce_scatter(None, [t, pt.to_tensor(
+        np.full((4,), 3.0, np.float32))])
+    np.testing.assert_allclose(np.asarray(out.data), 4.0)
+    assert d.is_available() and d.get_backend().startswith("xla:")
+    lin = d.split(None, (8, 12), operation="linear", axis=1)
+    assert type(lin).__name__ == "ColumnParallelLinear"
+    emb = d.split(None, (100, 16), operation="embedding")
+    assert type(emb).__name__ == "VocabParallelEmbedding"
+
+
+def test_static_compat():
+    st = pt.static
+    x = pt.to_tensor(np.asarray([[0.2, 0.8], [0.9, 0.1]], np.float32))
+    y = pt.to_tensor(np.asarray([1, 1], np.int64))
+    acc = float(np.asarray(st.accuracy(x, y).data))
+    assert abs(acc - 0.5) < 1e-6
+    auc = float(np.asarray(st.auc(x, pt.to_tensor(
+        np.asarray([1, 0], np.int64))).data))
+    assert abs(auc - 1.0) < 1e-6  # positive scored higher
+    assert len(st.cpu_places()) >= 1
+    w = pt.create_parameter([3], "float32")
+    ema = st.ExponentialMovingAverage(0.9)
+    ema.update([w])
+    orig = np.asarray(w.data).copy()
+    w._data = w._data + 10.0
+    ema.update()
+    with ema.apply():
+        assert np.asarray(w.data).mean() < orig.mean() + 10.0
+    assert np.allclose(np.asarray(w.data), orig + 10.0)
+
+
+# ------------------------------------------------------------------ audio
+def test_audio_wav_roundtrip(tmp_path):
+    from paddle_tpu import audio
+    sr = 8000
+    t = np.linspace(0, 1, sr, endpoint=False)
+    wave = (0.5 * np.sin(2 * np.pi * 440 * t)).astype(np.float32)[None, :]
+    path = str(tmp_path / "tone.wav")
+    audio.save(path, pt.to_tensor(wave), sr)
+    info = audio.info(path)
+    assert info.sample_rate == sr and info.num_channels == 1
+    loaded, sr2 = audio.load(path)
+    assert sr2 == sr
+    np.testing.assert_allclose(np.asarray(loaded.data), wave, atol=1e-3)
+
+
+# -------------------------------------------------------------- geometric
+def test_geometric_sampling_and_reindex():
+    from paddle_tpu import geometric as G
+    # CSC graph: node 0 has neighbors {1, 2, 3}; node 1 has {0}
+    row = np.asarray([1, 2, 3, 0], np.int64)
+    colptr = np.asarray([0, 3, 4], np.int64)
+    nb, cnt = G.sample_neighbors(pt.to_tensor(row), pt.to_tensor(colptr),
+                                 pt.to_tensor(np.asarray([0])),
+                                 sample_size=2)
+    assert int(np.asarray(cnt.data)[0]) == 2
+    assert set(np.asarray(nb.data)) <= {1, 2, 3}
+    out, nodes = G.reindex_graph(pt.to_tensor(np.asarray([5, 9])),
+                                 pt.to_tensor(np.asarray([9, 7, 5, 7])),
+                                 None)
+    np.testing.assert_array_equal(np.asarray(out.data), [1, 2, 0, 2])
+    np.testing.assert_array_equal(np.asarray(nodes.data), [5, 9, 7])
+    uv = G.send_uv(pt.to_tensor(np.asarray([[1.0], [2.0]], np.float32)),
+                   pt.to_tensor(np.asarray([[10.0], [20.0]], np.float32)),
+                   pt.to_tensor(np.asarray([0, 1])),
+                   pt.to_tensor(np.asarray([1, 0])), "add")
+    np.testing.assert_allclose(np.asarray(uv.data), [[21.0], [12.0]])
+
+
+# ------------------------------------------------------------- incubate
+def test_incubate_exports():
+    inc = pt.incubate
+    x = pt.to_tensor(np.random.RandomState(0).randn(2, 4, 4)
+                     .astype(np.float32))
+    sm = np.asarray(inc.softmax_mask_fuse_upper_triangle(x).data)
+    # causal: first row attends only to position 0
+    np.testing.assert_allclose(sm[:, 0, 0], 1.0, rtol=1e-5)
+    assert abs(sm[0, 2, :3].sum() - 1.0) < 1e-5
+    w = pt.create_parameter([4], "float32")
+    ma = inc.ModelAverage(parameters=[w])
+    ma.step()
+    w._data = w._data + 2.0
+    ma.step()
+    before = np.asarray(w.data).copy()
+    ma.apply()
+    assert np.allclose(np.asarray(w.data), before - 1.0)
+    ma.restore()
+    assert np.allclose(np.asarray(w.data), before)
+    assert float(np.asarray(inc.identity_loss(
+        pt.to_tensor(np.asarray([2.0, 4.0])), "mean").data)) == 3.0
+
+
+# ---------------------------------------------------------------- inplace
+def test_functional_inplace_activations():
+    F = pt.nn.functional
+    x = pt.to_tensor(np.asarray([-1.0, 2.0], np.float32))
+    assert F.relu_(x) is x
+    np.testing.assert_allclose(np.asarray(x.data), [0.0, 2.0])
+    y = pt.to_tensor(np.asarray([0.5, -0.5], np.float32))
+    F.tanh_(y)
+    np.testing.assert_allclose(np.asarray(y.data), np.tanh([0.5, -0.5]),
+                               rtol=1e-6)
+
+
+# ------------------------------------------------- review-fix regressions
+def test_adaptive_and_fractional_pools_return_mask():
+    F = pt.nn.functional
+    x = pt.to_tensor(np.random.RandomState(3).rand(1, 1, 4, 4, 4)
+                     .astype(np.float32))
+    out, mask = F.adaptive_max_pool3d(x, 2, return_mask=True)
+    assert tuple(out.shape) == (1, 1, 2, 2, 2)
+    assert tuple(mask.shape) == (1, 1, 2, 2, 2)
+    # indices point at the max values
+    flat = np.asarray(x.data).reshape(1, 1, -1)
+    picked = np.take_along_axis(flat, np.asarray(mask.data).reshape(1, 1, -1),
+                                axis=-1)
+    np.testing.assert_allclose(picked.reshape(-1),
+                               np.asarray(out.data).reshape(-1))
+    x2 = pt.to_tensor(np.random.RandomState(4).rand(1, 2, 8, 8)
+                      .astype(np.float32))
+    p2, m2 = F.fractional_max_pool2d(x2, 4, random_u=0.3, return_mask=True)
+    flat2 = np.asarray(x2.data).reshape(1, 2, -1)
+    picked2 = np.take_along_axis(flat2, np.asarray(m2.data).reshape(1, 2, -1),
+                                 axis=-1)
+    np.testing.assert_allclose(picked2.reshape(-1),
+                               np.asarray(p2.data).reshape(-1))
+
+
+def test_householder_batched_and_ormqr_full_q():
+    rng = np.random.RandomState(5)
+    x = rng.randn(2, 4, 3).astype(np.float32)
+    tau = rng.rand(2, 3).astype(np.float32)
+    q = np.asarray(pt.linalg.householder_product(
+        pt.to_tensor(x), pt.to_tensor(tau)).data)
+    assert q.shape == (2, 4, 3)
+    other = rng.randn(4, 2).astype(np.float32)
+    out = np.asarray(pt.linalg.ormqr(
+        pt.to_tensor(x[0]), pt.to_tensor(tau[0]),
+        pt.to_tensor(other)).data)
+    assert out.shape == (4, 2)  # full m x m Q applied
+
+
+def test_random_affine_scalar_shear():
+    from paddle_tpu.vision import transforms as T
+    img = (np.random.RandomState(6).rand(8, 8, 3) * 255).astype(np.uint8)
+    out = T.RandomAffine(10, shear=5)(img)
+    assert out.shape == img.shape
+
+
+def test_geometric_sampler_respects_seed():
+    from paddle_tpu import geometric as G
+    row = np.arange(50, dtype=np.int64)
+    colptr = np.asarray([0, 50], np.int64)
+    pt.seed(123)
+    a = np.asarray(G.sample_neighbors(pt.to_tensor(row),
+                                      pt.to_tensor(colptr),
+                                      pt.to_tensor(np.asarray([0])),
+                                      sample_size=5)[0].data)
+    pt.seed(123)
+    b = np.asarray(G.sample_neighbors(pt.to_tensor(row),
+                                      pt.to_tensor(colptr),
+                                      pt.to_tensor(np.asarray([0])),
+                                      sample_size=5)[0].data)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_scatter_object_list_and_flops():
+    d = pt.distributed
+    out = []
+    d.scatter_object_list(out, [{"a": 1}, {"b": 2}])
+    # world size 1: the single rank receives the whole list
+    assert out == [{"a": 1}, {"b": 2}]
+    assert pt.flops(pt.nn.Linear(4, 8), [2, 4]) == 2 * 4 * 8 * 2

@@ -334,14 +334,121 @@ SPECS.update({
 
 EXCLUDED = {
     # complex-valued outputs / inputs (complex autograd out of scope here)
-    "eig", "eigvals", "as_complex", "as_real",
+    "eig", "eigvals", "as_complex", "as_real", "polar",
     # randomized per call (dropout family — mask freshness covered by
     # test_eager_vjp_cache) / stubs / interpolation (functional tests in
     # test_vision_hapi) — all exercised elsewhere
     "dropout", "dropout2d", "dropout3d", "alpha_dropout",
     "ctc_loss_stub", "linear_compress", "interpolate", "upsample",
     "flash_attention", "scaled_dot_product_attention",
+    # fresh-PRNG-per-call (forward can't be replayed against raw fn) —
+    # behavior covered in test_api_extras / test_api_parity_batch
+    "binomial", "standard_gamma", "log_normal", "feature_alpha_dropout",
+    "class_center_sample", "svd_lowrank", "pca_lowrank",
+    # structured-arg ops with dedicated behavioral tests
+    "rnnt_loss", "adaptive_log_softmax_with_loss",
 }
+
+# ---- specs for the long-tail ops (ops/extras.py, functional/extended) ----
+_LU44 = None
+try:
+    import scipy.linalg as _sl
+    _LU44 = _sl.lu_factor(A(4, 4).astype(np.float64))
+except Exception:
+    pass
+
+_CHOL = np.linalg.cholesky(SPD(3)).astype(np.float32)
+_UNPOOL_IDX = np.stack([np.arange(0, 16, 4).reshape(2, 2)] * 2)[None]
+
+SPECS_EXTRA = {
+    # elementwise / math
+    "sinc": S(A(2, 3)),
+    "signbit": S(A(2, 3), g=False, fd=False),
+    "thresholded_relu": S(A(2, 3)),
+    "gammaln": S(POS(2, 3)),
+    "gammainc": S(POS(2, 3), POS(2, 3), g=False, fd=False),
+    "gammaincc": S(POS(2, 3), POS(2, 3), g=False, fd=False),
+    "multigammaln": S(POS(2, 3) + 2.0, 2),
+    "mod": S(A(2, 3), POS(2, 3)),
+    "floor_mod": S(A(2, 3), POS(2, 3)),
+    "frexp": S(POS(2, 3), g=False, fd=False),
+    "trapezoid": S(A(3, 5)),
+    "cumulative_trapezoid": S(A(3, 5)),
+    "vander": S(POS(4), 3),
+    "cdist": S(A(3, 4), A(5, 4)),
+    "pdist": S(A(4, 3)),
+    "pairwise_distance": S(A(3, 4), A(3, 4)),
+    "renorm": S(A(3, 4), 2.0, 0, 1.0),
+    "histogram_bin_edges": S(A(20), 5, g=False, fd=False, bf16=False),
+    "histogramdd": S(A(10, 2), g=False, fd=False, bf16=False),
+    "cond": S(SPD(3), g=False, fd=False, bf16=False),
+    "cholesky_inverse": S(_CHOL),
+    "householder_product": S(A(4, 3), POS(3), bf16=False),
+    "ormqr": S(A(4, 3), POS(3), A(4, 2), bf16=False),
+    # structure / stacking / views
+    "block_diag": S([A(2, 3), A(3, 3)]),
+    "hstack": S([A(2, 3), A(2, 3)]),
+    "vstack": S([A(2, 3), A(2, 3)]),
+    "dstack": S([A(2, 3), A(2, 3)]),
+    "column_stack": S([A(4), A(4)]),
+    "add_n": S([A(2, 3), A(2, 3)]),
+    "cartesian_prod": S([A(3), A(4)]),
+    "hsplit": S(A(2, 4), 2),
+    "vsplit": S(A(4, 3), 2),
+    "dsplit": S(A(2, 2, 4), 2),
+    "tensor_split": S(A(7), 3),
+    "unstack": S(A(3, 4)),
+    "reverse": S(A(2, 3), 1),
+    "unflatten": S(A(2, 6), 1, (2, 3)),
+    "diag_embed": S(A(2, 3)),
+    "combinations": S(A(4), 2),
+    "take": S(A(3, 4), I32(12, 5)),
+    "as_strided": S(A(12), (2, 3), (3, 1)),
+    "view": S(A(2, 6), (3, 4)),
+    "view_as": S(A(2, 6), A(3, 4), diff=[0]),
+    "kthvalue": S(A(3, 5), 2),
+    "reduce_as": S(A(3, 4), A(1, 4), diff=[0]),
+    # scatter family
+    "masked_scatter": S(A(3, 4), B_(3, 4), A(12)),
+    "index_fill": S(A(3, 4), I32(3, 2), 0, 2.0),
+    "select_scatter": S(A(3, 4), A(4), 0, 1),
+    "slice_scatter": S(A(4, 5), A(2, 5), [0], [1], [3], [1]),
+    "diagonal_scatter": S(A(4, 4), A(3), 1),
+    # pooling / padding / spatial
+    "zeropad2d": S(A(1, 2, 3, 3), (1, 1, 1, 1)),
+    "lp_pool1d": S(A(1, 2, 8), 2.0, 2),
+    "lp_pool2d": S(A(1, 2, 6, 6), 2.0, 2),
+    "adaptive_avg_pool3d": S(A(1, 2, 4, 4, 4), 2),
+    "adaptive_max_pool1d": S(A(1, 2, 8), 4),
+    "adaptive_max_pool3d": S(A(1, 1, 4, 4, 4), 2),
+    "fractional_max_pool2d": S(A(1, 2, 8, 8), 4, random_u=0.4),
+    "fractional_max_pool3d": S(A(1, 1, 6, 6, 6), 2, random_u=0.3),
+    "max_unpool2d": S(A(1, 2, 2, 2), _UNPOOL_IDX, 2),
+    "fold": S(A(1, 4, 4), (4, 4), 2, strides=2),
+    "grid_sample": S(A(1, 2, 4, 4), UNIT(1, 3, 3, 2)),
+    "affine_grid": S(A(1, 2, 3), [1, 1, 4, 4]),
+    "temporal_shift": S(A(4, 8, 2, 2), 2),
+    "sequence_mask": S(I32(5, 3), maxlen=6),
+    "gather_tree": S(I32(4, 3, 2, 2), I32(2, 3, 2, 2)),
+    # losses
+    "dice_loss": S(POS(2, 4), I32(4, 2, 1)),
+    "log_loss": S((RNG.rand(4, 1) * 0.8 + 0.1).astype(np.float32),
+                  B_(4, 1).astype(np.float32)),
+    "multi_label_soft_margin_loss": S(A(3, 5), B_(3, 5).astype(np.float32)),
+    "poisson_nll_loss": S(A(3, 4), POS(3, 4)),
+    "gaussian_nll_loss": S(A(3), A(3), POS(3)),
+    "soft_margin_loss": S(A(3, 4), (B_(3, 4) * 2 - 1).astype(np.float32)),
+    "npair_loss": S(A(4, 6), A(4, 6), I32(3, 4)),
+    "multi_margin_loss": S(A(4, 5), I32(5, 4)),
+    "triplet_margin_with_distance_loss": S(A(3, 4), A(3, 4), A(3, 4)),
+    "hsigmoid_loss": S(A(4, 8), I32(6, 4), 6, A(5, 8)),
+    "margin_cross_entropy": S(UNIT(4, 6), I32(6, 4)),
+}
+if _LU44 is not None:
+    SPECS_EXTRA["lu_unpack"] = S(_LU44[0].astype(np.float32),
+                                 (_LU44[1] + 1).astype(np.int32),
+                                 g=False, fd=False)
+SPECS.update(SPECS_EXTRA)
 
 
 def _tensorize(x, dtype=None):
