@@ -91,6 +91,9 @@ class _Place:
     def __eq__(self, other):
         return type(self) is type(other) and self._id == other._id
 
+    def __hash__(self):
+        return hash((type(self).__name__, self._id))
+
 
 class CPUPlace(_Place):
     _platform = "cpu"

@@ -465,20 +465,29 @@ def hsigmoid_loss(input, label, num_classes, weight, bias=None,
     coding of num_classes leaves, depth ceil(log2(C)); custom trees via
     path_table/path_code."""
     if path_table is None:
-        # default tree: right-leaning chain with exactly C-1 internal
-        # nodes (the weight's row count in the reference's default
-        # mode). Class c < C-1 exits chain node c with code 1; the last
-        # class descends the whole chain with all-0 codes.
+        # default tree: complete binary tree over C leaves in heap
+        # layout — internal nodes are ids 0..C-2 (exactly the weight's
+        # C-1 rows), leaves are heap ids C-1..2C-2, so every path has
+        # depth <= ceil(log2(2C-1)) and table memory is O(C log C)
         C = num_classes
-        depth = C - 1
-        nodes = np.tile(np.arange(depth, dtype=np.int64), (C, 1))
+        paths = []
+        for c in range(C):
+            node = c + C - 1
+            path = []
+            while node:
+                parent = (node - 1) // 2
+                path.append((parent, node - (2 * parent + 1)))
+                node = parent
+            paths.append(path[::-1])
+        depth = max(len(pth) for pth in paths)
+        nodes = np.zeros((C, depth), np.int64)
         codes = np.zeros((C, depth), np.int64)
         mask = np.zeros((C, depth), np.float32)
-        for c in range(C):
-            plen = min(c + 1, depth)
-            mask[c, :plen] = 1.0
-            if c < C - 1:
-                codes[c, c] = 1
+        for c, pth in enumerate(paths):
+            for d, (n_id, bit) in enumerate(pth):
+                nodes[c, d] = n_id
+                codes[c, d] = bit
+                mask[c, d] = 1.0
         path_table = jnp.asarray(nodes)
         path_code = jnp.asarray(codes)
         path_mask = jnp.asarray(mask)
@@ -527,15 +536,21 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
     lax.scan over T rows (each row a scan over U) — O(T*U) sequential
     but fully differentiable through XLA; the kernel-free TPU shape.
 
+    FastEmit (Yu et al. 2021): the emit-arc log-probs are up-weighted
+    by (1 + fastemit_lambda) in the lattice, the loss-level reweighting
+    form of the regularizer (pushes probability mass toward earlier
+    emissions); fastemit_lambda=0 recovers the exact transducer NLL.
+
     input: [B, T, U+1, V] log-probs (unnormalized ok - log_softmax here).
     """
     logp = jax.nn.log_softmax(input, axis=-1)
     B, T, U1, V = logp.shape
+    emit_w = 1.0 + fastemit_lambda
 
     def one(lp, lab, t_len, u_len):
         # lp [T, U+1, V]; lab [U]
         blank_lp = lp[..., blank]                      # [T, U+1]
-        lab_lp = jnp.take_along_axis(
+        lab_lp = emit_w * jnp.take_along_axis(
             lp[:, :-1, :], lab[None, :, None], axis=-1)[..., 0]  # [T, U]
         neg = jnp.asarray(-1e30, lp.dtype)
 

@@ -308,15 +308,20 @@ class LinearLR(LRScheduler):
 
 class MultiplicativeDecay(LRScheduler):
     """lr = base_lr * prod(lr_lambda(i) for i in 1..epoch) (reference
-    optimizer/lr.py MultiplicativeDecay)."""
+    optimizer/lr.py MultiplicativeDecay). The running product is cached
+    so each step() costs one lr_lambda call, not O(epoch)."""
 
     def __init__(self, learning_rate, lr_lambda, last_epoch=-1,
                  verbose=False):
         self.lr_lambda = lr_lambda
+        self._prod_epoch = 0
+        self._prod = 1.0
         super().__init__(learning_rate, last_epoch, verbose)
 
     def get_lr(self):
-        lr = self.base_lr
-        for i in range(1, self.last_epoch + 1):
-            lr *= self.lr_lambda(i)
-        return lr
+        if self.last_epoch < self._prod_epoch:    # rewound (set_state)
+            self._prod_epoch, self._prod = 0, 1.0
+        while self._prod_epoch < self.last_epoch:
+            self._prod_epoch += 1
+            self._prod *= self.lr_lambda(self._prod_epoch)
+        return self.base_lr * self._prod

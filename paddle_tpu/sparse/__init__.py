@@ -194,6 +194,9 @@ def cast(x, index_dtype=None, value_dtype=None, name=None):
     vals = sp.data if value_dtype is None else sp.data.astype(value_dtype)
     idx = sp.indices if index_dtype is None else sp.indices.astype(
         index_dtype)
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(
+            jsparse.BCSR((vals, idx, sp.indptr), shape=sp.shape))
     return SparseCooTensor(jsparse.BCOO((vals, idx), shape=sp.shape))
 
 
@@ -203,9 +206,8 @@ def coalesce(x, name=None) -> "SparseCooTensor":
 
 
 def subtract(x, y, name=None):
-    return add(x, neg(y) if isinstance(y, SparseCooTensor) else
-               SparseCooTensor(jsparse.BCOO(
-                   (-_sp(y).data, _sp(y).indices), shape=_sp(y).shape)))
+    # neg() handles both COO and CSR; add() densifies mixed formats
+    return add(x, neg(y))
 
 
 def multiply(x, y, name=None) -> Tensor:

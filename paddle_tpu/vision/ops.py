@@ -35,6 +35,7 @@ from ..core.tensor import Tensor
 from .. import nn
 
 __all__ = ["nms", "matrix_nms", "roi_align", "roi_pool", "psroi_pool",
+           "yolo_loss", "generate_proposals",
            "box_coder", "prior_box", "yolo_box", "deform_conv2d",
            "DeformConv2D", "RoIAlign", "RoIPool", "PSRoIPool",
            "ConvNormActivation", "distribute_fpn_proposals"]
@@ -560,3 +561,198 @@ def read_file(*a, **k):
 
 
 decode_jpeg = read_file
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (reference ops.py:69 over phi yolov3_loss kernel):
+    coordinate + objectness + class terms with responsible-anchor
+    assignment and ignore-region masking. Registered through the op
+    registry so the eager tape differentiates it.
+
+    TPU shape: target assignment is a static einsum/argmax program over
+    [B, n_gt, na] IoU tables — no per-box host loops; the whole loss
+    jits. x [B, mask_na*(5+C), H, W]; gt_box [B, n_gt, 4] (x, y, w, h,
+    normalized); gt_label [B, n_gt]."""
+    from ..ops.registry import call_op
+
+    def impl(xv, gtb, gtl, gts):
+        return _yolo_loss_impl(xv, gtb, gtl, gts, anchors, anchor_mask,
+                               class_num, ignore_thresh, downsample_ratio,
+                               use_label_smooth, scale_x_y)
+
+    gs = gt_score if gt_score is not None else 1
+    return call_op("yolo_loss", impl, (x, gt_box, gt_label, gs), {})
+
+
+def _yolo_loss_impl(xv, gtb, gtl, gts, anchors, anchor_mask, class_num,
+                    ignore_thresh, downsample_ratio, use_label_smooth,
+                    scale_x_y):
+    xv = jnp.asarray(xv, jnp.float32)
+    gtb = jnp.asarray(gtb, jnp.float32)
+    gtl = jnp.asarray(gtl, jnp.int32)
+    gt_score = None if (isinstance(gts, int) and gts == 1) else gts
+    B, _, H, W = xv.shape
+    an_all = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+    amask = list(anchor_mask)
+    na = len(amask)
+    an = an_all[jnp.asarray(amask)]
+    p = xv.reshape(B, na, 5 + class_num, H, W)
+    input_size = downsample_ratio * H
+
+    valid = (gtb[..., 2] > 0) & (gtb[..., 3] > 0)          # [B, n_gt]
+
+    # --- responsible anchor per gt: best IoU of (0,0)-centered boxes
+    # against ALL anchors (reference semantics); the gt belongs to this
+    # head only when that anchor is in anchor_mask
+    gw = gtb[..., 2] * input_size
+    gh = gtb[..., 3] * input_size
+    inter = (jnp.minimum(gw[..., None], an_all[None, None, :, 0])
+             * jnp.minimum(gh[..., None], an_all[None, None, :, 1]))
+    union = (gw * gh)[..., None] + (an_all[:, 0] * an_all[:, 1]
+                                    )[None, None, :] - inter
+    best = jnp.argmax(inter / jnp.maximum(union, 1e-10), axis=-1)  # [B,n_gt]
+    mask_arr = jnp.asarray(amask)
+    local_a = jnp.argmax((best[..., None] == mask_arr[None, None, :])
+                         .astype(jnp.int32), axis=-1)
+    resp = valid & (best[..., None] == mask_arr[None, None, :]).any(-1)
+
+    gi = jnp.clip((gtb[..., 0] * W).astype(jnp.int32), 0, W - 1)
+    gj = jnp.clip((gtb[..., 1] * H).astype(jnp.int32), 0, H - 1)
+    # non-responsible (incl. zero-padded) gts must not scatter at all:
+    # route them out of bounds and let mode="drop" discard the update —
+    # otherwise a padded box writes zeros over a real target at (0,0,0)
+    gi = jnp.where(resp, gi, W)
+    gj = jnp.where(resp, gj, H)
+
+    # --- build dense targets by scatter over gt boxes
+    obj_tgt = jnp.zeros((B, na, H, W))
+    tx = jnp.zeros((B, na, H, W))
+    ty = jnp.zeros((B, na, H, W))
+    tw = jnp.zeros((B, na, H, W))
+    th = jnp.zeros((B, na, H, W))
+    tcls = jnp.zeros((B, na, class_num, H, W))
+    tscale = jnp.zeros((B, na, H, W))
+    bidx = jnp.broadcast_to(jnp.arange(B)[:, None], gi.shape)
+    sw = jnp.where(resp, 1.0, 0.0)
+    score = (jnp.where(resp, jnp.asarray(gt_score, jnp.float32), 0.0)
+             if gt_score is not None else sw)
+    obj_tgt = obj_tgt.at[bidx, local_a, gj, gi].max(score, mode="drop")
+    tx = tx.at[bidx, local_a, gj, gi].set(
+        jnp.where(resp, gtb[..., 0] * W - gi, 0.0), mode="drop")
+    ty = ty.at[bidx, local_a, gj, gi].set(
+        jnp.where(resp, gtb[..., 1] * H - gj, 0.0), mode="drop")
+    tw = tw.at[bidx, local_a, gj, gi].set(jnp.where(
+        resp, jnp.log(jnp.maximum(gw / jnp.maximum(an[local_a][..., 0],
+                                                   1e-10), 1e-9)), 0.0), mode="drop")
+    th = th.at[bidx, local_a, gj, gi].set(jnp.where(
+        resp, jnp.log(jnp.maximum(gh / jnp.maximum(an[local_a][..., 1],
+                                                   1e-10), 1e-9)), 0.0), mode="drop")
+    tscale = tscale.at[bidx, local_a, gj, gi].set(
+        jnp.where(resp, 2.0 - gtb[..., 2] * gtb[..., 3], 0.0), mode="drop")
+    smooth = (1.0 / max(class_num, 1) if use_label_smooth and class_num > 1
+              else 0.0)
+    onehot = jax.nn.one_hot(gtl, class_num) * (1 - smooth) + smooth / 2
+    tcls = tcls.at[bidx, local_a, :, gj, gi].set(
+        jnp.where(resp[..., None], onehot, 0.0), mode="drop")
+
+    # --- ignore mask: predictions overlapping any gt above threshold
+    sig = jax.nn.sigmoid
+    gx_grid = jnp.arange(W, dtype=jnp.float32)[None, None, None, :]
+    gy_grid = jnp.arange(H, dtype=jnp.float32)[None, None, :, None]
+    px = (sig(p[:, :, 0]) + gx_grid) / W
+    py = (sig(p[:, :, 1]) + gy_grid) / H
+    pw = jnp.exp(p[:, :, 2]) * an[None, :, 0, None, None] / input_size
+    ph = jnp.exp(p[:, :, 3]) * an[None, :, 1, None, None] / input_size
+    pb = jnp.stack([px - pw / 2, py - ph / 2, px + pw / 2, py + ph / 2], -1)
+    gb = jnp.stack([gtb[..., 0] - gtb[..., 2] / 2,
+                    gtb[..., 1] - gtb[..., 3] / 2,
+                    gtb[..., 0] + gtb[..., 2] / 2,
+                    gtb[..., 1] + gtb[..., 3] / 2], -1)  # [B, n_gt, 4]
+    lt = jnp.maximum(pb[..., None, :2], gb[:, None, None, None, :, :2])
+    rb = jnp.minimum(pb[..., None, 2:], gb[:, None, None, None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter2 = wh[..., 0] * wh[..., 1]
+    area_p = jnp.maximum((pb[..., 2] - pb[..., 0])
+                         * (pb[..., 3] - pb[..., 1]), 0)
+    area_g = jnp.maximum((gb[..., 2] - gb[..., 0])
+                         * (gb[..., 3] - gb[..., 1]), 0)
+    iou = inter2 / jnp.maximum(
+        area_p[..., None] + area_g[:, None, None, None, :] - inter2, 1e-10)
+    iou = jnp.where(valid[:, None, None, None, :], iou, 0.0)
+    ignore = (iou.max(-1) > ignore_thresh) & (obj_tgt <= 0)
+
+    # --- loss terms (bce = sigmoid cross entropy)
+    def bce(logit, target):
+        return jnp.maximum(logit, 0) - logit * target + jnp.log1p(
+            jnp.exp(-jnp.abs(logit)))
+
+    loss_xy = tscale * obj_tgt * (bce(p[:, :, 0], tx) + bce(p[:, :, 1], ty))
+    loss_wh = 0.5 * tscale * obj_tgt * ((p[:, :, 2] - tw) ** 2
+                                        + (p[:, :, 3] - th) ** 2)
+    obj_logit = p[:, :, 4]
+    loss_obj = (obj_tgt * bce(obj_logit, jnp.ones_like(obj_tgt))
+                + jnp.where(ignore, 0.0, 1.0) * (1 - obj_tgt)
+                * bce(obj_logit, jnp.zeros_like(obj_tgt)))
+    loss_cls = obj_tgt[:, :, None] * bce(p[:, :, 5:], tcls)
+    return (loss_xy.sum((1, 2, 3)) + loss_wh.sum((1, 2, 3))
+            + loss_obj.sum((1, 2, 3)) + loss_cls.sum((1, 2, 3, 4)))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False, name=None):
+    """RPN proposal generation (reference ops.py:2106 over phi
+    generate_proposals kernel): decode anchor deltas, clip, filter
+    small, NMS per image. scores [B, A, H, W]; bbox_deltas [B, 4A, H, W];
+    anchors [H, W, A, 4]; variances like anchors."""
+    sc = _arr(scores).astype(jnp.float32)
+    deltas = _arr(bbox_deltas).astype(jnp.float32)
+    anc = _arr(anchors).astype(jnp.float32).reshape(-1, 4)
+    var = _arr(variances).astype(jnp.float32).reshape(-1, 4)
+    imgs = _arr(img_size).astype(jnp.float32)
+    B, A, H, W = sc.shape
+    off = 1.0 if pixel_offset else 0.0
+
+    rois_out, num_out, scores_out = [], [], []
+    for b in range(B):
+        s = sc[b].transpose(1, 2, 0).reshape(-1)            # [H*W*A]
+        d = deltas[b].reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        # decode (variance-scaled center-size)
+        aw = anc[:, 2] - anc[:, 0] + off
+        ah = anc[:, 3] - anc[:, 1] + off
+        acx = anc[:, 0] + aw * 0.5
+        acy = anc[:, 1] + ah * 0.5
+        dv = d * var
+        cx = dv[:, 0] * aw + acx
+        cy = dv[:, 1] * ah + acy
+        wpred = jnp.exp(jnp.clip(dv[:, 2], -10, 10)) * aw
+        hpred = jnp.exp(jnp.clip(dv[:, 3], -10, 10)) * ah
+        boxes = jnp.stack([cx - wpred / 2, cy - hpred / 2,
+                           cx + wpred / 2 - off, cy + hpred / 2 - off], -1)
+        ih, iw = imgs[b, 0], imgs[b, 1]
+        boxes = jnp.stack([jnp.clip(boxes[:, 0], 0, iw - off),
+                           jnp.clip(boxes[:, 1], 0, ih - off),
+                           jnp.clip(boxes[:, 2], 0, iw - off),
+                           jnp.clip(boxes[:, 3], 0, ih - off)], -1)
+        keep_size = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                     & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        s = jnp.where(keep_size, s, -jnp.inf)
+        top = min(pre_nms_top_n, s.shape[0])
+        order = jnp.argsort(-s)[:top]
+        cand_boxes = np.asarray(boxes[order])
+        cand_scores = np.asarray(s[order])
+        ok = np.isfinite(cand_scores)
+        cand_boxes, cand_scores = cand_boxes[ok], cand_scores[ok]
+        keep = np.asarray(nms(cand_boxes, nms_thresh,
+                              scores=cand_scores).data)[:post_nms_top_n]
+        rois_out.append(cand_boxes[keep])
+        scores_out.append(cand_scores[keep][:, None])
+        num_out.append(len(keep))
+    rois = Tensor(jnp.asarray(np.concatenate(rois_out, 0)))
+    rscores = Tensor(jnp.asarray(np.concatenate(scores_out, 0)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(num_out, jnp.int32))
+    return rois, rscores

@@ -1,0 +1,98 @@
+"""yolo_loss + generate_proposals (closing paddle.vision.ops).
+
+Reference tests: test/legacy_test/test_yolov3_loss_op.py,
+test_generate_proposals_v2_op.py.
+"""
+import numpy as np
+
+import paddle_tpu as pt
+from paddle_tpu.vision import ops as V
+
+
+def test_yolo_loss_basic_properties():
+    rng = np.random.RandomState(0)
+    B, na, C, H = 2, 3, 4, 8
+    x = pt.to_tensor(rng.randn(B, na * (5 + C), H, H).astype(np.float32) * 0.1)
+    gt_box = np.zeros((B, 5, 4), np.float32)
+    gt_box[0, 0] = [0.5, 0.5, 0.3, 0.4]   # one real box in image 0
+    gt_label = np.zeros((B, 5), np.int32)
+    gt_label[0, 0] = 2
+    loss = V.yolo_loss(x, pt.to_tensor(gt_box), pt.to_tensor(gt_label),
+                       anchors=[10, 13, 16, 30, 33, 23],
+                       anchor_mask=[0, 1, 2], class_num=C,
+                       ignore_thresh=0.7, downsample_ratio=32)
+    v = np.asarray(loss.data)
+    assert v.shape == (B,)
+    assert np.isfinite(v).all() and (v > 0).all()
+    # the image with a gt box pays coordinate+class terms -> higher loss
+    assert v[0] > v[1]
+
+
+def test_yolo_loss_differentiable():
+    rng = np.random.RandomState(1)
+    B, na, C, H = 1, 3, 3, 4
+    x = pt.to_tensor(rng.randn(B, na * (5 + C), H, H).astype(np.float32) * 0.1)
+    x.stop_gradient = False
+    gt_box = np.zeros((B, 2, 4), np.float32)
+    gt_box[0, 0] = [0.4, 0.6, 0.2, 0.2]
+    gt_label = np.zeros((B, 2), np.int32)
+    loss = V.yolo_loss(x, pt.to_tensor(gt_box), pt.to_tensor(gt_label),
+                       anchors=[10, 13, 16, 30, 33, 23],
+                       anchor_mask=[0, 1, 2], class_num=C,
+                       ignore_thresh=0.7, downsample_ratio=32)
+    loss.sum().backward()
+    g = np.asarray(x._grad.data)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_yolo_loss_padding_invariant():
+    # trailing all-zero gt padding (standard fixed-n_gt batching) must
+    # not change the loss — regression for padded boxes clobbering the
+    # (0, 0, 0) target slot
+    rng = np.random.RandomState(7)
+    B, na, C, H = 1, 3, 3, 8
+    x = rng.randn(B, na * (5 + C), H, H).astype(np.float32) * 0.1
+    gt1 = np.zeros((B, 1, 4), np.float32)
+    gt1[0, 0] = [0.05, 0.05, 0.3, 0.4]   # center in cell (0, 0)
+    lb1 = np.full((B, 1), 2, np.int32)
+    gt2 = np.zeros((B, 6, 4), np.float32)
+    gt2[0, 0] = gt1[0, 0]
+    lb2 = np.zeros((B, 6), np.int32)
+    lb2[0, 0] = 2
+    kw = dict(anchors=[10, 13, 16, 30, 33, 23], anchor_mask=[0, 1, 2],
+              class_num=C, ignore_thresh=0.7, downsample_ratio=32)
+    l1 = float(np.asarray(V.yolo_loss(pt.to_tensor(x), pt.to_tensor(gt1),
+                                      pt.to_tensor(lb1), **kw).data)[0])
+    l2 = float(np.asarray(V.yolo_loss(pt.to_tensor(x), pt.to_tensor(gt2),
+                                      pt.to_tensor(lb2), **kw).data)[0])
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+def test_generate_proposals():
+    rng = np.random.RandomState(2)
+    B, A, H, W = 1, 3, 4, 4
+    scores = rng.rand(B, A, H, W).astype(np.float32)
+    deltas = rng.randn(B, 4 * A, H, W).astype(np.float32) * 0.1
+    # simple anchor grid: 16x16 boxes at stride 16
+    anchors = np.zeros((H, W, A, 4), np.float32)
+    for y in range(H):
+        for x in range(W):
+            for a in range(A):
+                size = 8 * (a + 1)
+                cx, cy = x * 16 + 8, y * 16 + 8
+                anchors[y, x, a] = [cx - size, cy - size, cx + size, cy + size]
+    variances = np.ones_like(anchors)
+    rois, rscores, num = V.generate_proposals(
+        pt.to_tensor(scores), pt.to_tensor(deltas),
+        pt.to_tensor(np.asarray([[64, 64]], np.float32)),
+        pt.to_tensor(anchors), pt.to_tensor(variances),
+        pre_nms_top_n=30, post_nms_top_n=10, nms_thresh=0.6,
+        min_size=2.0, return_rois_num=True)
+    r = np.asarray(rois.data)
+    n = int(np.asarray(num.data)[0])
+    assert r.shape == (n, 4) and 0 < n <= 10
+    # clipped to image bounds, valid boxes
+    assert (r[:, 0] >= 0).all() and (r[:, 2] <= 64).all()
+    assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
+    s = np.asarray(rscores.data).ravel()
+    assert (np.diff(s) <= 1e-6).all()  # score-descending
