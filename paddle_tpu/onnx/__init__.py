@@ -1,19 +1,10 @@
 """paddle.onnx namespace (reference: python/paddle/onnx/export.py via
-paddle2onnx). In this framework the portable deployment artifact is
-StableHLO (jit.save), which ONNX runtimes do not consume; export() saves
-the StableHLO artifact and says so rather than silently produce nothing.
+paddle2onnx). This build emits ONNX ModelProto directly in protobuf
+wire format (export.py) for Sequential-style models — Linear/Conv/BN/
+activation/pool chains, which covers the vision zoo — and falls back to
+the StableHLO artifact (jit.save) with a warning for graphs beyond that
+subset.
 """
 from __future__ import annotations
 
-
-def export(layer, path: str, input_spec=None, opset_version: int = 9,
-           **configs):
-    from .. import jit
-
-    jit.save(layer, path, input_spec=input_spec)
-    import warnings
-    warnings.warn(
-        "paddle_tpu has no paddle2onnx; exported StableHLO to "
-        f"{path}.pdmodel instead (load with paddle_tpu.inference or "
-        "jit.load)")
-    return path + ".pdmodel"
+from .export import export  # noqa: F401

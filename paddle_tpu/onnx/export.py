@@ -1,0 +1,314 @@
+"""Real ONNX export for layer chains.
+
+Reference: python/paddle/onnx/export.py (delegates to paddle2onnx — a
+full Program->ONNX compiler). This build has no onnx package, so the
+exporter emits ModelProto in protobuf wire format directly (_proto.py)
+for the layer types that cover the vision zoo and MLP-style models:
+Linear, Conv2D, BatchNorm1D/2D, ReLU/ReLU6/Sigmoid/Tanh/Softmax/GELU/
+LeakyReLU/Hardswish/Hardsigmoid, MaxPool2D, AvgPool2D,
+AdaptiveAvgPool2D (global), Flatten, Dropout (eval identity),
+PixelShuffle-free Sequential composition.
+
+Layer call order is recorded with forward hooks on a tracing run; the
+exporter requires a LINEAR chain (each layer consumes the previous
+layer's output — true for Sequential-style models) and raises for
+branching graphs, pointing at jit.save (StableHLO) for those.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from . import _proto as P
+
+# onnx.proto field numbers (public spec)
+_IR_VERSION = 8
+_OPSET = 13
+
+# TensorProto.DataType
+_F32 = 1
+_I64 = 7
+
+
+def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    dt = _F32 if arr.dtype != np.int64 else _I64
+    if dt == _F32:
+        arr = arr.astype(np.float32)
+    msg = b"".join([
+        *(P.field_varint(1, int(d)) for d in arr.shape),   # dims
+        P.field_varint(2, dt),                             # data_type
+        P.field_string(8, name),                           # name
+        P.field_bytes(9, arr.tobytes()),                   # raw_data
+    ])
+    return msg
+
+
+def _value_info(name: str, shape, elem=_F32) -> bytes:
+    dims = b"".join(
+        P.field_message(1, P.field_varint(1, int(d)) if d is not None
+                        else P.field_string(2, "N"))
+        for d in shape)
+    tensor_type = (P.field_varint(1, elem)
+                   + P.field_message(2, dims))              # shape
+    type_proto = P.field_message(1, tensor_type)            # tensor_type
+    return P.field_string(1, name) + P.field_message(2, type_proto)
+
+
+def _attr_int(name: str, v: int) -> bytes:
+    return (P.field_string(1, name) + P.field_varint(3, v)
+            + P.field_varint(20, 2))                        # type=INT
+
+
+def _attr_ints(name: str, vs) -> bytes:
+    return (P.field_string(1, name)
+            + b"".join(P.field_varint(8, int(v)) for v in vs)
+            + P.field_varint(20, 7))                        # type=INTS
+
+
+def _attr_float(name: str, v: float) -> bytes:
+    import struct
+    return (P.field_string(1, name)
+            + P._varint(2 << 3 | 5) + struct.pack("<f", v)
+            + P.field_varint(20, 1))                        # type=FLOAT
+
+
+def _node(op_type: str, inputs, outputs, attrs: List[bytes] = (),
+          name: str = "") -> bytes:
+    return b"".join([
+        *(P.field_string(1, i) for i in inputs),
+        *(P.field_string(2, o) for o in outputs),
+        P.field_string(3, name or outputs[0]),
+        P.field_string(4, op_type),
+        *(P.field_message(5, a) for a in attrs),
+    ])
+
+
+def _pair(v):
+    return (v, v) if isinstance(v, int) else tuple(v)
+
+
+_OP_MIN_OPSET = {"Gelu": 20, "HardSwish": 14}
+
+
+class _Emitter:
+    def __init__(self):
+        self.nodes: List[bytes] = []
+        self.inits: List[bytes] = []
+        self.counter = 0
+        self.min_opset = 7
+
+    def tname(self, base):
+        self.counter += 1
+        return f"{base}_{self.counter}"
+
+    def add_init(self, base, arr):
+        name = self.tname(base)
+        self.inits.append(_tensor_proto(name, np.asarray(arr)))
+        return name
+
+    def emit(self, layer, x_name: str) -> Optional[str]:
+        """Emit node(s) for `layer` consuming x_name; returns output
+        name, or None if the layer type is unsupported."""
+        from .. import nn
+        t = type(layer).__name__
+        out = self.tname(t.lower())
+        if isinstance(layer, nn.Linear):
+            w = self.add_init("weight", np.asarray(layer.weight.data))
+            ins = [x_name, w]
+            if layer.bias is not None:
+                ins.append(self.add_init("bias",
+                                         np.asarray(layer.bias.data)))
+            # our weight layout is [in, out] = Gemm's B untransposed
+            self.nodes.append(_node("Gemm", ins, [out]))
+            return out
+        if isinstance(layer, nn.Conv2D):
+            w = self.add_init("weight", np.asarray(layer.weight.data))
+            ins = [x_name, w]
+            if layer.bias is not None:
+                ins.append(self.add_init("bias",
+                                         np.asarray(layer.bias.data)))
+            st = _pair(layer.stride)
+            pa = layer.padding
+            if isinstance(pa, str):
+                return None  # SAME/VALID: shape math differs; use jit.save
+            if isinstance(pa, (tuple, list)) and len(pa) == 4:
+                # paddle [h_lo, h_hi, w_lo, w_hi] -> onnx [h0, w0, h1, w1]
+                pads = (pa[0], pa[2], pa[1], pa[3])
+            elif isinstance(pa, (tuple, list)) and len(pa) == 2 and \
+                    isinstance(pa[0], (tuple, list)):
+                pads = (pa[0][0], pa[1][0], pa[0][1], pa[1][1])
+            else:
+                ph, pw = _pair(pa)
+                pads = (ph, pw, ph, pw)
+            di = _pair(layer.dilation)
+            attrs = [_attr_ints("strides", st),
+                     _attr_ints("pads", pads),
+                     _attr_ints("dilations", di),
+                     _attr_int("group", layer.groups)]
+            self.nodes.append(_node("Conv", ins, [out], attrs))
+            return out
+        if isinstance(layer, (nn.BatchNorm1D, nn.BatchNorm2D)):
+            scale = self.add_init("scale", np.asarray(layer.weight.data))
+            bias = self.add_init("b", np.asarray(layer.bias.data))
+            mean = self.add_init("mean", np.asarray(layer._mean.data))
+            var = self.add_init("var", np.asarray(layer._variance.data))
+            self.nodes.append(_node(
+                "BatchNormalization", [x_name, scale, bias, mean, var],
+                [out], [_attr_float("epsilon", float(layer.epsilon))]))
+            return out
+        simple = {"ReLU": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh",
+                  "GELU": "Gelu", "Hardswish": "HardSwish",
+                  "Hardsigmoid": "HardSigmoid"}
+        if t in simple:
+            self.nodes.append(_node(simple[t], [x_name], [out]))
+            self.min_opset = max(self.min_opset, _OP_MIN_OPSET.get(
+                simple[t], 7))
+            return out
+        if t == "Softmax":
+            axis = getattr(layer, "_kwargs", {}).get("axis", -1)
+            self.nodes.append(_node("Softmax", [x_name], [out],
+                                    [_attr_int("axis", int(axis))]))
+            self.min_opset = max(self.min_opset, 13)  # axis semantics
+            return out
+        if t == "Flatten":
+            if getattr(layer, "stop_axis", -1) != -1:
+                return None  # ONNX Flatten has only a start axis
+            self.nodes.append(_node(
+                "Flatten", [x_name], [out],
+                [_attr_int("axis", int(getattr(layer, "start_axis", 1)))]))
+            return out
+        if t == "ReLU6":
+            self.nodes.append(_node("Clip", [
+                x_name, self.add_init("min", np.float32(0.0)),
+                self.add_init("max", np.float32(6.0))], [out]))
+            self.min_opset = max(self.min_opset, 11)  # min/max as inputs
+            return out
+        if t == "LeakyReLU":
+            alpha = getattr(layer, "_kwargs", {}).get("negative_slope", 0.01)
+            self.nodes.append(_node(
+                "LeakyRelu", [x_name], [out],
+                [_attr_float("alpha", float(alpha))]))
+            return out
+        if t in ("Dropout", "Dropout2D", "Dropout3D"):
+            self.nodes.append(_node("Identity", [x_name], [out]))
+            return out
+        if isinstance(layer, nn.MaxPool2D):
+            k = _pair(layer.kernel_size)
+            st = _pair(layer.stride if layer.stride is not None
+                       else layer.kernel_size)
+            pa = _pair(layer.padding)
+            self.nodes.append(_node(
+                "MaxPool", [x_name], [out],
+                [_attr_ints("kernel_shape", k), _attr_ints("strides", st),
+                 _attr_ints("pads", (pa[0], pa[1], pa[0], pa[1]))]))
+            return out
+        if isinstance(layer, nn.AvgPool2D):
+            k = _pair(layer.kernel_size)
+            st = _pair(layer.stride if layer.stride is not None
+                       else layer.kernel_size)
+            pa = _pair(layer.padding)
+            self.nodes.append(_node(
+                "AveragePool", [x_name], [out],
+                [_attr_ints("kernel_shape", k), _attr_ints("strides", st),
+                 _attr_ints("pads", (pa[0], pa[1], pa[0], pa[1]))]))
+            return out
+        if isinstance(layer, nn.AdaptiveAvgPool2D):
+            if tuple(np.atleast_1d(layer.output_size)) in ((1,), (1, 1)):
+                self.nodes.append(_node("GlobalAveragePool", [x_name],
+                                        [out]))
+                return out
+            return None
+        return None
+
+
+def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
+           **configs) -> str:
+    """Export a Sequential-style Layer to a real .onnx file.
+
+    Falls back to jit.save (StableHLO) with a warning when the model
+    contains layers or graph shapes the ONNX emitter doesn't cover —
+    deployment through inference.Config still works in that case.
+    """
+    from .. import nn, jit
+
+    if input_spec is None:
+        raise ValueError("onnx.export needs input_spec=[InputSpec(shape)] "
+                         "to trace the model")
+    spec = input_spec[0]
+    decl_shape = [d if (d or 0) > 0 else None for d in spec.shape]
+    shape = [d if d is not None else 1 for d in decl_shape]
+
+    # record call order with hooks on a tracing forward
+    calls = []
+    hooks = []
+
+    def rec(l, inputs, output):
+        calls.append((l, inputs, output))
+
+    for _, sub in layer.named_sublayers(include_self=False):
+        if not list(sub.sublayers()):
+            hooks.append(sub.register_forward_post_hook(rec))
+    import jax.numpy as jnp
+    from ..core.tensor import Tensor
+    was_training = layer.training
+    layer.eval()
+    x = Tensor(jnp.zeros(tuple(shape), jnp.float32))
+    try:
+        y = layer(x)
+    finally:
+        if was_training:
+            layer.train()
+        for h in hooks:
+            h.remove()
+
+    em = _Emitter()
+    in_name, out_name = "input", "input"
+    obj_to_name = {}
+    supported = True
+    for (l, inputs, output) in calls:
+        src = inputs[0] if isinstance(inputs, tuple) else inputs
+        # linear chain check: this layer must consume the previous output
+        if obj_to_name and id(src) not in obj_to_name:
+            supported = False
+            break
+        cur_in = obj_to_name.get(id(src), "input")
+        nm = em.emit(l, cur_in)
+        if nm is None:
+            supported = False
+            break
+        obj_to_name = {id(output): nm}
+        out_name = nm
+    if not supported or not calls:
+        import warnings
+        jit.save(layer, path, input_spec=input_spec)
+        warnings.warn(
+            "onnx.export covers Sequential-style chains of "
+            "Linear/Conv/BN/activation/pool layers; this model uses "
+            "other shapes — exported StableHLO to "
+            f"{path}.pdmodel instead (paddle_tpu.inference loads it)")
+        return path + ".pdmodel"
+
+    graph = b"".join([
+        *(P.field_message(1, n) for n in em.nodes),
+        P.field_string(2, type(layer).__name__),
+        *(P.field_message(5, t) for t in em.inits),
+        P.field_message(11, _value_info("input", decl_shape)),
+        P.field_message(12, _value_info(
+            out_name, [None if decl_shape[0] is None and i == 0 else int(d)
+                       for i, d in enumerate(np.shape(y.data))])),
+    ])
+    final_opset = max(opset_version, em.min_opset)
+    opset = P.field_string(1, "") + P.field_varint(2, final_opset)
+    model = b"".join([
+        P.field_varint(1, _IR_VERSION),
+        P.field_string(2, "paddle_tpu"),
+        P.field_string(3, "0.3"),
+        P.field_message(7, graph),
+        P.field_message(8, opset),
+    ])
+    out_path = path if path.endswith(".onnx") else path + ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(model)
+    return out_path
