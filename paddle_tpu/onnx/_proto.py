@@ -39,11 +39,6 @@ def field_message(num: int, encoded: bytes) -> bytes:
     return field_bytes(num, encoded)
 
 
-def field_packed_varints(num: int, values) -> bytes:
-    body = b"".join(_varint(v) for v in values)
-    return field_bytes(num, body)
-
-
 # -- reader (for round-trip tests) -----------------------------------------
 
 def parse(buf: bytes) -> List[Tuple[int, int, Union[int, bytes]]]:

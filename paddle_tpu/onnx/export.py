@@ -67,6 +67,11 @@ def _attr_ints(name: str, vs) -> bytes:
             + P.field_varint(20, 7))                        # type=INTS
 
 
+def _attr_string(name: str, v: str) -> bytes:
+    return (P.field_string(1, name) + P.field_bytes(4, v.encode())
+            + P.field_varint(20, 3))                        # type=STRING
+
+
 def _attr_float(name: str, v: float) -> bytes:
     import struct
     return (P.field_string(1, name)
@@ -90,6 +95,21 @@ def _pair(v):
 
 
 _OP_MIN_OPSET = {"Gelu": 20, "HardSwish": 14}
+
+
+def _onnx_pads(pa):
+    """paddle padding spec -> onnx pads (h0, w0, h1, w1); None when the
+    spec (string SAME/VALID) has no static equivalent."""
+    if isinstance(pa, str):
+        return None
+    if isinstance(pa, (tuple, list)) and len(pa) == 4:
+        # paddle [h_lo, h_hi, w_lo, w_hi] -> onnx [h0, w0, h1, w1]
+        return (pa[0], pa[2], pa[1], pa[3])
+    if isinstance(pa, (tuple, list)) and len(pa) == 2 and \
+            isinstance(pa[0], (tuple, list)):
+        return (pa[0][0], pa[1][0], pa[0][1], pa[1][1])
+    ph, pw = _pair(pa)
+    return (ph, pw, ph, pw)
 
 
 class _Emitter:
@@ -130,18 +150,9 @@ class _Emitter:
                 ins.append(self.add_init("bias",
                                          np.asarray(layer.bias.data)))
             st = _pair(layer.stride)
-            pa = layer.padding
-            if isinstance(pa, str):
+            pads = _onnx_pads(layer.padding)
+            if pads is None:
                 return None  # SAME/VALID: shape math differs; use jit.save
-            if isinstance(pa, (tuple, list)) and len(pa) == 4:
-                # paddle [h_lo, h_hi, w_lo, w_hi] -> onnx [h0, w0, h1, w1]
-                pads = (pa[0], pa[2], pa[1], pa[3])
-            elif isinstance(pa, (tuple, list)) and len(pa) == 2 and \
-                    isinstance(pa[0], (tuple, list)):
-                pads = (pa[0][0], pa[1][0], pa[0][1], pa[1][1])
-            else:
-                ph, pw = _pair(pa)
-                pads = (ph, pw, ph, pw)
             di = _pair(layer.dilation)
             attrs = [_attr_ints("strides", st),
                      _attr_ints("pads", pads),
@@ -150,8 +161,13 @@ class _Emitter:
             self.nodes.append(_node("Conv", ins, [out], attrs))
             return out
         if isinstance(layer, (nn.BatchNorm1D, nn.BatchNorm2D)):
-            scale = self.add_init("scale", np.asarray(layer.weight.data))
-            bias = self.add_init("b", np.asarray(layer.bias.data))
+            nf = layer.num_features
+            scale = self.add_init(
+                "scale", np.asarray(layer.weight.data)
+                if layer.weight is not None else np.ones(nf, np.float32))
+            bias = self.add_init(
+                "b", np.asarray(layer.bias.data)
+                if layer.bias is not None else np.zeros(nf, np.float32))
             mean = self.add_init("mean", np.asarray(layer._mean.data))
             var = self.add_init("var", np.asarray(layer._variance.data))
             self.nodes.append(_node(
@@ -159,12 +175,19 @@ class _Emitter:
                 [out], [_attr_float("epsilon", float(layer.epsilon))]))
             return out
         simple = {"ReLU": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh",
-                  "GELU": "Gelu", "Hardswish": "HardSwish",
-                  "Hardsigmoid": "HardSigmoid"}
+                  "Hardswish": "HardSwish", "Hardsigmoid": "HardSigmoid"}
         if t in simple:
             self.nodes.append(_node(simple[t], [x_name], [out]))
             self.min_opset = max(self.min_opset, _OP_MIN_OPSET.get(
                 simple[t], 7))
+            return out
+        if t == "GELU":
+            approx = getattr(layer, "_kwargs", {}).get("approximate", False)
+            self.nodes.append(_node(
+                "Gelu", [x_name], [out],
+                [_attr_string("approximate",
+                              "tanh" if approx else "none")]))
+            self.min_opset = max(self.min_opset, 20)
             return out
         if t == "Softmax":
             axis = getattr(layer, "_kwargs", {}).get("axis", -1)
@@ -194,25 +217,19 @@ class _Emitter:
         if t in ("Dropout", "Dropout2D", "Dropout3D"):
             self.nodes.append(_node("Identity", [x_name], [out]))
             return out
-        if isinstance(layer, nn.MaxPool2D):
+        if isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D)):
+            pads = _onnx_pads(layer.padding)
+            if pads is None:
+                return None  # string/SAME padding: use the StableHLO path
             k = _pair(layer.kernel_size)
             st = _pair(layer.stride if layer.stride is not None
                        else layer.kernel_size)
-            pa = _pair(layer.padding)
+            op = ("MaxPool" if isinstance(layer, nn.MaxPool2D)
+                  else "AveragePool")
             self.nodes.append(_node(
-                "MaxPool", [x_name], [out],
+                op, [x_name], [out],
                 [_attr_ints("kernel_shape", k), _attr_ints("strides", st),
-                 _attr_ints("pads", (pa[0], pa[1], pa[0], pa[1]))]))
-            return out
-        if isinstance(layer, nn.AvgPool2D):
-            k = _pair(layer.kernel_size)
-            st = _pair(layer.stride if layer.stride is not None
-                       else layer.kernel_size)
-            pa = _pair(layer.padding)
-            self.nodes.append(_node(
-                "AveragePool", [x_name], [out],
-                [_attr_ints("kernel_shape", k), _attr_ints("strides", st),
-                 _attr_ints("pads", (pa[0], pa[1], pa[0], pa[1]))]))
+                 _attr_ints("pads", pads)]))
             return out
         if isinstance(layer, nn.AdaptiveAvgPool2D):
             if tuple(np.atleast_1d(layer.output_size)) in ((1,), (1, 1)):
@@ -247,9 +264,10 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
     def rec(l, inputs, output):
         calls.append((l, inputs, output))
 
-    for _, sub in layer.named_sublayers(include_self=False):
-        if not list(sub.sublayers()):
-            hooks.append(sub.register_forward_post_hook(rec))
+    leaves = [sub for _, sub in layer.named_sublayers(include_self=True)
+              if not list(sub.sublayers())]
+    for sub in leaves:
+        hooks.append(sub.register_forward_post_hook(rec))
     import jax.numpy as jnp
     from ..core.tensor import Tensor
     was_training = layer.training
@@ -264,13 +282,20 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
             h.remove()
 
     em = _Emitter()
-    in_name, out_name = "input", "input"
+    out_name = "input"
     obj_to_name = {}
-    supported = True
-    for (l, inputs, output) in calls:
+    supported = bool(calls)
+    for ci, (l, inputs, output) in enumerate(calls):
         src = inputs[0] if isinstance(inputs, tuple) else inputs
-        # linear chain check: this layer must consume the previous output
-        if obj_to_name and id(src) not in obj_to_name:
+        # linear chain check: the FIRST layer must consume the traced
+        # input itself and every later layer the previous output —
+        # otherwise functional pre/inter-processing in forward() would
+        # be silently dropped from the graph
+        if ci == 0:
+            if src is not x:
+                supported = False
+                break
+        elif id(src) not in obj_to_name:
             supported = False
             break
         cur_in = obj_to_name.get(id(src), "input")
@@ -280,6 +305,10 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
             break
         obj_to_name = {id(output): nm}
         out_name = nm
+    # the model's return value must BE the last layer's output, or
+    # forward() post-processing would be dropped
+    if supported and id(y) not in obj_to_name:
+        supported = False
     if not supported or not calls:
         import warnings
         jit.save(layer, path, input_spec=input_spec)

@@ -97,6 +97,54 @@ def test_export_dynamic_batch_opset_and_attrs(tmp_path):
     assert abs(struct.unpack("<f", raw)[0] - 0.2) < 1e-6
 
 
+def test_export_falls_back_for_functional_pre_post(tmp_path):
+    # functional math in forward() outside hooked layers must NOT be
+    # silently dropped — the exporter falls back to StableHLO
+    class Pre(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = pt.nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x / 255.0)
+
+    class Post(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = pt.nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x) * 2.0
+
+    for name, m in [("pre", Pre()), ("post", Post())]:
+        with pytest.warns(UserWarning):
+            out = pt.onnx.export(m, str(tmp_path / name),
+                                 input_spec=[InputSpec([1, 4])])
+        assert out.endswith(".pdmodel"), name
+
+
+def test_export_leaf_and_affineless_bn(tmp_path):
+    out = pt.onnx.export(pt.nn.Linear(4, 8), str(tmp_path / "leaf"),
+                         input_spec=[InputSpec([1, 4])])
+    assert out.endswith(".onnx")
+    assert _op_types(open(out, "rb").read()) == ["Gemm"]
+    m = pt.nn.Sequential(
+        pt.nn.Conv2D(3, 4, 1),
+        pt.nn.BatchNorm2D(4, weight_attr=False, bias_attr=False))
+    out = pt.onnx.export(m, str(tmp_path / "bn"),
+                         input_spec=[InputSpec([1, 3, 4, 4])])
+    assert out.endswith(".onnx")
+
+
+def test_export_string_pool_padding_falls_back(tmp_path):
+    m = pt.nn.Sequential(pt.nn.Conv2D(3, 4, 3, padding="SAME"),
+                         pt.nn.ReLU())
+    with pytest.warns(UserWarning):
+        out = pt.onnx.export(m, str(tmp_path / "same"),
+                             input_spec=[InputSpec([1, 3, 8, 8])])
+    assert out.endswith(".pdmodel")
+
+
 def test_export_falls_back_for_branching(tmp_path):
     from paddle_tpu.vision.models import resnet18
     m = resnet18(num_classes=4)  # residual adds -> not a linear chain
