@@ -38,19 +38,23 @@ ihfft = _w(jnp.fft.ihfft)
 def _hermitian_nd(base_1d, last_fn, x, s=None, axes=None, norm="backward",
                   name=None):
     """hfft2/hfftn-style transforms: full FFT over all axes but the
-    last, hermitian transform on the last (reference fft.py hfftn)."""
-    import numpy as _np
+    last, hermitian transform on the last (reference fft.py hfftn).
+    For the inverse family the hermitian step runs FIRST — its input
+    must be real (rfft under the hood); the separable axes commute."""
     d = x.data if hasattr(x, "data") else jnp.asarray(x)
     nd = d.ndim
     axes = tuple(range(nd)) if axes is None else tuple(a % nd for a in axes)
     head, last = axes[:-1], axes[-1]
-    if head:
-        d = jnp.fft.fftn(d, s=None if s is None else s[:-1], axes=head,
-                         norm=norm) if base_1d == "h" else \
-            jnp.fft.ifftn(d, s=None if s is None else s[:-1], axes=head,
-                          norm=norm)
     n_last = None if s is None else s[-1]
-    out = last_fn(d, n=n_last, axis=last, norm=norm)
+    s_head = None if s is None else s[:-1]
+    if base_1d == "h":
+        if head:
+            d = jnp.fft.fftn(d, s=s_head, axes=head, norm=norm)
+        out = last_fn(d, n=n_last, axis=last, norm=norm)
+    else:
+        out = last_fn(d, n=n_last, axis=last, norm=norm)
+        if head:
+            out = jnp.fft.ifftn(out, s=s_head, axes=head, norm=norm)
     return Tensor(out)
 
 

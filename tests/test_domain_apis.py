@@ -118,3 +118,18 @@ def test_send_u_recv_message_passing():
     dst = pt.to_tensor(np.array([1, 2, 0], np.int32))
     out = pt.geometric.send_u_recv(x, src, dst, reduce_op="sum").numpy()
     np.testing.assert_allclose(out, [[4.], [1.], [2.]])
+
+
+def test_hfft_family_matches_numpy():
+    rng = np.random.RandomState(5)
+    x = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype(np.complex64)
+    got = pt.fft.hfft2(pt.to_tensor(x)).numpy()
+    ref = np.fft.fftn(x, axes=(0,))
+    ref = np.fft.hfft(ref, axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-3)
+    r = rng.randn(4, 6).astype(np.float32)
+    got_i = pt.fft.ihfft2(pt.to_tensor(r)).numpy()
+    ref_i = np.fft.ifftn(np.fft.ihfft(r, axis=1), axes=(0,))
+    np.testing.assert_allclose(got_i, ref_i, rtol=1e-4, atol=1e-4)
+    gotn = pt.fft.hfftn(pt.to_tensor(x)).numpy()
+    np.testing.assert_allclose(gotn, ref, rtol=1e-4, atol=1e-3)
