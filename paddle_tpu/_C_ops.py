@@ -11,7 +11,9 @@ from __future__ import annotations
 
 
 def __getattr__(name: str):
-    from .ops import registry
+    from .ops.registry import OPS
+    if name in OPS:
+        return OPS[name].wrapper
     import paddle_tpu
     fn = getattr(paddle_tpu, name, None)
     if fn is None:
@@ -23,5 +25,5 @@ def __getattr__(name: str):
 
 
 def __dir__():
-    import paddle_tpu
-    return [k for k in dir(paddle_tpu.ops) if not k.startswith("_")]
+    from .ops.registry import OPS
+    return sorted(OPS)

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import importlib
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from .core.tensor import Tensor, Parameter
 from .core import dtype as _dtype_mod

@@ -41,9 +41,14 @@ def _hermitian_nd(base_1d, last_fn, x, s=None, axes=None, norm="backward",
     last, hermitian transform on the last (reference fft.py hfftn).
     For the inverse family the hermitian step runs FIRST — its input
     must be real (rfft under the hood); the separable axes commute."""
-    d = x.data if hasattr(x, "data") else jnp.asarray(x)
+    d = _u(x)
     nd = d.ndim
-    axes = tuple(range(nd)) if axes is None else tuple(a % nd for a in axes)
+    if axes is None:
+        # paddle semantics: with s given, transform the LAST len(s) axes
+        n_axes = nd if s is None else len(s)
+        axes = tuple(range(nd - n_axes, nd))
+    else:
+        axes = tuple(a % nd for a in axes)
     head, last = axes[:-1], axes[-1]
     n_last = None if s is None else s[-1]
     s_head = None if s is None else s[:-1]

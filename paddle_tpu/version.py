@@ -1,0 +1,31 @@
+"""paddle.version (reference: generated python/paddle/version/__init__.py)."""
+from __future__ import annotations
+
+full_version = "0.3.0"
+major = "0"
+minor = "3"
+patch = "0"
+rc = "0"
+commit = "tpu-native"
+with_pip_cuda_libraries = "OFF"
+cuda_version = "False"
+cudnn_version = "False"
+tensorrt_version = None
+xpu_version = "False"
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}); "
+          "accelerator: TPU via JAX/XLA")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False
+
+
+def xpu():
+    return False

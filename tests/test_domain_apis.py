@@ -133,3 +133,12 @@ def test_hfft_family_matches_numpy():
     np.testing.assert_allclose(got_i, ref_i, rtol=1e-4, atol=1e-4)
     gotn = pt.fft.hfftn(pt.to_tensor(x)).numpy()
     np.testing.assert_allclose(gotn, ref, rtol=1e-4, atol=1e-3)
+
+
+def test_hfftn_s_shorter_than_ndim():
+    # s=[n] transforms only the last len(s) axes (paddle semantics)
+    rng = np.random.RandomState(6)
+    x = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype(np.complex64)
+    got = pt.fft.hfftn(pt.to_tensor(x), s=[8]).numpy()
+    ref = np.fft.hfft(x, n=8, axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-3)
