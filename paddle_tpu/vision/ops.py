@@ -113,14 +113,18 @@ def matrix_nms(bboxes, scores, score_threshold, post_threshold=0.0,
             s_s, b_s = s[order], bb[bi][order]
             iou = _box_iou_matrix(b_s, b_s)
             upper = jnp.triu(iou, k=1)  # [i,j]: overlap of higher i on j
-            max_over = upper.max(axis=0)          # per box: worst overlap
-            comp = upper.max(axis=1)              # compensation term
+            # compensation for row i = its own worst overlap with anything
+            # scored above it, i.e. the COLUMN max (matrix_nms_kernel.cc:120
+            # iou_max); decay_score (:70,:77) then divides/exponentiates
+            # per (pair iou, row compensation) and the column min wins
+            comp = upper.max(axis=0)
             if use_gaussian:
-                decay = jnp.exp(-(upper ** 2 - comp[:, None] ** 2)
-                                / gaussian_sigma).min(axis=0)
+                decay = jnp.exp((comp[:, None] ** 2 - upper ** 2)
+                                * gaussian_sigma).min(axis=0)
             else:
                 decay = ((1 - upper) / jnp.maximum(1 - comp[:, None], 1e-10)
                          ).min(axis=0)
+            decay = jnp.minimum(decay, 1.0)
             dec_s = s_s * decay * valid[order]
             keepm = dec_s > post_threshold
             k_idx = np.nonzero(np.asarray(keepm))[0]
@@ -172,7 +176,14 @@ def _rois_to_batch(boxes, boxes_num, B):
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (reference ops.py:1705 over roi_align_kernel.cu):
-    average of bilinear samples on a regular grid inside each bin."""
+    average of bilinear samples on a regular grid inside each bin.
+
+    ``sampling_ratio=-1`` approximation: the reference samples
+    ``ceil(roi_size/output_size)`` points per bin — a data-dependent
+    count that would force dynamic shapes under XLA. This build uses a
+    fixed 2x2 grid instead (exact for RoIs up to 2x the output grid;
+    coarser sampling, not wrong values, beyond that). Pass an explicit
+    ``sampling_ratio`` for a denser static grid."""
     feat = _arr(x)
     rois = _arr(boxes).astype(jnp.float32)
     B, C, H, W = feat.shape

@@ -338,23 +338,33 @@ def erase(img, i, j, h, w, v, inplace=False):
     return out
 
 
-def _warp(img, minv, fill=0):
-    """Inverse-map warp with bilinear sampling; minv maps OUTPUT (x, y)
-    homogeneous coords to INPUT coords."""
+def _warp(img, minv, fill=0, out_size=None, interpolation="bilinear"):
+    """Inverse-map warp with bilinear/nearest sampling; minv maps OUTPUT
+    (x, y) homogeneous coords to INPUT coords. out_size=(oh, ow) sets the
+    output canvas (defaults to the input's)."""
     ih, iw = img.shape[:2]
-    ys, xs = np.mgrid[0:ih, 0:iw].astype(np.float32)
+    oh, ow = out_size if out_size is not None else (ih, iw)
+    ys, xs = np.mgrid[0:oh, 0:ow].astype(np.float32)
     ones = np.ones_like(xs)
     coords = np.stack([xs, ys, ones], 0).reshape(3, -1)
     src = minv @ coords
-    sx = (src[0] / src[2]).reshape(ih, iw)
-    sy = (src[1] / src[2]).reshape(ih, iw)
+    sx = (src[0] / src[2]).reshape(oh, ow)
+    sy = (src[1] / src[2]).reshape(oh, ow)
+    if interpolation == "nearest":
+        # floor(x+0.5), not np.round: banker's rounding combs half-pixel
+        # coords (PIL/cv2 nearest round half up)
+        sx, sy = np.floor(sx + 0.5), np.floor(sy + 0.5)
+    elif interpolation != "bilinear":
+        raise ValueError(
+            f"unsupported interpolation {interpolation!r}: this build "
+            "implements 'nearest' and 'bilinear'")
     x0 = np.floor(sx)
     y0 = np.floor(sy)
     lx, ly = sx - x0, sy - y0
     im = img.astype(np.float32)
     if im.ndim == 2:
         im = im[:, :, None]
-    out = np.zeros_like(im)
+    out = np.zeros((oh, ow, im.shape[2]), np.float32)
     for dy, wy in ((0, 1 - ly), (1, ly)):
         for dx, wx in ((0, 1 - lx), (1, lx)):
             xi = x0 + dx
@@ -364,7 +374,6 @@ def _warp(img, minv, fill=0):
             yi = np.clip(yi, 0, ih - 1).astype(np.int64)
             w = (wy * wx * ok)[..., None]
             out += np.where(ok[..., None], im[yi, xi], fill) * w
-    miss = np.zeros((ih, iw), bool)
     oob = (sx < -0.5) | (sx > iw - 0.5) | (sy < -0.5) | (sy > ih - 0.5)
     out[oob] = fill
     if img.ndim == 2:
@@ -373,12 +382,14 @@ def _warp(img, minv, fill=0):
         np.clip(out, 0, 255).astype(np.uint8)
 
 
-def _affine_inv_matrix(angle, translate, scale, shear, center):
+def _affine_fwd_matrix(angle, translate, scale, shear, center):
+    """Forward map for a CLOCKWISE ``angle`` (the affine() convention;
+    reference functional.py:642)."""
     a = np.deg2rad(angle)
     sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
     cx, cy = center
     tx, ty = translate
-    # forward: T(center) R S Sh T(-center) + translate; invert it
+    # forward: T(center) R S Sh T(-center) + translate
     rot = np.asarray([[np.cos(a + sy), -np.sin(a + sx), 0],
                       [np.sin(a + sy), np.cos(a + sx), 0],
                       [0, 0, 1]], np.float64)
@@ -386,8 +397,7 @@ def _affine_inv_matrix(angle, translate, scale, shear, center):
     to_c = np.asarray([[1, 0, cx], [0, 1, cy], [0, 0, 1]], np.float64)
     from_c = np.asarray([[1, 0, -cx], [0, 1, -cy], [0, 0, 1]], np.float64)
     tr = np.asarray([[1, 0, tx], [0, 1, ty], [0, 0, 1]], np.float64)
-    fwd = tr @ to_c @ rot @ sc @ from_c
-    return np.linalg.inv(fwd)
+    return tr @ to_c @ rot @ sc @ from_c
 
 
 def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
@@ -395,13 +405,32 @@ def affine(img, angle=0.0, translate=(0, 0), scale=1.0, shear=(0.0, 0.0),
     h, w = img.shape[:2]
     center = center or ((w - 1) / 2.0, (h - 1) / 2.0)
     shear = shear if isinstance(shear, (list, tuple)) else (shear, 0.0)
-    return _warp(img, _affine_inv_matrix(angle, translate, scale, shear,
-                                         center), fill)
+    fwd = _affine_fwd_matrix(angle, translate, scale, shear, center)
+    return _warp(img, np.linalg.inv(fwd), fill,
+                 interpolation=interpolation)
 
 
 def rotate(img, angle, interpolation="nearest", expand=False, center=None,
            fill=0):
-    return affine(img, angle=angle, fill=fill, center=center)
+    # angle is COUNTER-clockwise (reference functional.py:778), the
+    # opposite of affine()'s clockwise convention
+    h, w = img.shape[:2]
+    center = center or ((w - 1) / 2.0, (h - 1) / 2.0)
+    fwd = _affine_fwd_matrix(-angle, (0, 0), 1.0, (0.0, 0.0), center)
+    if not expand:
+        return _warp(img, np.linalg.inv(fwd), fill,
+                     interpolation=interpolation)
+    # expand: canvas grows to the rotated image's bounding box
+    corners = np.asarray([[0, 0, 1], [w - 1, 0, 1],
+                          [0, h - 1, 1], [w - 1, h - 1, 1]], np.float64).T
+    mapped = fwd @ corners
+    cx, cy = mapped[0] / mapped[2], mapped[1] / mapped[2]
+    ow = int(np.ceil(cx.max() - cx.min())) + 1
+    oh = int(np.ceil(cy.max() - cy.min())) + 1
+    shift = np.asarray([[1, 0, cx.min()], [0, 1, cy.min()], [0, 0, 1]],
+                       np.float64)
+    return _warp(img, np.linalg.inv(fwd) @ shift, fill, out_size=(oh, ow),
+                 interpolation=interpolation)
 
 
 def perspective(img, startpoints, endpoints, interpolation="bilinear",
@@ -414,7 +443,7 @@ def perspective(img, startpoints, endpoints, interpolation="bilinear",
     b = np.asarray([c for pt in endpoints for c in pt], np.float64)
     coef = np.linalg.lstsq(np.asarray(A, np.float64), b, rcond=None)[0]
     fwd = np.append(coef, 1.0).reshape(3, 3)
-    return _warp(img, np.linalg.inv(fwd), fill)
+    return _warp(img, np.linalg.inv(fwd), fill, interpolation=interpolation)
 
 
 # -- class transforms -------------------------------------------------------
@@ -472,12 +501,16 @@ class RandomRotation(BaseTransform):
                  center=None, fill=0, keys=None):
         self.degrees = (degrees if isinstance(degrees, (list, tuple))
                         else (-degrees, degrees))
+        self.interpolation = interpolation
+        self.expand = expand
         self.center = center
         self.fill = fill
 
     def _apply_image(self, img):
         angle = np.random.uniform(*self.degrees)
-        return rotate(img, angle, center=self.center, fill=self.fill)
+        return rotate(img, angle, interpolation=self.interpolation,
+                      expand=self.expand, center=self.center,
+                      fill=self.fill)
 
 
 class RandomAffine(BaseTransform):
@@ -487,9 +520,13 @@ class RandomAffine(BaseTransform):
                         else (-degrees, degrees))
         self.translate = translate
         self.scale = scale
-        self.shear = (shear if shear is None or
-                      isinstance(shear, (list, tuple))
-                      else (-shear, shear))
+        if shear is not None and not isinstance(shear, (list, tuple)):
+            shear = (-shear, shear)
+        if shear is not None and len(shear) not in (2, 4):
+            raise ValueError("shear must be a number or a 2- or 4-element "
+                             f"sequence, got {shear!r}")
+        self.shear = shear  # 2 elems: x-range; 4: x-range + y-range
+        self.interpolation = interpolation
         self.fill = fill
         self.center = center
 
@@ -501,9 +538,14 @@ class RandomAffine(BaseTransform):
             tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
             ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
         sc = (np.random.uniform(*self.scale) if self.scale else 1.0)
-        sh = (np.random.uniform(*self.shear) if self.shear else 0.0)
+        sh_x = sh_y = 0.0
+        if self.shear is not None:
+            sh_x = np.random.uniform(self.shear[0], self.shear[1])
+            if len(self.shear) == 4:
+                sh_y = np.random.uniform(self.shear[2], self.shear[3])
         return affine(img, angle=angle, translate=(tx, ty), scale=sc,
-                      shear=(sh, 0.0), fill=self.fill, center=self.center)
+                      shear=(sh_x, sh_y), interpolation=self.interpolation,
+                      fill=self.fill, center=self.center)
 
 
 class RandomPerspective(BaseTransform):
@@ -511,6 +553,8 @@ class RandomPerspective(BaseTransform):
                  interpolation="bilinear", fill=0, keys=None):
         self.prob = prob
         self.scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
 
     def _apply_image(self, img):
         if np.random.rand() >= self.prob:
@@ -525,7 +569,8 @@ class RandomPerspective(BaseTransform):
         bl = (np.random.uniform(0, d * w / 2),
               h - 1 - np.random.uniform(0, d * h / 2))
         start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
-        return perspective(img, start, [tl, tr, br, bl])
+        return perspective(img, start, [tl, tr, br, bl],
+                           interpolation=self.interpolation, fill=self.fill)
 
 
 class RandomResizedCrop(BaseTransform):

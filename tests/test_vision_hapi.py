@@ -54,6 +54,28 @@ def test_resize_aspect_and_exact():
     assert T.Resize((16, 24))(img).shape == (16, 24, 3)
 
 
+def test_rotate_expand_keeps_whole_image():
+    img = np.full((20, 40, 3), 200, np.uint8)
+    out = T.rotate(img, 45, expand=True)
+    # 45-deg bbox of a 40x20 canvas: ~ (40+20)/sqrt(2) ≈ 42.4 each side
+    assert out.shape[0] > 40 and out.shape[1] > 40
+    # all original mass is retained: fill is 0, content is 200
+    assert (np.asarray(out, np.int64) > 0).sum() >= 20 * 40 * 3
+    # non-expanding keeps the canvas and crops the corners
+    crop = T.rotate(img, 45, expand=False)
+    assert crop.shape == img.shape
+    assert (np.asarray(crop, np.int64) > 0).sum() < 20 * 40 * 3
+
+
+def test_rotate_90_expand_exact_transpose():
+    # reference convention (functional.py:778): positive angle is
+    # COUNTER-clockwise, i.e. np.rot90's default direction
+    img = (np.arange(12 * 8 * 3) % 251).astype(np.uint8).reshape(12, 8, 3)
+    out = T.rotate(img, 90, expand=True, interpolation="bilinear")
+    assert out.shape == (8, 12, 3)
+    np.testing.assert_array_equal(out, np.rot90(img))
+
+
 def test_accuracy_metric():
     m = Accuracy(topk=(1, 2))
     pred = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1], [0.1, 0.2, 0.7]])

@@ -73,6 +73,53 @@ def test_matrix_nms_runs_and_filters():
     assert int(np.asarray(num.data)[0]) == out.shape[0]
 
 
+def _matrix_nms_ref(boxes, scores, post_threshold, sigma, use_gaussian):
+    """Sequential transcript of matrix_nms_kernel.cc NMSMatrix (:120-151):
+    iou_max[i] = max overlap with higher-scored boxes; decay for box i =
+    min over higher j of decay_score(iou(i,j), iou_max[j], sigma)."""
+    order = list(np.argsort(-scores))
+    iou_max, out = {}, {}
+    for rank, i in enumerate(order):
+        ious = [_iou(boxes[i], boxes[order[r]]) for r in range(rank)]
+        iou_max[i] = max(ious, default=0.0)
+        decay = 1.0
+        for r, v in enumerate(ious):
+            m = iou_max[order[r]]
+            if use_gaussian:
+                d = np.exp((m * m - v * v) * sigma)
+            else:
+                d = (1.0 - v) / (1.0 - m)
+            decay = min(decay, d)
+        ds = decay * scores[i]
+        if ds > post_threshold:
+            out[i] = ds
+    return out
+
+
+@pytest.mark.parametrize("use_gaussian", [False, True])
+def test_matrix_nms_decay_matches_reference_formula(use_gaussian):
+    # three heavily-overlapping boxes: suppression must be real, not a
+    # near no-op (round-3 ADVICE: wrong compensation axis cancelled decay)
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [2, 0, 12, 10],
+                        [30, 30, 40, 40], [0, 3, 10, 13]],
+                       np.float32)[None]
+    scores = np.asarray([0.9, 0.8, 0.7, 0.6, 0.5], np.float32)[None, None]
+    sigma = 2.0
+    out, idx, num = V.matrix_nms(
+        pt.to_tensor(boxes), pt.to_tensor(scores), score_threshold=0.0,
+        post_threshold=0.05, use_gaussian=use_gaussian,
+        gaussian_sigma=sigma, background_label=-1, return_index=True)
+    got = {int(i): float(s) for i, s in
+           zip(np.asarray(idx.data), np.asarray(out.data)[:, 1])}
+    want = _matrix_nms_ref(boxes[0], scores[0, 0], 0.05, sigma,
+                           use_gaussian)
+    assert set(got) == set(want)
+    for i in got:
+        np.testing.assert_allclose(got[i], want[i], rtol=1e-5)
+    # the overlapped boxes really decayed
+    assert got[1] < 0.8 * 0.7 and got[2] < 0.7 * 0.8
+
+
 def test_roi_align_linear_field_exact():
     # bilinear sampling of a LINEAR field f(y,x)=y+x is exact, and the
     # mean over a bin's sample grid equals f at the bin center — so
