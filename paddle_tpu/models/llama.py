@@ -62,6 +62,13 @@ class LlamaConfig:
     # error instead of silently falling back to dense — the bench runs
     # this), False/"dense"
     use_flash_attention: Any = True
+    # fused rmsnorm/rope pallas kernels between the GEMMs
+    # (ops/pallas/fused_norm_rope; counterpart of the reference's
+    # fused_rms_norm/fused_rope fusion kernels). "auto": on when running
+    # on TPU with an unsharded (tp=cp=1) layer body — the pallas calls
+    # are not GSPMD-partitionable, so a sharded stream would all-gather.
+    # True/"pallas": always (interpret mode off-TPU). False: never.
+    use_fused_norm_rope: Any = "auto"
     # context parallelism: "none" | "ring" | "ulysses" — shards the
     # sequence dim over the mesh cp axis (parallel/context_parallel.py)
     context_parallel: str = "none"
@@ -191,7 +198,25 @@ def attention(q, k, v, cfg: LlamaConfig):
     return _fa(q, k, v, causal=True, impl="dense")
 
 
-def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None):
+def _fused_nr_on(cfg: LlamaConfig, mesh) -> bool:
+    """Whether the fused pallas rmsnorm/rope kernels replace the jnp
+    formulations in the layer body (see LlamaConfig.use_fused_norm_rope)."""
+    v = getattr(cfg, "use_fused_norm_rope", "auto")
+    if v in (False, "off", "dense"):
+        return False
+    if v in (True, "pallas"):
+        return True
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    unsharded = mesh is None or (mesh.shape.get("tp", 1) == 1
+                                 and mesh.shape.get("cp", 1) == 1)
+    return on_tpu and unsharded
+
+
+def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None,
+           fused_nr=False):
     """The transformer block math shared by the training path
     (decoder_layer) and the KV-cache decode path (forward_with_cache):
     rms_norm -> QKV -> rope -> ``attn_fn(q, k, v)`` -> o-proj+residual ->
@@ -199,11 +224,19 @@ def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None):
     is the only thing the two paths vary."""
     B, T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    if fused_nr:
+        from ..ops.pallas.fused_norm_rope import fused_rms_norm, fused_rope
+        norm = lambda x, w: fused_rms_norm(x, w, cfg.rms_norm_eps)
+    else:
+        norm = lambda x, w: rms_norm(x, w, cfg.rms_norm_eps)
+    x = norm(h, lp["attn_norm"])
     q = (x @ lp["wq"]).reshape(B, T, H, Dh)
     k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
     v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
-    q, k = rope(q, k, positions, cfg.rope_theta, Dh)
+    if fused_nr:
+        q, k = fused_rope(q, k, positions, cfg.rope_theta)
+    else:
+        q, k = rope(q, k, positions, cfg.rope_theta, Dh)
     o = attn_fn(q, k, v)
     # tag for remat policies: lets a save_only_these_names policy keep the
     # kernel output so backward recompute skips the flash forward (the
@@ -242,8 +275,11 @@ def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
     (unstacked) weights."""
     B, T, _ = h.shape
     positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    # sp_spec set means the residual stream is sequence-sharded — the
+    # pallas kernels would force an all-gather there, so stay unfused
+    fused_nr = _fused_nr_on(cfg, mesh) and sp_spec is None
     return _block(lp, h, positions, cfg, _train_attn_fn(cfg, mesh),
-                  sp_spec=sp_spec)
+                  sp_spec=sp_spec, fused_nr=fused_nr)
 
 
 def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False,
@@ -275,7 +311,11 @@ def forward(params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
         h = lax.with_sharding_constraint(h, sp_spec)
     h = _scan_layers(params["layers"], h, cfg, sp_spec, remat=cfg.remat,
                      mesh=mesh)
-    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    if _fused_nr_on(cfg, mesh) and sp_spec is None:
+        from ..ops.pallas.fused_norm_rope import fused_rms_norm
+        h = fused_rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    else:
+        h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     return h @ params["lm_head"]
 
 

@@ -203,15 +203,20 @@ def fused_rms_norm(x, weight, eps: float = 1e-5, tile_n: int = 256):
     """RMSNorm over the last dim of ``x [..., D]``, fused fwd+bwd."""
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
-    tn = tile_n if x2.shape[0] % tile_n == 0 else _row_tile(x2.shape[0])
+    tn = _row_tile(x2.shape[0], x2.shape[1], tile_n)
     out, _ = _rms_fwd_call(x2, weight, float(eps), tn,
                            interpret=not _on_tpu())
     return out.reshape(shape)
 
 
-def _row_tile(n: int) -> int:
+def _row_tile(n: int, d: int, cap: int = 256) -> int:
+    """Largest row tile that divides ``n`` AND keeps the kernel's live
+    f32 [tile, d] windows inside scoped vmem. The bwd kernel holds ~6 of
+    them; at 3 MB/window (tile*d*4B) the measured peak stays under the
+    16 MB scope (tile 256 at D=4096 = 4 MB/window blows it)."""
+    budget = max(3_000_000 // (4 * d), 8)
     for t in (256, 128, 64, 32, 16, 8, 4, 2):
-        if n % t == 0:
+        if t <= cap and t <= budget and n % t == 0:
             return t
     return 1
 
@@ -219,7 +224,7 @@ def _row_tile(n: int) -> int:
 def _rms_fwd(x, weight, eps, tile_n):
     shape = x.shape
     x2 = x.reshape(-1, shape[-1])
-    tn = tile_n if x2.shape[0] % tile_n == 0 else _row_tile(x2.shape[0])
+    tn = _row_tile(x2.shape[0], x2.shape[1], tile_n)
     out, rstd = _rms_fwd_call(x2, weight, float(eps), tn,
                               interpret=not _on_tpu())
     return out.reshape(shape), (x2, weight, rstd, shape)
@@ -228,7 +233,7 @@ def _rms_fwd(x, weight, eps, tile_n):
 def _rms_bwd(eps, tile_n, res, g):
     x2, weight, rstd, shape = res
     g2 = g.reshape(-1, shape[-1])
-    tn = tile_n if x2.shape[0] % tile_n == 0 else _row_tile(x2.shape[0])
+    tn = _row_tile(x2.shape[0], x2.shape[1], tile_n)
     dx, dw = _rms_bwd_call(x2, weight, rstd, g2, float(eps), tn,
                            interpret=not _on_tpu())
     return dx.reshape(shape), dw.astype(weight.dtype)

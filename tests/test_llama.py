@@ -28,6 +28,29 @@ def test_forward_shapes():
     assert logits.shape == (2, 16, cfg.vocab_size)
 
 
+def test_fused_norm_rope_path_matches_unfused():
+    # the bench path runs the pallas fused rmsnorm/rope between GEMMs
+    # (interpret mode here); it must agree with the jnp formulation
+    cfg_f = _cfg(use_fused_norm_rope=True)
+    cfg_u = _cfg(use_fused_norm_rope=False)
+    params = L.init_params(cfg_f, jax.random.PRNGKey(0))
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                              cfg_f.vocab_size)
+
+    def loss(p, cfg):
+        lg = L.forward(p, toks, cfg)
+        return (lg.astype(jnp.float32) ** 2).mean()
+
+    lf, gf = jax.value_and_grad(loss)(params, cfg_f)
+    lu, gu = jax.value_and_grad(loss)(params, cfg_u)
+    np.testing.assert_allclose(float(lf), float(lu), rtol=2e-5)
+    flat_f = jax.tree_util.tree_leaves(gf)
+    flat_u = jax.tree_util.tree_leaves(gu)
+    for a, b in zip(flat_f, flat_u):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-5)
+
+
 def test_pipeline_matches_single_stage():
     """forward_pipelined (pp=2, 2 microbatches) == forward (pp=1)."""
     hm = init_hybrid_mesh(dp=2, pp=2, tp=2, set_global=False)

@@ -38,13 +38,15 @@ def main():
     B = int(sys.argv[6]) if len(sys.argv) > 6 else 4
     T = int(sys.argv[7]) if len(sys.argv) > 7 else 2048
     ffn = int(sys.argv[8]) if len(sys.argv) > 8 else 4 * hidden
-    remat = len(sys.argv) > 9 and sys.argv[9] == "remat"
+    flags = set(sys.argv[9:])
+    remat = "remat" in flags
 
     cfg = L.LlamaConfig(
         vocab_size=32000, hidden_size=hidden, intermediate_size=ffn,
         num_hidden_layers=layers, num_attention_heads=heads,
         num_key_value_heads=kv, max_position_embeddings=T,
-        dtype=jnp.bfloat16, remat=remat, use_flash_attention=True)
+        dtype=jnp.bfloat16, remat=remat, use_flash_attention=True,
+        use_fused_norm_rope=False if "nofuse" in flags else "auto")
     hm = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
     with hm.mesh:
         batch = L.make_batch(cfg, batch_size=B, seq_len=T, mesh=hm.mesh)
