@@ -7,6 +7,7 @@ InputSpec (shared with jit) and nn re-exports; Program/Executor raise
 with guidance instead of silently half-working.
 """
 from ..jit import InputSpec  # noqa: F401
+from . import nn  # noqa: F401  (control-flow capture: cond/while_loop/...)
 
 
 def data(name, shape, dtype="float32", lod_level=0):

@@ -1,0 +1,275 @@
+"""paddle.static.nn — control-flow capture ops.
+
+Reference: python/paddle/static/nn/control_flow.py — ``cond`` (:1509),
+``while_loop`` (:682), ``case`` (:961), ``switch_case`` (:1084), backed
+by the PIR If/While instructions
+(paddle/fluid/framework/new_executor/instruction/).
+
+TPU-native redesign: the four APIs are registered ops over
+``lax.cond`` / ``lax.switch`` / ``lax.while_loop`` so that data-dependent
+control flow stays INSIDE the compiled program — under ``jit.to_static``
+a branch or loop lowers to one ``stablehlo.case`` / ``stablehlo.while``
+in a single module instead of breaking the graph. Three execution modes,
+matching how the reference's control-flow ops behave in each regime:
+
+  * eager — executes immediately (lax traces the branches, runs one);
+  * under the tape — ``cond``/``case``/``switch_case`` differentiate
+    through the taken branch (jax's native cond/switch vjp);
+    ``while_loop`` raises a clear error if gradients are required
+    (reverse-mode through a dynamic trip count is unbounded-memory —
+    use ``lax.scan`` via a bounded loop instead);
+  * under ``to_static`` — the op traces straight into the XLA module.
+
+Branch callables follow the reference's no-argument convention, so
+tensors they use are free variables. Capture walks the callables'
+closures/globals (``inspect.getclosurevars``) and lifts every Tensor —
+including Layer parameters one attribute-hop away — into op operands so
+gradients flow to them through the branch.
+"""
+from __future__ import annotations
+
+import inspect
+from contextlib import contextmanager
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..autograd import tape as _tape
+from ..core.tensor import Tensor
+from ..ops import registry as _registry
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+# ---------------------------------------------------------------------------
+# closure capture
+# ---------------------------------------------------------------------------
+
+def _iter_tensors(v, out, depth=0):
+    if isinstance(v, Tensor):
+        out.setdefault(id(v), v)
+        return
+    if depth >= 2:
+        return
+    if isinstance(v, (list, tuple)):
+        for x in v:
+            _iter_tensors(x, out, depth + 1)
+    elif isinstance(v, dict):
+        for x in v.values():
+            _iter_tensors(x, out, depth + 1)
+    else:
+        params = getattr(v, "parameters", None)
+        if callable(params) and hasattr(v, "state_dict"):  # a Layer
+            try:
+                for p in v.parameters():
+                    _iter_tensors(p, out, depth + 1)
+            except Exception:
+                pass
+
+
+def _captured_tensors(fns: Sequence[Callable]) -> List[Tensor]:
+    """Tensors referenced (but not passed) by the branch callables."""
+    seen: dict = {}
+    for fn in fns:
+        if fn is None or not callable(fn):
+            continue
+        try:
+            cv = inspect.getclosurevars(fn)
+        except TypeError:
+            continue
+        for scope in (cv.nonlocals, cv.globals):
+            for v in scope.values():
+                _iter_tensors(v, seen)
+    return list(seen.values())
+
+
+@contextmanager
+def _bind(tensors: List[Tensor], arrays):
+    """Temporarily swap each tensor's payload (so branch closures see the
+    op's traced operands instead of the eager values)."""
+    saved = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._data = s
+
+
+def _unwrap(out):
+    return jax.tree_util.tree_map(
+        lambda t: t.data if isinstance(t, Tensor) else t, out,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _as_scalar_pred(p):
+    if p.dtype != jnp.bool_:
+        p = p.astype(jnp.bool_)
+    return p.reshape(())
+
+
+# ---------------------------------------------------------------------------
+# cond
+# ---------------------------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run ``true_fn()`` or ``false_fn()`` by the runtime value of
+    ``pred`` (reference control_flow.py:1509). Both branches must return
+    the same structure of tensors; gradients flow through the taken
+    branch to any tensors the branches capture."""
+    if isinstance(pred, (bool, int)) and not isinstance(pred, Tensor):
+        fn = true_fn if pred else false_fn
+        return fn() if fn is not None else None
+    if true_fn is None and false_fn is None:
+        return None
+    if true_fn is None or false_fn is None:
+        raise ValueError(
+            "cond needs both true_fn and false_fn (the reference requires "
+            "matching outputs; one-armed cond has no output structure)")
+    captured = _captured_tensors([true_fn, false_fn])
+
+    def fn(pred_arr, cap_arrs):
+        with _bind(captured, cap_arrs), _tape.no_grad():
+            return lax.cond(_as_scalar_pred(pred_arr),
+                            lambda _: _unwrap(true_fn()),
+                            lambda _: _unwrap(false_fn()),
+                            None)
+
+    return _registry.call_op("static_cond", fn, (pred, captured), {},
+                             differentiable=True)
+
+
+# ---------------------------------------------------------------------------
+# while_loop
+# ---------------------------------------------------------------------------
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Repeat ``body`` while ``cond`` holds (reference control_flow.py:682).
+    ``cond``/``body`` take the loop vars positionally; ``body`` returns
+    the same arity. Reverse-mode gradients are NOT defined (a dynamic
+    trip count has no bounded adjoint program) — matching XLA's While:
+    request them and this raises with the scan-based alternative."""
+    if not callable(cond) or not callable(body):
+        raise TypeError("cond and body in while_loop must be callable")
+    if not isinstance(loop_vars, (list, tuple)) or len(loop_vars) == 0:
+        raise ValueError("loop_vars must be a non-empty list/tuple")
+    captured = _captured_tensors([cond, body])
+    if _tape.grad_enabled():
+        live = [t for t in list(loop_vars) + captured
+                if isinstance(t, Tensor)
+                and (not t.stop_gradient or t._node is not None)]
+        if live:
+            raise ValueError(
+                "while_loop is not differentiable: its trip count is "
+                "dynamic, so reverse mode would need unbounded activation "
+                "storage (XLA While has no adjoint). Mark the inputs "
+                "stop_gradient, wrap the call in paddle_tpu.no_grad(), or "
+                "restructure as a bounded loop (python range under "
+                "to_static, or lax.scan) to differentiate")
+
+    def fn(var_arrs, cap_arrs):
+        # carry structure = the unwrapped arrays' structure (NOT the
+        # Tensor-level structure: Tensor is itself a pytree node, so a
+        # treedef taken over loop_vars would rebuild Tensor wrappers
+        # inside the carry)
+        treedef = jax.tree_util.tree_structure(var_arrs)
+
+        def wrap_vars(arrs):
+            return jax.tree_util.tree_map(Tensor, arrs)
+
+        with _bind(captured, cap_arrs), _tape.no_grad():
+            def c(arrs):
+                out = cond(*wrap_vars(arrs))
+                return _as_scalar_pred(out.data if isinstance(out, Tensor)
+                                       else jnp.asarray(out))
+
+            def b(arrs):
+                out = body(*wrap_vars(arrs))
+                out = _unwrap(list(out) if isinstance(out, (list, tuple))
+                              else [out])
+                return jax.tree_util.tree_unflatten(
+                    treedef, jax.tree_util.tree_leaves(out))
+
+            return lax.while_loop(c, b, var_arrs)
+
+    out = _registry.call_op("static_while_loop", fn,
+                            (list(loop_vars), captured), {},
+                            differentiable=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# case / switch_case
+# ---------------------------------------------------------------------------
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First pair whose pred is true wins; else ``default`` (reference
+    control_flow.py:961). Lowering: fold the preds into one branch index
+    (first-true-wins) and ``lax.switch`` over the branch bodies."""
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("pred_fn_pairs must be a non-empty list/tuple")
+    preds, fns = [], []
+    for pair in pred_fn_pairs:
+        if not isinstance(pair, (list, tuple)) or len(pair) != 2:
+            raise TypeError(f"each pred_fn_pair must be (pred, fn): {pair!r}")
+        p, f = pair
+        if not callable(f):
+            raise TypeError("fn in pred_fn_pairs must be callable")
+        preds.append(p)
+        fns.append(f)
+    if default is None:
+        default = fns[-1]  # reference: last fn doubles as default
+    branches = fns + [default]
+    captured = _captured_tensors(branches)
+
+    def fn(pred_arrs, cap_arrs):
+        with _bind(captured, cap_arrs), _tape.no_grad():
+            idx = jnp.asarray(len(fns), jnp.int32)  # default
+            for i in range(len(fns) - 1, -1, -1):
+                idx = jnp.where(_as_scalar_pred(pred_arrs[i]),
+                                jnp.int32(i), idx)
+            return lax.switch(idx, [lambda _, f=f: _unwrap(f())
+                                    for f in branches], None)
+
+    return _registry.call_op("static_case", fn, (preds, captured), {},
+                             differentiable=True)
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Select a branch by integer index (reference control_flow.py:1084).
+    ``branch_fns``: list of callables (implicit indices 0..n-1), or list
+    of (index, callable) pairs; out-of-range indices take ``default``."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif isinstance(branch_fns, (list, tuple)) and branch_fns and \
+            callable(branch_fns[0]):
+        pairs = list(enumerate(branch_fns))
+    else:
+        pairs = sorted((int(i), f) for i, f in branch_fns)
+    keys = [i for i, _ in pairs]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"duplicate branch indices: {keys}")
+    fns = [f for _, f in pairs]
+    for f in fns:
+        if not callable(f):
+            raise TypeError("branch_fns entries must be callable")
+    if default is None:
+        default = fns[-1]  # reference: max-index branch is the default
+    branches = fns + [default]
+    captured = _captured_tensors(branches)
+
+    def fn(bi_arr, cap_arrs):
+        bi = bi_arr.reshape(()).astype(jnp.int32)
+        with _bind(captured, cap_arrs), _tape.no_grad():
+            sel = jnp.asarray(len(fns), jnp.int32)  # default slot
+            for pos, key in enumerate(keys):
+                sel = jnp.where(bi == key, jnp.int32(pos), sel)
+            return lax.switch(sel, [lambda _, f=f: _unwrap(f())
+                                    for f in branches], None)
+
+    return _registry.call_op("static_switch_case", fn,
+                             (branch_index, captured), {},
+                             differentiable=True)

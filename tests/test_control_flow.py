@@ -1,0 +1,172 @@
+"""Control-flow capture tests: paddle_tpu.static.nn cond / while_loop /
+case / switch_case.
+
+Reference strategy: test/dygraph_to_static + legacy_test/test_cond.py,
+test_while_loop_op.py — run eager, under the tape (grads through the
+taken branch), and under to_static, where a data-dependent branch/loop
+must compile into ONE StableHLO module (stablehlo.case / stablehlo.while
+in the lowered text — no eager fallback).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.static import nn as snn
+
+
+def t(x, sg=False):
+    return pt.to_tensor(np.asarray(x, np.float32), stop_gradient=sg)
+
+
+# ---------------------------------------------------------------------------
+# eager
+# ---------------------------------------------------------------------------
+
+def test_cond_eager_picks_branch():
+    x = t([2.0])
+    out_t = snn.cond(pt.to_tensor(True), lambda: x * 2, lambda: x - 1)
+    out_f = snn.cond(pt.to_tensor(False), lambda: x * 2, lambda: x - 1)
+    np.testing.assert_allclose(out_t.numpy(), [4.0])
+    np.testing.assert_allclose(out_f.numpy(), [1.0])
+
+
+def test_cond_python_bool_shortcut():
+    x = t([3.0])
+    np.testing.assert_allclose(
+        snn.cond(True, lambda: x + 1, lambda: x).numpy(), [4.0])
+
+
+def test_cond_structure_output():
+    x = t([1.0, 2.0])
+    a, b = snn.cond(t([1.0]).sum() > 0,
+                    lambda: (x * 2, x + 1), lambda: (x, x))
+    np.testing.assert_allclose(a.numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(b.numpy(), [2.0, 3.0])
+
+
+def test_while_loop_eager():
+    i = pt.to_tensor(np.asarray([0], np.int64))
+    ten = pt.to_tensor(np.asarray([10], np.int64))
+    i_out, ten_out = snn.while_loop(lambda i, ten: (i < ten).all(),
+                                    lambda i, ten: [i + 1, ten], [i, ten])
+    assert int(i_out.numpy()[0]) == 10
+
+
+def test_while_loop_captured_tensor():
+    step = pt.to_tensor(np.asarray([2], np.int64), stop_gradient=True)
+    i = pt.to_tensor(np.asarray([0], np.int64))
+    (i_out,) = snn.while_loop(lambda i: (i < 9).all(),
+                              lambda i: [i + step], [i])
+    assert int(i_out.numpy()[0]) == 10
+
+
+def test_case_first_true_wins():
+    x = t([1.0])
+    out = snn.case([((x > 0).all(), lambda: x + 10),
+                    ((x > -5).all(), lambda: x + 100)],
+                   default=lambda: x)
+    np.testing.assert_allclose(out.numpy(), [11.0])
+    out2 = snn.case([((x > 5).all(), lambda: x + 10),
+                     ((x > 0).all(), lambda: x + 100)],
+                    default=lambda: x)
+    np.testing.assert_allclose(out2.numpy(), [101.0])
+    out3 = snn.case([((x > 5).all(), lambda: x + 10)],
+                    default=lambda: x - 7)
+    np.testing.assert_allclose(out3.numpy(), [-6.0])
+
+
+def test_switch_case_by_index_and_default():
+    x = t([1.0])
+    fns = [lambda: x * 1, lambda: x * 2, lambda: x * 3]
+    for bi, want in [(0, 1.0), (1, 2.0), (2, 3.0), (7, 3.0)]:
+        out = snn.switch_case(pt.to_tensor(np.asarray(bi, np.int32)), fns)
+        np.testing.assert_allclose(out.numpy(), [want])
+    # (index, fn) pairs with explicit default
+    out = snn.switch_case(pt.to_tensor(np.asarray(5, np.int32)),
+                          [(1, lambda: x * 2), (3, lambda: x * 4)],
+                          default=lambda: x - 1)
+    np.testing.assert_allclose(out.numpy(), [0.0])
+
+
+# ---------------------------------------------------------------------------
+# tape: gradients through the taken branch
+# ---------------------------------------------------------------------------
+
+def test_cond_grad_through_taken_branch():
+    x = t([3.0])
+    y = snn.cond((x > 0).all(), lambda: x * x, lambda: x * 4)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # d(x^2)/dx
+
+    x2 = t([-3.0])
+    y2 = snn.cond((x2 > 0).all(), lambda: x2 * x2, lambda: x2 * 4)
+    y2.sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), [4.0])
+
+
+def test_case_grad():
+    x = t([2.0])
+    out = snn.case([((x > 10).all(), lambda: x * 2),
+                    ((x > 0).all(), lambda: x * x * x)],
+                   default=lambda: x)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [12.0])  # 3x^2
+
+
+def test_while_loop_grad_raises_with_guidance():
+    x = t([1.0])  # requires grad
+    with pytest.raises(ValueError, match="not differentiable"):
+        snn.while_loop(lambda v: (v < 10).all(), lambda v: [v * 2], [x])
+    # under no_grad the same loop runs
+    with pt.no_grad():
+        (out,) = snn.while_loop(lambda v: (v < 10).all(),
+                                lambda v: [v * 2], [x])
+    np.testing.assert_allclose(out.numpy(), [16.0])
+
+
+# ---------------------------------------------------------------------------
+# to_static: ONE compiled module, no fallback
+# ---------------------------------------------------------------------------
+
+def test_cond_under_to_static_single_module():
+    @pt.jit.to_static(full_graph=True)
+    def f(x):
+        return snn.cond((x.sum() > 0).all(),
+                        lambda: x * 2, lambda: x - 1)
+
+    out = f(t([1.0, 2.0]))
+    np.testing.assert_allclose(out.numpy(), [2.0, 4.0])
+    out = f(t([-1.0, -2.0]))
+    np.testing.assert_allclose(out.numpy(), [-2.0, -3.0])
+    hlo = f.lower(t([1.0, 2.0]))
+    # the branch is INSIDE the one module (reference: PIR If instruction)
+    assert "case" in hlo or "if" in hlo
+    assert not f._fell_back
+
+
+def test_while_loop_under_to_static_single_module():
+    @pt.jit.to_static(full_graph=True)
+    def f(n):
+        i = pt.to_tensor(np.asarray([0], np.int64))
+        i_out, _ = snn.while_loop(lambda i, n: (i < n).all(),
+                                  lambda i, n: [i + 1, n], [i, n])
+        return i_out
+
+    n = pt.to_tensor(np.asarray([7], np.int64))
+    assert int(f(n).numpy()[0]) == 7
+    hlo = f.lower(n)
+    assert "while" in hlo
+    assert not f._fell_back
+
+
+def test_switch_case_under_to_static():
+    @pt.jit.to_static(full_graph=True)
+    def f(x, bi):
+        return snn.switch_case(bi, [lambda: x * 1, lambda: x * 2,
+                                    lambda: x * 3])
+
+    x = t([2.0])
+    for bi, want in [(0, 2.0), (1, 4.0), (2, 6.0)]:
+        out = f(x, pt.to_tensor(np.asarray(bi, np.int32)))
+        np.testing.assert_allclose(out.numpy(), [want])
+    assert not f._fell_back
