@@ -19,7 +19,7 @@ from .modules_conv import (
 from .modules_norm import (
     LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
     SyncBatchNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
-    LocalResponseNorm,
+    LocalResponseNorm, SpectralNorm,
 )
 from .modules_loss import (
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,

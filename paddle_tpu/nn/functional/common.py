@@ -145,10 +145,6 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     return (out, None) if return_softmax is not None else out
 
 
-def linear_compress(*a, **k):
-    raise NotImplementedError
-
-
 @register_op(name="label_smooth")
 def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
     n = label.shape[-1]
@@ -212,7 +208,12 @@ def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
         x = x.reshape(n, c, h // r, r, w // r, r)
         x = jnp.transpose(x, (0, 1, 3, 5, 2, 4))
         return x.reshape(n, c * r * r, h // r, w // r)
-    raise NotImplementedError("NHWC pixel_unshuffle")
+    # NHWC: channels flatten (r, r, c)-major — the exact inverse of
+    # pixel_shuffle's NHWC layout above
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // r, r, w // r, r, c)
+    x = jnp.transpose(x, (0, 1, 3, 2, 4, 5))
+    return x.reshape(n, h // r, w // r, c * r * r)
 
 
 @register_op(name="channel_shuffle")

@@ -229,7 +229,8 @@ def _pool(x, ksize, stride, padding, spatial, data_format, reducer, init,
                 extra_cfg)
             out = out / counts
     if return_mask:
-        return out, _pool_argmax_mask(x, k, s, pad_pairs, extra,
+        mask_pads = pad_pairs if pad_pairs is not None else pad  # str mode
+        return out, _pool_argmax_mask(x, k, s, mask_pads, extra,
                                       spatial_axes, channel_last)
     return out
 
@@ -237,10 +238,28 @@ def _pool(x, ksize, stride, padding, spatial, data_format, reducer, init,
 def _pool_argmax_mask(x, k, s, pad_pairs, extra, spatial_axes, channel_last):
     """Flattened-spatial argmax index per pooling window (paddle's
     max_poolNd(..., return_mask=True) second output)."""
-    if pad_pairs is None:
-        raise NotImplementedError("return_mask with string padding")
     if channel_last:
-        raise NotImplementedError("return_mask requires channel-first layout")
+        # compute channel-first, emit channel-last: the patch extraction
+        # below is NC*-layout
+        xcf = jnp.moveaxis(x, -1, 1)
+        cf_axes = tuple(range(2, 2 + len(k)))
+        mask = _pool_argmax_mask(xcf, k, s, pad_pairs, extra, cf_axes,
+                                 channel_last=False)
+        return jnp.moveaxis(mask, 1, -1)
+    if pad_pairs is None or isinstance(pad_pairs, str):
+        # string padding reached us unresolved: reconstruct XLA's
+        # SAME/VALID explicit pairs (extra is all-zero on this path —
+        # ceil_mode has no effect for string padding)
+        mode = (pad_pairs or "VALID").upper()
+        pad_pairs = []
+        for i, ax in enumerate(spatial_axes):
+            n = x.shape[ax]
+            if mode == "VALID":
+                pad_pairs.append((0, 0))
+                continue
+            out = -(-n // s[i])  # SAME output size: ceil(n / s)
+            total = max((out - 1) * s[i] + k[i] - n, 0)
+            pad_pairs.append((total // 2, total - total // 2))
     # finite sentinel: patches are conv-based, and -inf * 0 kernel taps = NaN
     neg = (jnp.finfo(x.dtype).min
            if jnp.issubdtype(x.dtype, jnp.floating)

@@ -216,11 +216,6 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
     return _reduce(loss, reduction)
 
 
-@register_op(name="ctc_loss_stub", also_method=False)
-def _ctc_unimpl(*a, **k):
-    raise NotImplementedError
-
-
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
     """CTC via optax (reference: paddle ctc_loss over warpctc,

@@ -19,7 +19,7 @@ from . import functional as F
 from . import initializer as I
 
 __all__ = [
-    "SpectralNorm", "UpsamplingNearest2D", "UpsamplingBilinear2D",
+    "UpsamplingNearest2D", "UpsamplingBilinear2D",
     "FeatureAlphaDropout", "Unfold", "Fold", "BiRNN", "PairwiseDistance",
     "AdaptiveAvgPool3D", "AdaptiveMaxPool3D", "AdaptiveMaxPool1D",
     "PoissonNLLLoss", "Softmax2D", "Silu", "RNNTLoss", "ThresholdedReLU",
@@ -292,39 +292,6 @@ class LayerDict(Layer):
                  else sublayers)
         for k, v in items:
             self.add_sublayer(k, v)
-
-
-class SpectralNorm(Layer):
-    """Spectral normalization of a weight (reference norm.py
-    SpectralNorm): largest singular value estimated by power iteration;
-    forward returns weight / sigma."""
-
-    def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
-                 name=None, dtype="float32"):
-        super().__init__()
-        self.dim = dim
-        self.power_iters = power_iters
-        self.eps = epsilon
-        h = weight_shape[dim]
-        w = int(np.prod(weight_shape)) // h
-        from ..core.generator import next_key
-        self.register_buffer("weight_u", Tensor(
-            jax.random.normal(next_key(), (h,), jnp.float32)))
-        self.register_buffer("weight_v", Tensor(
-            jax.random.normal(next_key(), (w,), jnp.float32)))
-
-    def forward(self, weight):
-        d = weight.data if isinstance(weight, Tensor) else jnp.asarray(weight)
-        mat = jnp.moveaxis(d, self.dim, 0).reshape(d.shape[self.dim], -1)
-        u, v = self.weight_u.data, self.weight_v.data
-        for _ in range(self.power_iters):
-            v = mat.T @ u
-            v = v / (jnp.linalg.norm(v) + self.eps)
-            u = mat @ v
-            u = u / (jnp.linalg.norm(u) + self.eps)
-        self.weight_u._data, self.weight_v._data = u, v
-        sigma = u @ mat @ v
-        return Tensor(d / sigma)
 
 
 class BiRNN(Layer):

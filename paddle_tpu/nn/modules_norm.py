@@ -2,6 +2,8 @@
 from __future__ import annotations
 
 import numpy as np
+import jax
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 from . import functional as F
@@ -171,7 +173,61 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Spectral normalization of a weight tensor (reference
+    nn/layer/norm.py:1847 over the spectral_norm kernel; also
+    python/paddle/nn/utils/spectral_norm_hook.py): estimate the largest
+    singular value sigma by power iteration on W reshaped to
+    [shape[dim], prod(rest)], return weight / sigma."""
+
     def __init__(self, weight_shape, dim=0, power_iters=1, epsilon=1e-12,
-                 name=None):
+                 name=None, dtype="float32"):
         super().__init__()
-        raise NotImplementedError("SpectralNorm: planned (round 2)")
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = epsilon
+        self._weight_shape = list(weight_shape)
+        if int(np.prod(self._weight_shape)) <= 0:
+            raise ValueError("weight_shape dims must be positive")
+        rank = len(self._weight_shape)
+        if not -rank <= dim < rank:
+            raise ValueError(
+                f"dim {dim} out of range for shape {weight_shape}")
+        dim = self._dim = dim % rank
+        h = self._weight_shape[dim]
+        w = int(np.prod(self._weight_shape)) // h
+        self.weight_u = self.create_parameter([h], dtype=dtype)
+        self.weight_v = self.create_parameter([w], dtype=dtype)
+        from ..core.generator import next_key  # paddle_tpu.seed-driven
+        ku, kv = jax.random.split(next_key())
+        self.weight_u.set_value(jax.random.normal(ku, (h,), jnp.float32))
+        self.weight_v.set_value(jax.random.normal(kv, (w,), jnp.float32))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, x):
+        from ..ops import registry as _registry
+        dim, eps, iters = self._dim, self._eps, self._power_iters
+
+        def fn(w, u0, v0):
+            wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+            wf = wm.astype(jnp.float32)
+            ws = jax.lax.stop_gradient(wf)  # iteration is grad-free
+            u, v = u0.astype(jnp.float32), v0.astype(jnp.float32)
+            for _ in range(iters):
+                v = ws.T @ u
+                v = v / (jnp.linalg.norm(v) + eps)
+                u = ws @ v
+                u = u / (jnp.linalg.norm(u) + eps)
+            u = jax.lax.stop_gradient(u)
+            v = jax.lax.stop_gradient(v)
+            sigma = u @ wf @ v  # d sigma/dw = u v^T (the reference grad)
+            return (w.astype(jnp.float32) / sigma).astype(w.dtype), u, v
+
+        out, u_new, v_new = _registry.call_op(
+            "spectral_norm", fn, (x, self.weight_u, self.weight_v), {},
+            differentiable=True)
+        # persist the iterated vectors (the reference kernel updates U/V
+        # in place each call)
+        self.weight_u.set_value(u_new.data)
+        self.weight_v.set_value(v_new.data)
+        return out

@@ -20,7 +20,7 @@ router weights. Also used as the building block for grad-of-weights via
 ``tgmm`` (per-expert X^T G accumulation).
 
 All kernels run in interpreter mode off-TPU so the CPU test mesh exercises
-identical semantics (tests/test_grouped_matmul.py).
+identical semantics (tests/test_pallas_kernels.py, tests/test_moe.py).
 """
 from __future__ import annotations
 

@@ -339,7 +339,7 @@ EXCLUDED = {
     # test_eager_vjp_cache) / stubs / interpolation (functional tests in
     # test_vision_hapi) — all exercised elsewhere
     "dropout", "dropout2d", "dropout3d", "alpha_dropout",
-    "ctc_loss_stub", "linear_compress", "interpolate", "upsample",
+    "interpolate", "upsample",
     "flash_attention", "scaled_dot_product_attention",
     # fresh-PRNG-per-call (forward can't be replayed against raw fn) —
     # behavior covered in test_api_extras / test_api_parity_batch

@@ -49,6 +49,75 @@ def test_max_pool1d_return_mask(x):
     np.testing.assert_array_equal(refidx.numpy(), mask.numpy())
 
 
+def test_max_pool2d_return_mask_string_padding(x):
+    # VALID == explicit 0 padding; indices must match the explicit path
+    ref, refidx = TF.max_pool2d(torch.tensor(x), 3, 2, padding=0,
+                                return_indices=True)
+    out, mask = F.max_pool2d(pt.to_tensor(x), 3, 2, padding="VALID",
+                             return_mask=True)
+    np.testing.assert_allclose(ref.numpy(), out.numpy())
+    np.testing.assert_array_equal(refidx.numpy(), mask.numpy())
+    # SAME: just consistency — mask indices must point at the max values
+    out_s, mask_s = F.max_pool2d(pt.to_tensor(x), 2, 2, padding="SAME",
+                                 return_mask=True)
+    flat = x.reshape(2, 3, -1)
+    picked = np.take_along_axis(flat, mask_s.numpy().reshape(2, 3, -1),
+                                axis=2).reshape(out_s.shape)
+    np.testing.assert_allclose(picked, out_s.numpy())
+
+
+def test_max_pool2d_return_mask_nhwc(x):
+    xh = np.transpose(x, (0, 2, 3, 1)).copy()
+    ref, refidx = TF.max_pool2d(torch.tensor(x), 2, 2, return_indices=True)
+    out, mask = F.max_pool2d(pt.to_tensor(xh), 2, 2, return_mask=True,
+                             data_format="NHWC")
+    np.testing.assert_allclose(np.transpose(ref.numpy(), (0, 2, 3, 1)),
+                               out.numpy())
+    np.testing.assert_array_equal(np.transpose(refidx.numpy(), (0, 2, 3, 1)),
+                                  mask.numpy())
+
+
+def test_pixel_unshuffle_nhwc_inverts_shuffle():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 8, 6, 4).astype(np.float32)  # NHWC, c=4, r=2
+    shuf = F.pixel_shuffle(pt.to_tensor(x), 2, data_format="NHWC")
+    back = F.pixel_unshuffle(shuf, 2, data_format="NHWC")
+    np.testing.assert_allclose(back.numpy(), x)
+    # and unshuffle matches the NCHW formulation through transposes
+    un = F.pixel_unshuffle(pt.to_tensor(x), 2, data_format="NHWC")
+    un_ref = F.pixel_unshuffle(
+        pt.to_tensor(np.transpose(x, (0, 3, 1, 2)).copy()), 2)
+    assert un.shape == [2, 4, 3, 16]
+    assert un_ref.shape == [2, 16, 4, 3]
+
+
+def test_spectral_norm_layer():
+    import paddle_tpu.nn as nn
+    rng = np.random.RandomState(0)
+    w = rng.randn(6, 4).astype(np.float32)
+    sn = nn.SpectralNorm([6, 4], dim=0, power_iters=20)
+    out = sn(pt.to_tensor(w))
+    # after enough power iterations the top singular value is ~1
+    s = np.linalg.svd(out.numpy(), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-4)
+    # direction preserved: out is w / sigma
+    sigma = np.linalg.svd(w, compute_uv=False)[0]
+    np.testing.assert_allclose(out.numpy(), w / sigma, rtol=1e-3)
+
+
+def test_spectral_norm_grad_flows_to_weight():
+    import paddle_tpu.nn as nn
+    rng = np.random.RandomState(1)
+    w = pt.to_tensor(rng.randn(4, 3).astype(np.float32),
+                     stop_gradient=False)
+    sn = nn.SpectralNorm([4, 3], dim=0, power_iters=8)
+    y = sn(w)
+    y.sum().backward()
+    assert w.grad is not None
+    assert np.isfinite(w.grad.numpy()).all()
+    assert np.abs(w.grad.numpy()).max() > 0
+
+
 def test_pad_validation():
     z = pt.to_tensor(np.zeros((2, 3), "float32"))
     with pytest.raises(ValueError):
