@@ -38,6 +38,13 @@ class Generator:
             self._key, sub = jax.random.split(self._key)
             return sub
 
+    def ensure_key(self):
+        """The current key array (materialising it lazily)."""
+        with self._lock:
+            if self._key is None:
+                self._key = jax.random.PRNGKey(self._seed)
+            return self._key
+
     def get_state(self):
         with self._lock:
             if self._key is None:

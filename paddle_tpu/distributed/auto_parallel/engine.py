@@ -1,29 +1,72 @@
-"""auto_parallel Engine: plan + shard + train without manual specs.
+"""auto_parallel Engine: plan + shard + train as ONE compiled step.
 
-Reference surface (static/engine.py): ``Engine(model, loss, optimizer,
-strategy).fit(dataset)`` / ``evaluate`` / ``predict``. The reference
-pipeline — completer annotates a static program, planner searches
-distributed attributes, partitioner splits it per rank, fleet executor
-runs it — collapses on TPU to:
+Reference surface (static/engine.py:97,1450): ``Engine(model, loss,
+optimizer, strategy).fit(dataset)`` / ``evaluate`` / ``predict``. The
+reference pipeline — completer annotates a static program, planner
+searches distributed attributes, partitioner splits it per rank, fleet
+executor runs it — collapses on TPU to:
 
   1. PLAN: a rule-based planner assigns a PartitionSpec to every
      parameter (tensor-parallel columns/rows for large matmul weights,
-     vocab-sharded embeddings, replicated small tensors) and dp-shards
-     the batch. User placements from shard_tensor/shard_layer win.
+     replicated small tensors) and dp-shards the batch. User placements
+     from shard_tensor/shard_layer win.
   2. SHARD: jax.device_put per the plan (GSPMD partitions the math).
-  3. EXECUTE: the eager tape trains through sharded arrays; every op
-     dispatches through the (cached) registry so the same model code
-     runs single-chip or on any mesh.
+  3. COMPILE: fit/evaluate/predict trace the model + loss + optimizer
+     update into ONE jitted XLA program (the reference Engine's whole
+     point: static/engine.py:1450 runs a compiled program per rank, not
+     eager per-op dispatch). The eager tape runs only the very first
+     fit step — that materialises the optimizer's lazily-created
+     accumulator slots, which then become traced inputs.
+
+Pipeline parallelism (``pp_degree > 1``): the model must be a sequence
+of structurally identical blocks (a ``Sequential`` of one repeated
+block type — the transformer shape). Blocks' stacked parameters get a
+leading ``[pp, layers/stage, ...]`` axis sharded over the mesh's pp
+axis and run through ``parallel.pipeline_spmd`` (microbatched GPipe:
+the stage shift lowers to collective_permute). Heterogeneous graph
+partitioning — the reference's program-slicing partitioner — is out of
+scope; the Engine raises with that explanation instead of guessing.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Optional, Sequence
+import contextlib
+from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
 import jax
+import jax.numpy as jnp
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ...core.tensor import Tensor
+
+
+class _GenKeyState:
+    """Adapter exposing the global RNG key as a bindable ``_data`` slot,
+    so the jitted step threads it as a traced input/output — dropout
+    resamples per step instead of replaying the trace-time mask."""
+
+    @property
+    def _data(self):
+        from ...core.generator import default_generator
+        return default_generator().ensure_key()
+
+    @_data.setter
+    def _data(self, v):
+        from ...core.generator import default_generator
+        default_generator()._key = v
+
+
+@contextlib.contextmanager
+def _bind(tensors, arrays):
+    saved = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._data = s
 
 
 class Strategy:
@@ -31,22 +74,21 @@ class Strategy:
     DistributedStrategy hybrid_configs)."""
 
     def __init__(self, dp_degree: int = 1, mp_degree: int = 1,
-                 pp_degree: int = 1, min_shard_size: int = 2 ** 16):
-        if pp_degree != 1:
-            raise NotImplementedError(
-                "Engine pipeline parallelism: use the model-level "
-                "pp paths (models/llama.py pp_stages + pp_schedule); "
-                "the Engine plans dp x mp meshes")
+                 pp_degree: int = 1, min_shard_size: int = 2 ** 16,
+                 jit: bool = True, num_microbatches: Optional[int] = None):
         self.dp_degree = dp_degree
         self.mp_degree = mp_degree
         self.pp_degree = pp_degree
         # tensors smaller than this stay replicated (sharding overhead
         # beats the memory win)
         self.min_shard_size = min_shard_size
+        # jit=False keeps the round-3 eager execution path
+        self.jit = jit
+        self.num_microbatches = num_microbatches or max(pp_degree, 1)
 
 
 class Engine:
-    """Plan-shard-train driver over an (eager) Layer.
+    """Plan-shard-compile driver over an (eager) Layer.
 
     model: nn.Layer; loss: callable(pred, label) -> scalar Tensor;
     optimizer: paddle_tpu optimizer bound to model.parameters().
@@ -61,17 +103,21 @@ class Engine:
         self.strategy = strategy or Strategy()
         self._mesh: Optional[Mesh] = None
         self._planned = False
+        self._jit_step = None
+        self._jit_fwd = None
+        self._pp_blocks: Optional[List] = None
 
     # ------------------------------------------------------------- plan ----
     def _build_mesh(self) -> Mesh:
         s = self.strategy
-        want = s.dp_degree * s.mp_degree
+        want = s.dp_degree * s.mp_degree * s.pp_degree
         devs = jax.devices()
         if want > len(devs):
             raise ValueError(
                 f"strategy needs {want} devices, have {len(devs)}")
-        arr = np.array(devs[:want]).reshape(s.dp_degree, s.mp_degree)
-        return Mesh(arr, ("dp", "mp"))
+        arr = np.array(devs[:want]).reshape(s.dp_degree, s.mp_degree,
+                                            s.pp_degree)
+        return Mesh(arr, ("dp", "mp", "pp"))
 
     def _plan_param(self, name: str, p: Tensor) -> P:
         """Rule-based planner (the completer/planner stand-in): shard the
@@ -91,11 +137,66 @@ class Engine:
                 return P(*spec)
         return P()
 
+    def _partition_blocks(self) -> List:
+        """Split the model into pp-stage-able blocks; raise with the
+        design boundary when the model is not a homogeneous sequence."""
+        S = self.strategy.pp_degree
+        subs = list(getattr(self.model, "_sub_layers", {}).values())
+        if len(subs) < S:
+            raise ValueError(
+                f"pp_degree={S} needs >= {S} top-level sublayers, model "
+                f"has {len(subs)}")
+        block_param_ids = {id(q) for b in subs for q in b.parameters()}
+        own = [p for p in self.model.parameters()
+               if id(p) not in block_param_ids]
+        if own:
+            raise ValueError(
+                "Engine pipeline parallelism requires ALL parameters to "
+                "live in the model's top-level sublayers (a Sequential "
+                "of blocks); found parameters owned by the model itself")
+
+        def sig(block):
+            return tuple((tuple(p.data.shape), str(p.data.dtype))
+                         for p in block.parameters())
+
+        sigs = {sig(b) for b in subs}
+        # one block TYPE too: equal param shapes with different forward
+        # code (Relu vs Gelu blocks) would silently run block[0]'s math
+        # for every stage
+        if len({type(b) for b in subs}) != 1:
+            raise ValueError(
+                "Engine pipeline parallelism needs ONE repeated block "
+                f"type; got {sorted({type(b).__name__ for b in subs})} — "
+                "different forwards cannot share the stacked stage "
+                "template")
+        if len(sigs) != 1:
+            raise ValueError(
+                "Engine pipeline parallelism needs structurally identical "
+                "blocks (same parameter shapes/dtypes per block) so their "
+                "weights stack on a pp-sharded layer axis; this model's "
+                "blocks differ. Heterogeneous program partitioning is the "
+                "reference's static-graph partitioner — out of scope here; "
+                "use the model-level pp paths (models/llama.py) or make "
+                "the model a Sequential of one repeated block")
+        if len(subs) % S:
+            raise ValueError(
+                f"{len(subs)} blocks not divisible by pp_degree {S}")
+        for b in subs:
+            # recursive: nested sublayers' buffers (BatchNorm running
+            # stats) disqualify too — only parameters are stage-stacked
+            if any(True for _ in b.buffers()):
+                raise ValueError(
+                    "pp blocks with buffers (running stats) are not "
+                    "stackable; use buffer-free blocks")
+        return subs
+
     def prepare(self):
         """Plan + shard all parameters (idempotent)."""
         if self._planned:
             return self
         self._mesh = self._build_mesh()
+        if self.strategy.pp_degree > 1:
+            self._pp_blocks = self._partition_blocks()
         self.plan = {}
         for name, p in self.model.named_parameters():
             existing = getattr(p.data, "sharding", None)
@@ -115,12 +216,146 @@ class Engine:
         self._planned = True
         return self
 
-    def _shard_batch(self, arr) -> Any:
-        a = arr.data if isinstance(arr, Tensor) else np.asarray(arr)
-        spec = P("dp", *([None] * (a.ndim - 1))) if a.ndim else P()
-        if a.shape and a.shape[0] % self.strategy.dp_degree == 0:
+    # --------------------------------------------------------- compiled ----
+    def _trainables(self) -> List:
+        return [p for p in self.model.parameters() if not p.stop_gradient]
+
+    def _loss_arrays(self, params) -> Callable:
+        """Pure (param_arrays, x, y) -> scalar loss array, running the
+        eager Layer over traced values (the to_static capture trick)."""
+        from ...autograd import tape as _tape
+
+        def lf(parrs, x, y, karr=None):
+            kctx = (_bind([_GenKeyState()], [karr]) if karr is not None
+                    else contextlib.nullcontext())
+            with _bind(params, parrs), kctx, _tape.no_grad():
+                out = self.model(Tensor(x))
+                l = self.loss(out, Tensor(y, stop_gradient=True))
+            return l.data if isinstance(l, Tensor) else l
+        return lf
+
+    def _pp_loss_arrays(self, params) -> Callable:
+        """Pure loss with the homogeneous blocks run as a GPipe pipeline
+        over the mesh pp axis (parallel/pipeline_spmd)."""
+        from ...autograd import tape as _tape
+        from ...parallel.pipeline_spmd import microbatch, pipeline_spmd
+
+        blocks = self._pp_blocks
+        S = self.strategy.pp_degree
+        M = self.strategy.num_microbatches
+        mesh = self._mesh
+        Lb = len(blocks)
+        template = blocks[0]
+        tparams = list(template.parameters())
+        pos = {id(p): i for i, p in enumerate(params)}
+        # [block][param_j] -> index into the flat trainables list
+        block_idx = [[pos[id(p)] for p in b.parameters()] for b in blocks]
+        # per-leaf stacked sharding: pp on the stage axis, the planner's
+        # mp placement (same across blocks, by homogeneity) on the rest
+        leaf_specs = [tuple(p.data.sharding.spec)
+                      if isinstance(getattr(p.data, "sharding", None),
+                                    NamedSharding) else (None,) * p.data.ndim
+                      for p in blocks[0].parameters()]
+
+        def lf(parrs, x, y, karr=None):
+            kctx = (_bind([_GenKeyState()], [karr]) if karr is not None
+                    else contextlib.nullcontext())
+            with kctx:
+                stacked = []
+                for j in range(len(tparams)):
+                    s = jnp.stack([parrs[block_idx[b][j]]
+                                   for b in range(Lb)])
+                    s = s.reshape((S, Lb // S) + s.shape[1:])
+                    s = lax.with_sharding_constraint(
+                        s, NamedSharding(mesh,
+                                         P("pp", None, *leaf_specs[j])))
+                    stacked.append(s)
+
+                def stage_fn(sp, state):
+                    # sp leaves: [Lb/S, ...]; run the stage's blocks
+                    with _tape.no_grad():
+                        for l in range(Lb // S):
+                            with _bind(tparams, [leaf[l] for leaf in sp]):
+                                t = template(Tensor(state))
+                            state = t.data if isinstance(t, Tensor) else t
+                    return state
+
+                xm = microbatch(x, M)
+                xm = lax.with_sharding_constraint(
+                    xm, NamedSharding(mesh, P(None, "dp",
+                                              *([None] * (xm.ndim - 2)))))
+                out = pipeline_spmd(stage_fn, stacked, xm, num_stages=S)
+                out = out.reshape((-1,) + out.shape[2:])
+                with _tape.no_grad():
+                    l = self.loss(Tensor(out),
+                                  Tensor(y, stop_gradient=True))
+            return l.data if isinstance(l, Tensor) else l
+        return lf
+
+    def _build_jit_step(self):
+        if self.strategy.pp_degree > 1:
+            # pp stacks EVERY block param (frozen ones included — the
+            # position map must cover b.parameters() exactly); the
+            # optimizer still skips frozen params (no grad assigned)
+            params = [p for b in self._pp_blocks for p in b.parameters()]
+            lf = self._pp_loss_arrays(params)
+        else:
+            params = self._trainables()
+            lf = self._loss_arrays(params)
+        # thread the global RNG key through the step so dropout-style
+        # ops resample every call instead of replaying the trace-time key
+        state_t = self.optimizer._all_state_tensors() + [_GenKeyState()]
+        opt = self.optimizer
+
+        def pure(parrs, sarrs, x, y):
+            # last state slot is the RNG key: one child seeds this step's
+            # dropout masks (threaded INTO the loss so the forward under
+            # value_and_grad uses a traced key, not a baked constant),
+            # the other becomes the next step's key
+            k_inner, k_next = jax.random.split(sarrs[-1])
+            loss, grads = jax.value_and_grad(lf)(parrs, x, y, k_inner)
+            with _bind(params, parrs), _bind(state_t[:-1], sarrs[:-1]):
+                saved = [p._grad for p in params]
+                for p, g in zip(params, grads):
+                    p._grad = Tensor(g)
+                # scheduler already synced host-side; see Optimizer.step
+                opt.step(_sync_lr=False)
+                new_p = [p._data for p in params]
+                new_s = [t._data for t in state_t[:-1]] + [k_next]
+                for p, sg in zip(params, saved):
+                    p._grad = sg
+            return loss, new_p, new_s
+
+        self._params = params
+        self._state_t = state_t
+        self._jit_step = jax.jit(pure, donate_argnums=(0, 1))
+
+    def _run_jit_step(self, x, y):
+        self.optimizer._sync_lr()
+        loss, new_p, new_s = self._jit_step(
+            [p._data for p in self._params],
+            [t._data for t in self._state_t], x, y)
+        for p, a in zip(self._params, new_p):
+            p._data = a
+        for t, a in zip(self._state_t, new_s):
+            t._data = a
+        return loss
+
+    def _eager_step(self, x, y):
+        out = self.model(Tensor(x, stop_gradient=True))
+        loss = self.loss(out, Tensor(y, stop_gradient=True))
+        loss.backward()
+        self.optimizer.step()
+        self.optimizer.clear_grad()
+        return loss.data
+
+    def _shard_arr(self, arr):
+        a = arr.data if isinstance(arr, Tensor) else jnp.asarray(
+            np.asarray(arr))
+        if a.ndim and a.shape[0] % self.strategy.dp_degree == 0:
+            spec = P("dp", *([None] * (a.ndim - 1)))
             a = jax.device_put(a, NamedSharding(self._mesh, spec))
-        return Tensor(a, stop_gradient=True)
+        return a
 
     @staticmethod
     def _batches(data, batch_size: Optional[int]):
@@ -148,7 +383,10 @@ class Engine:
             = None, verbose: int = 0, log_freq: int = 10):
         """train_data: iterable of (input, label) batches (a DataLoader
         or any iterable of numpy/Tensor pairs), or one (features,
-        labels) pair together with ``batch_size``."""
+        labels) pair together with ``batch_size``.
+
+        The first step runs eagerly (materialising optimizer slots);
+        every later step is the single compiled program."""
         if self.loss is None or self.optimizer is None:
             raise ValueError("fit() needs loss and optimizer")
         self.prepare()
@@ -156,19 +394,42 @@ class Engine:
         for epoch in range(epochs):
             for i, batch in enumerate(self._batches(train_data,
                                                     batch_size)):
-                x, y = batch[0], batch[1]
-                x = self._shard_batch(x)
-                y = self._shard_batch(y)
-                out = self.model(x)
-                loss = self.loss(out, y)
-                loss.backward()
-                self.optimizer.step()
-                self.optimizer.clear_grad()
-                history.append(float(loss.numpy()))
+                x = self._shard_arr(batch[0])
+                y = self._shard_arr(batch[1])
+                if not self.strategy.jit:
+                    loss = self._eager_step(x, y)
+                elif self._jit_step is None:
+                    loss = self._eager_step(x, y)  # slot materialisation
+                    self._build_jit_step()
+                else:
+                    loss = self._run_jit_step(x, y)
+                history.append(float(np.asarray(loss)))
                 if verbose and i % log_freq == 0:
                     print(f"epoch {epoch} step {i}: "
                           f"loss {history[-1]:.4f}")
         return history
+
+    def _forward_jitted(self, x):
+        from ...autograd import tape as _tape
+        if self._jit_fwd is None:
+            params = list(self.model.parameters())
+            key_state = _GenKeyState()
+
+            def pure(parrs, karr, x):
+                with _bind(params, parrs), _bind([key_state], [karr]), \
+                        _tape.no_grad():
+                    out = self.model(Tensor(x))
+                    out = out.data if isinstance(out, Tensor) else out
+                    new_key = key_state._data
+                return out, new_key
+
+            self._fwd_params = params
+            self._fwd_key = key_state
+            self._jit_fwd = jax.jit(pure)
+        out, new_key = self._jit_fwd(
+            [p._data for p in self._fwd_params], self._fwd_key._data, x)
+        self._fwd_key._data = new_key
+        return Tensor(out)
 
     def evaluate(self, eval_data):
         from ...autograd import no_grad
@@ -178,15 +439,16 @@ class Engine:
             m.reset()
         with no_grad():
             for batch in eval_data:
-                x, y = self._shard_batch(batch[0]), self._shard_batch(
-                    batch[1])
-                pred = self.model(x)
-                losses.append(float(self.loss(pred, y).numpy()))
+                x, y = self._shard_arr(batch[0]), self._shard_arr(batch[1])
+                pred = (self._forward_jitted(x) if self.strategy.jit
+                        else self.model(Tensor(x)))
+                losses.append(float(np.asarray(
+                    self.loss(pred, Tensor(y)).data)))
                 for m in self.metrics:
                     # hapi metric protocol: compute() may return a tuple
                     # of update()'s positional args (Metric.compute's
                     # default passes (pred, label) through)
-                    res = m.compute(pred, y)
+                    res = m.compute(pred, Tensor(y))
                     if isinstance(res, (tuple, list)):
                         m.update(*res)
                     else:
@@ -218,10 +480,12 @@ class Engine:
         outs = []
         with no_grad():
             for batch in test_data:
-                x = self._shard_batch(
+                x = self._shard_arr(
                     batch[0] if isinstance(batch, (tuple, list))
                     else batch)
-                outs.append(self.model(x).numpy())
+                pred = (self._forward_jitted(x) if self.strategy.jit
+                        else self.model(Tensor(x)))
+                outs.append(np.asarray(pred.data))
         return outs
 
     # ------------------------------------------------------------ intro ----
@@ -230,3 +494,12 @@ class Engine:
         Engine's dist_context program annotations)."""
         self.prepare()
         return dict(self.plan)
+
+    def compiled_step_hlo(self, x, y):
+        """Partitioned HLO of the train step (debug/introspection;
+        available after fit has compiled the step)."""
+        if self._jit_step is None:
+            raise RuntimeError("run fit() for at least 2 steps first")
+        return self._jit_step.lower(
+            [p._data for p in self._params],
+            [t._data for t in self._state_t], x, y).compile().as_text()

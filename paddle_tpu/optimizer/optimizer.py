@@ -139,8 +139,13 @@ class Optimizer:
 
     # -- stepping -----------------------------------------------------------
     @no_grad()
-    def step(self):
-        self._sync_lr()
+    def step(self, _sync_lr: bool = True):
+        # _sync_lr=False: caller already synced the scheduler host-side —
+        # the auto-parallel Engine's jitted step does this so the traced
+        # program reads the lr from its input instead of baking the
+        # trace-time scheduler value in as a constant
+        if _sync_lr:
+            self._sync_lr()
         params_grads = []
         for p in self._param_list:
             if p.stop_gradient or p._grad is None:

@@ -172,8 +172,134 @@ def test_replicated_sharding_does_not_count_as_user_placement():
 
 
 def test_strategy_validation():
-    with pytest.raises(NotImplementedError):
-        Strategy(pp_degree=2)
     eng = Engine(MLP(), strategy=Strategy(dp_degree=64, mp_degree=1))
     with pytest.raises(ValueError, match="devices"):
         eng.prepare()
+    # pp over a heterogeneous model raises with the design boundary
+    het = Engine(MLP(), loss=_mse, optimizer=None,
+                 strategy=Strategy(pp_degree=2))
+    with pytest.raises(ValueError, match="identical"):
+        het.prepare()
+
+
+def _mse(pred, y):
+    return ((pred - y) ** 2).mean()
+
+
+class Block(pt.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = pt.nn.Linear(32, 32)
+
+    def forward(self, x):
+        return pt.nn.functional.relu(self.fc(x)) + x
+
+
+def _seq_model(n=4):
+    return pt.nn.Sequential(*[Block() for _ in range(n)])
+
+
+def _seq_data(n=6, bs=8):
+    rng = np.random.RandomState(1)
+    for _ in range(n):
+        x = rng.randn(bs, 32).astype(np.float32)
+        y = np.tanh(x).astype(np.float32)
+        yield x, y
+
+
+def test_fit_compiles_one_step_after_warmup():
+    """v2 contract: step 1 eager (slot materialisation), steps 2+ run
+    ONE jitted program (model + loss + backward + AdamW in one module)."""
+    model = MLP()
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=model.parameters())
+    eng = Engine(model, loss=_mse, optimizer=opt,
+                 strategy=Strategy(dp_degree=8, mp_degree=1))
+    hist = eng.fit(list(_data(6)), epochs=1)
+    assert eng._jit_step is not None
+    assert hist[-1] < hist[0]
+    # introspection: the compiled step exists and contains the fused
+    # update (dot for the matmuls + the adamw multiply-adds)
+    x, y = next(iter(_data(1)))
+    hlo = eng.compiled_step_hlo(eng._shard_arr(x), eng._shard_arr(y))
+    assert "fusion" in hlo or "dot" in hlo
+
+
+def test_jitted_matches_eager_numerics():
+    data = list(_data(5))
+
+    def run(jit):
+        pt.seed(3)
+        model = MLP()
+        opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+        eng = Engine(model, loss=_mse, optimizer=opt,
+                     strategy=Strategy(dp_degree=1, mp_degree=1, jit=jit))
+        return eng.fit(data, epochs=2)
+
+    hj, he = run(True), run(False)
+    np.testing.assert_allclose(hj, he, rtol=2e-4, atol=2e-5)
+
+
+def test_engine_pp_2x2x2_single_compiled_step():
+    """VERDICT r3 target: dp x mp x pp = 2 x 2 x 2 on the CPU mesh,
+    trained through one compiled step with the pipeline inside."""
+    model = _seq_model(4)  # 4 homogeneous blocks -> 2 per stage
+    opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                             parameters=model.parameters())
+    eng = Engine(model, loss=_mse, optimizer=opt,
+                 strategy=Strategy(dp_degree=2, mp_degree=2, pp_degree=2,
+                                   min_shard_size=128,
+                                   num_microbatches=2))
+    hist = eng.fit(list(_seq_data(8)), epochs=2)
+    assert eng._jit_step is not None
+    assert hist[-1] < hist[0], hist
+    # the pipeline rides the pp axis inside the ONE compiled module:
+    # stage shift = collective-permute (or its CPU lowering)
+    x, y = next(iter(_seq_data(1)))
+    hlo = eng.compiled_step_hlo(eng._shard_arr(x), eng._shard_arr(y))
+    assert ("collective-permute" in hlo) or ("all-to-all" in hlo), \
+        "no stage-shift collective in the compiled step"
+
+
+def test_jitted_step_resamples_dropout_masks():
+    """The RNG key is threaded through the compiled step as an input —
+    post-warmup steps must NOT replay the trace-time dropout mask."""
+    class DropNet(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = pt.nn.Linear(16, 16)
+
+        def forward(self, x):
+            return pt.nn.functional.dropout(self.fc(x), p=0.5)
+
+    pt.seed(0)
+    model = DropNet()
+    opt = pt.optimizer.SGD(learning_rate=0.0,  # keep weights fixed
+                           parameters=model.parameters())
+    eng = Engine(model, loss=_mse, optimizer=opt, strategy=Strategy())
+    x = np.ones((4, 16), np.float32)
+    y = np.zeros((4, 16), np.float32)
+    # 4 steps on identical data: with lr=0 the loss varies ONLY through
+    # the dropout mask; jitted steps 2..4 must differ from each other
+    hist = eng.fit([(x, y)] * 4, epochs=1)
+    jitted_losses = hist[1:]
+    assert len(set(np.round(jitted_losses, 7))) > 1, hist
+
+
+def test_engine_pp_matches_plain_sequential():
+    """GPipe microbatching must not change the math: pp=2 training equals
+    the same model trained unpipelined (same seed, same data)."""
+    data = list(_seq_data(4))
+
+    def run(pp):
+        pt.seed(11)
+        model = _seq_model(4)
+        opt = pt.optimizer.AdamW(learning_rate=1e-2,
+                                 parameters=model.parameters())
+        eng = Engine(model, loss=_mse, optimizer=opt,
+                     strategy=Strategy(pp_degree=pp,
+                                       num_microbatches=2 if pp > 1 else 1))
+        return eng.fit(data, epochs=1)
+
+    np.testing.assert_allclose(run(2), run(1), rtol=2e-4, atol=2e-5)
