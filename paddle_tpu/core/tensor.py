@@ -32,7 +32,10 @@ class Tensor:
     def __init__(self, data, stop_gradient: bool = True, name: str = ""):
         if isinstance(data, Tensor):
             data = data._data
-        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+        if not isinstance(data, (jax.Array, jax.core.Tracer)) and \
+                not getattr(data, "_is_lazy", False):
+            # _is_lazy: jit/segments.LazyValue payloads pass through
+            # unconverted (conversion would force the pending segment)
             data = _np_to_jax(data)
         self._data = data
         self.stop_gradient = stop_gradient

@@ -68,11 +68,17 @@ class StaticFunction:
         self._layer = layer
         self._input_spec = input_spec
         # full_graph=False is the SOT graph-break analogue (reference:
-        # jit/sot fallback on untraceable bytecode): if tracing fails,
-        # permanently fall back to running the dygraph function eagerly
-        # instead of raising. full_graph=True surfaces the trace error.
+        # jit/sot translate.py:99, eval_frame.c): if whole-graph tracing
+        # fails, later calls run in SEGMENT mode (jit/segments.py) — ops
+        # record into compiled subgraphs split at the concretisation
+        # points, the break region runs eagerly. When gradients are
+        # required the segmenter defers to plain eager (the tape), which
+        # is the wholesale fallback (_fell_back). full_graph=True
+        # surfaces the trace error instead.
         self._full_graph = full_graph
         self._fell_back = False
+        self._segmented = False
+        self._seg_recorder = None
         functools.update_wrapper(self, dygraph_function)
 
         def _wrap(a):
@@ -104,9 +110,35 @@ class StaticFunction:
             return self._fn(self._layer, *args, **kwargs)
         return self._fn(*args, **kwargs)
 
+    def _run_segmented(self, *args, **kwargs):
+        from . import segments as _segments
+        from ..autograd import tape as _tape
+
+        if self._seg_recorder is None:
+            self._seg_recorder = _segments.SegmentRecorder()
+        params = self._params()
+        grads_wanted = (_tape.grad_enabled()
+                        and any(not p.stop_gradient for p in params))
+        if grads_wanted:
+            # training path: the tape needs real per-op nodes — segment
+            # capture would stop gradients; THIS call runs plain eager
+            # (not sticky: later no-grad calls still get segments)
+            return self._eager(*args, **kwargs)
+        with self._seg_recorder.active():
+            out = self._eager(*args, **kwargs)
+            return self._seg_recorder.finalize(out)
+
+    @property
+    def graph_break_stats(self):
+        """Segment-capture counters: ops_recorded (inside compiled
+        segments), ops_eager (at breaks), segments, cache_hits."""
+        return dict(self._seg_recorder.stats) if self._seg_recorder else None
+
     def __call__(self, *args, **kwargs):
         if self._fell_back:
             return self._eager(*args, **kwargs)
+        if self._segmented:
+            return self._run_segmented(*args, **kwargs)
         params = self._params()
         static_kwargs = tuple(
             (k, v) for k, v in kwargs.items()
@@ -126,10 +158,10 @@ class StaticFunction:
             if self._full_graph:
                 raise
             # graph break: untraceable python (data-dependent control
-            # flow, concretization) — run the whole function eagerly
-            # from now on (SOT splits subgraphs; we fall back wholesale)
-            self._fell_back = True
-            return self._eager(*args, **kwargs)
+            # flow, concretization). Re-run in segment mode: compiled
+            # subgraphs around the break instead of wholesale eager.
+            self._segmented = True
+            return self._run_segmented(*args, **kwargs)
 
     # reference API surface
     @property

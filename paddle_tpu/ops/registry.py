@@ -95,6 +95,9 @@ _VJP_CACHE: Dict = {}
 _VJP_SEEN: set = set()
 _VJP_UNCACHABLE: set = set()  # op names whose fns cannot be jitted
 _VJP_CACHE_MAX = 4096
+# active partial-graph recorder (jit/segments.py sets/clears this; kept
+# here so the hot dispatch path reads one module global, no import)
+_ACTIVE_SEGMENT = None
 
 
 def _flatten_call(args, kwargs):
@@ -223,6 +226,13 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
     need_grad = (differentiable and _tape.grad_enabled()
                  and any(not t.stop_gradient or t._node is not None
                          for t in tensors))
+
+    if _ACTIVE_SEGMENT is not None:
+        # partial-graph capture (jit/segments.py): record instead of
+        # execute; None means "run eagerly" (the recorder flushed first)
+        res = _ACTIVE_SEGMENT.record(name, fn, args, kwargs, need_grad)
+        if res is not None:
+            return res
 
     if not need_grad:
         uw_args = tuple(_map_structure(lambda t: t._data, a) for a in args)
