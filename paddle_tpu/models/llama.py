@@ -69,8 +69,11 @@ class LlamaConfig:
     # are not GSPMD-partitionable, so a sharded stream would all-gather.
     # True/"pallas": always (interpret mode off-TPU). False: never.
     use_fused_norm_rope: Any = "auto"
-    # context parallelism: "none" | "ring" | "ulysses" — shards the
-    # sequence dim over the mesh cp axis (parallel/context_parallel.py)
+    # context parallelism: "none" | "ring" | "ulysses" | "zigzag" —
+    # shards the sequence dim over the mesh cp axis
+    # (parallel/context_parallel.py). "zigzag" is the causal-balanced
+    # ring: tokens are laid out so every rank owns one head + one tail
+    # cell and each ring hop carries equal unmasked work.
     context_parallel: str = "none"
 
     @property
@@ -270,11 +273,14 @@ def _train_attn_fn(cfg: LlamaConfig, mesh):
     return lambda q, k, v: _fa(q, k, v, causal=True, impl=impl)
 
 
-def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
+def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None,
+                  positions=None):
     """One transformer block on [B, T, D]. ``lp`` holds this layer's
-    (unstacked) weights."""
+    (unstacked) weights. ``positions``: global token positions [B, T]
+    (defaults to arange — zigzag CP passes its permuted layout)."""
     B, T, _ = h.shape
-    positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
     # sp_spec set means the residual stream is sequence-sharded — the
     # pallas kernels would force an all-gather there, so stay unfused
     fused_nr = _fused_nr_on(cfg, mesh) and sp_spec is None
@@ -283,8 +289,9 @@ def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None):
 
 
 def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False,
-                 mesh=None):
-    fn = partial(decoder_layer, cfg=cfg, sp_spec=sp_spec, mesh=mesh)
+                 mesh=None, positions=None):
+    fn = partial(decoder_layer, cfg=cfg, sp_spec=sp_spec, mesh=mesh,
+                 positions=positions)
     if remat:
         # measured on-chip: plain full per-layer remat beats
         # save_only_these_names("attn_out") by ~2% step time at bench
@@ -298,19 +305,36 @@ def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False,
     return h
 
 
+def _zigzag_on(cfg: LlamaConfig, mesh) -> bool:
+    return (cfg.context_parallel == "zigzag" and mesh is not None
+            and mesh.shape.get("cp", 1) > 1)
+
+
 def forward(params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
-    """tokens [B, T] -> logits [B, T, V]. Single pipeline stage (pp=1)."""
+    """tokens [B, T] -> logits [B, T, V]. Single pipeline stage (pp=1).
+
+    Under zigzag CP the sequence is internally re-laid-out (one head +
+    one tail cell per cp rank, parallel/context_parallel.py
+    zigzag_global_perm) — logits come back in that order; loss_fn
+    permutes the labels identically, so training is order-consistent.
+    """
     sp_spec = None
+    positions = None
     if mesh is not None and mesh.shape.get("cp", 1) > 1:
         # context parallel: residual stream sequence-sharded over cp
         sp_spec = NamedSharding(mesh, P("dp", "cp", None))
+        if _zigzag_on(cfg, mesh):
+            from ..parallel.context_parallel import zigzag_global_perm
+            perm = zigzag_global_perm(tokens.shape[1], mesh.shape["cp"])
+            tokens = tokens[:, perm]
+            positions = jnp.broadcast_to(jnp.asarray(perm), tokens.shape)
     elif mesh is not None and mesh.shape.get("tp", 1) > 1:
         sp_spec = NamedSharding(mesh, P("dp", "tp", None))
     h = params["embed"].astype(cfg.dtype)[tokens]
     if sp_spec is not None:
         h = lax.with_sharding_constraint(h, sp_spec)
     h = _scan_layers(params["layers"], h, cfg, sp_spec, remat=cfg.remat,
-                     mesh=mesh)
+                     mesh=mesh, positions=positions)
     if _fused_nr_on(cfg, mesh) and sp_spec is None:
         from ..ops.pallas.fused_norm_rope import fused_rms_norm
         h = fused_rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
@@ -377,6 +401,11 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
         logits = forward_pipelined(params, tokens, cfg, mesh)
     else:
         logits = forward(params, tokens, cfg, mesh)
+        if _zigzag_on(cfg, mesh):
+            # logits are in the zigzag layout; pair labels the same way
+            from ..parallel.context_parallel import zigzag_global_perm
+            labels = labels[:, zigzag_global_perm(labels.shape[1],
+                                                  mesh.shape["cp"])]
     return fused_softmax_cross_entropy(logits, labels).mean()
 
 

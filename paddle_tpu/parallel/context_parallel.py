@@ -44,20 +44,18 @@ def _repeat_kv(q, k, v):
     return k, v
 
 
-def _block_accum(q, k, v, q_off, k_off, causal, sm_scale, m, l, o):
+def _block_accum(q, k, v, qpos, kpos, causal, sm_scale, m, l, o):
     """Fold one k/v block into the online-softmax state.
 
     q [B,Tq,H,D]; k/v [B,Tk,Hkv,D] (GQA heads broadcast here, locally,
     so the ring only ever carries the small Hkv chunks);
-    m,l [B,H,Tq]; o [B,Tq,H,D] (fp32). q_off/k_off are the global
-    positions of the blocks' first tokens.
+    m,l [B,H,Tq]; o [B,Tq,H,D] (fp32). qpos/kpos are the GLOBAL token
+    positions of the blocks' rows ([Tq]/[Tk] int vectors — arbitrary
+    layouts like zigzag welcome).
     """
     k, v = _repeat_kv(q, k, v)
-    Tq, Tk = q.shape[1], k.shape[1]
     s = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * sm_scale
     if causal:
-        qpos = q_off + jnp.arange(Tq)
-        kpos = k_off + jnp.arange(Tk)
         mask = qpos[:, None] >= kpos[None, :]
         s = jnp.where(mask, s, _NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1))
@@ -72,8 +70,44 @@ def _block_accum(q, k, v, q_off, k_off, causal, sm_scale, m, l, o):
     return m_new, l_new, o_new
 
 
+def chunk_positions(r, R: int, Tl: int, layout: str = "contiguous"):
+    """Global positions of rank ``r``'s local sequence slots.
+
+    contiguous: rank r holds tokens [r*Tl, (r+1)*Tl).
+    zigzag: the sequence is cut into 2R cells; rank r holds cell r and
+    cell 2R-1-r (one early + one late) — the llama-3 style causal load
+    balance: every (rank, hop) pair then carries the same unmasked area
+    (tests/test_context_parallel.py proves the count).
+    """
+    if layout == "zigzag":
+        if Tl % 2:
+            raise ValueError(
+                f"zigzag needs an even per-rank chunk (got {Tl} slots): "
+                "the global seq len must be divisible by 2*cp")
+        C = Tl // 2
+        a = jnp.arange(C)
+        return jnp.concatenate([r * C + a, (2 * R - 1 - r) * C + a])
+    return r * Tl + jnp.arange(Tl)
+
+
+def zigzag_global_perm(T: int, R: int) -> np.ndarray:
+    """Permutation placing tokens into the zigzag layout: position j of
+    the permuted sequence holds original token perm[j]; cp-sharding the
+    permuted sequence contiguously gives every rank cell r + cell
+    2R-1-r. Host-side (numpy) — it is a static data layout."""
+    if T % (2 * R):
+        raise ValueError(f"seq len {T} not divisible by 2*cp ({2 * R})")
+    C = T // (2 * R)
+    out = []
+    for r in range(R):
+        out.append(np.arange(r * C, (r + 1) * C))
+        out.append(np.arange((2 * R - 1 - r) * C, (2 * R - r) * C))
+    return np.concatenate(out)
+
+
 def ring_attention(q, k, v, *, axis_name: str = "cp", causal: bool = True,
-                   sm_scale: Optional[float] = None):
+                   sm_scale: Optional[float] = None,
+                   layout: str = "contiguous"):
     """Blockwise ring attention on per-device chunks (use inside shard_map).
 
     q/k/v are the LOCAL sequence chunks [B, T/cp, H|Hkv, Dh]; returns the
@@ -81,17 +115,19 @@ def ring_attention(q, k, v, *, axis_name: str = "cp", causal: bool = True,
     Hkv-head k/v chunks (GQA broadcast happens per-hop inside
     _block_accum), so ppermute bandwidth is Hkv/H of the naive version.
 
-    Note on causal load balance: every rank computes all R blocks and masks
-    future ones, so ~half the flops are masked work; wall-clock per hop is
-    set by the busiest rank either way — zigzag/striped sequence sharding
-    (head+tail chunk per rank) is the known fix and a future optimisation.
+    ``layout``: how local slots map to global positions (chunk_positions).
+    contiguous causal rings are imbalanced — late ranks own almost-fully
+    unmasked hops while early ranks mask almost everything; "zigzag"
+    gives every rank one head + one tail cell so each hop's unmasked
+    area is equal across ranks (the reference has no CP at all; this is
+    the standard fix from ring-flash-attention / llama-3 training).
     """
     if sm_scale is None:
         sm_scale = 1.0 / np.sqrt(q.shape[-1])
     R = lax.psum(1, axis_name)
     r = lax.axis_index(axis_name)
     B, Tl, H, D = q.shape
-    q_off = r * Tl
+    qpos = chunk_positions(r, R, Tl, layout)
 
     m0 = jnp.full((B, H, Tl), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tl), jnp.float32)
@@ -101,8 +137,9 @@ def ring_attention(q, k, v, *, axis_name: str = "cp", causal: bool = True,
     def step(carry, s):
         k_c, v_c, m, l, o = carry
         src = (r - s) % R                     # origin rank of this kv chunk
-        m, l, o = _block_accum(q, k_c, v_c, q_off, src * Tl, causal,
-                               sm_scale, m, l, o)
+        m, l, o = _block_accum(q, k_c, v_c, qpos,
+                               chunk_positions(src, R, Tl, layout),
+                               causal, sm_scale, m, l, o)
         k_c = lax.ppermute(k_c, axis_name, fwd)
         v_c = lax.ppermute(v_c, axis_name, fwd)
         return (k_c, v_c, m, l, o), None
@@ -111,8 +148,9 @@ def ring_attention(q, k, v, *, axis_name: str = "cp", causal: bool = True,
     (k_c, v_c, m, l, o), _ = lax.scan(step, (k, v, m0, l0, o0),
                                       jnp.arange(R - 1))
     src_last = (r - (R - 1)) % R
-    m, l, o = _block_accum(q, k_c, v_c, q_off, src_last * Tl, causal,
-                           sm_scale, m, l, o)
+    m, l, o = _block_accum(q, k_c, v_c, qpos,
+                           chunk_positions(src_last, R, Tl, layout),
+                           causal, sm_scale, m, l, o)
     l = jnp.where(l == 0.0, 1.0, l)           # rows with nothing to attend
     out = o / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
@@ -152,7 +190,9 @@ def context_parallel_attention(q, k, v, mesh: Mesh, *, impl: str = "ring",
     Wraps ring/ulysses in shard_map over every mesh axis that shards an
     input dim, so it drops into a GSPMD forward (models/llama.py).
     """
-    fn = {"ring": ring_attention, "ulysses": ulysses_attention}[impl]
+    fns = {"ring": ring_attention, "ulysses": ulysses_attention,
+           "zigzag": partial(ring_attention, layout="zigzag")}
+    fn = fns[impl]
     dp = "dp" if "dp" in mesh.shape else None
     tp = "tp" if "tp" in mesh.shape else None
     spec = P(dp, "cp", tp, None)

@@ -101,3 +101,108 @@ def test_llama_cp_train_step():
             state, l = step(state, batch)
             losses.append(float(l))
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# zigzag layout (causal load balance)
+# ---------------------------------------------------------------------------
+
+def _np_chunk_positions(r, R, Tl, layout):
+    if layout == "zigzag":
+        C = Tl // 2
+        a = np.arange(C)
+        return np.concatenate([r * C + a, (2 * R - 1 - r) * C + a])
+    return r * Tl + np.arange(Tl)
+
+
+@pytest.mark.parametrize("R,T", [(4, 64), (8, 64)])
+def test_zigzag_balances_per_hop_unmasked_work(R, T):
+    """The point of zigzag: at every ring hop, each rank's UNMASKED
+    score area is identical — with contiguous sharding, the same hop
+    gives some ranks a fully-masked (wasted) block and others a full
+    one, so the synchronous hop runs at the worst rank's speed."""
+    Tl = T // R
+    for layout, want_balanced in [("zigzag", True), ("contiguous", False)]:
+        per_hop_spread = []
+        for s in range(R):  # hop index
+            counts = []
+            for r in range(R):
+                qpos = _np_chunk_positions(r, R, Tl, layout)
+                kpos = _np_chunk_positions((r - s) % R, R, Tl, layout)
+                counts.append(int((qpos[:, None] >= kpos[None, :]).sum()))
+            per_hop_spread.append(max(counts) - min(counts))
+        if want_balanced:
+            assert max(per_hop_spread) == 0, (layout, per_hop_spread)
+        else:
+            assert max(per_hop_spread) > 0, (layout, per_hop_spread)
+
+
+def test_zigzag_covers_every_token_pair_once():
+    from paddle_tpu.parallel.context_parallel import zigzag_global_perm
+    R, T = 4, 32
+    perm = zigzag_global_perm(T, R)
+    assert sorted(perm.tolist()) == list(range(T))
+    # local slots of rank r are perm[r*Tl:(r+1)*Tl] and must equal the
+    # positions chunk_positions assigns
+    Tl = T // R
+    for r in range(R):
+        np.testing.assert_array_equal(
+            perm[r * Tl:(r + 1) * Tl],
+            _np_chunk_positions(r, R, Tl, "zigzag"))
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_zigzag_ring_matches_dense(causal):
+    from paddle_tpu.parallel.context_parallel import zigzag_global_perm
+    q, k, v = _qkv(jax.random.PRNGKey(3), T=32)
+    ref = flash_attention(q, k, v, causal=causal, impl="dense")
+    R = 4
+    perm = zigzag_global_perm(32, R)
+    inv = np.argsort(perm)
+    hm = init_hybrid_mesh(dp=2, cp=R, set_global=False)
+    with hm.mesh:
+        out_z = jax.jit(lambda q, k, v: context_parallel_attention(
+            q, k, v, hm.mesh, impl="zigzag", causal=causal))(
+                q[:, perm], k[:, perm], v[:, perm])
+    np.testing.assert_allclose(np.asarray(out_z[:, inv]), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_llama_zigzag_cp_matches_dense_forward():
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.parallel.context_parallel import zigzag_global_perm
+    cfg = L.LlamaConfig.tiny(dtype=jnp.float32, remat=False,
+                             use_flash_attention=False)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    ref = L.forward(params, tokens, cfg)
+
+    cfg_z = L.LlamaConfig.tiny(dtype=jnp.float32, remat=False,
+                               use_flash_attention=False,
+                               context_parallel="zigzag")
+    hm = init_hybrid_mesh(dp=2, cp=4, set_global=False)
+    perm = zigzag_global_perm(32, 4)
+    inv = np.argsort(perm)
+    with hm.mesh:
+        params_z = L.shard_params(params, cfg_z, hm.mesh)
+        out = jax.jit(lambda p, t: L.forward(p, t, cfg_z, hm.mesh))(
+            params_z, tokens)
+    # logits come back in zigzag order; unpermute to compare
+    np.testing.assert_allclose(np.asarray(out)[:, inv], np.asarray(ref),
+                               rtol=5e-4, atol=5e-4)
+
+
+def test_llama_zigzag_loss_equals_contiguous_cp_loss():
+    from paddle_tpu.models import llama as L
+    base = dict(dtype=jnp.float32, remat=False, use_flash_attention=False)
+    cfg_r = L.LlamaConfig.tiny(context_parallel="ring", **base)
+    cfg_z = L.LlamaConfig.tiny(context_parallel="zigzag", **base)
+    params = L.init_params(cfg_r, jax.random.PRNGKey(0))
+    hm = init_hybrid_mesh(dp=2, cp=4, set_global=False)
+    with hm.mesh:
+        batch = L.make_batch(cfg_r, batch_size=2, seq_len=32, mesh=hm.mesh)
+        p = L.shard_params(params, cfg_r, hm.mesh)
+        lr = jax.jit(lambda p, b: L.loss_fn(p, b, cfg_r, hm.mesh))(p, batch)
+        lz = jax.jit(lambda p, b: L.loss_fn(p, b, cfg_z, hm.mesh))(p, batch)
+    np.testing.assert_allclose(float(lr), float(lz), rtol=2e-5)
