@@ -18,6 +18,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from ..core.tensor import Tensor
 
@@ -28,9 +29,31 @@ except Exception:  # pragma: no cover
     _HAS_ORBAX = False
 
 
+def _replicated_global_sharding():
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    return NamedSharding(Mesh(np.array(jax.devices()), ("all",)),
+                         PartitionSpec())
+
+
 def _to_arrays(state_dict: Dict[str, Any]):
-    return {k: (v.data if isinstance(v, Tensor) else v)
-            for k, v in state_dict.items()}
+    """Tensor payloads; in a multi-process job, host-local arrays (one
+    process's device, the eager default) are lifted to fully-replicated
+    GLOBAL arrays — orbax refuses host-local arrays in multi-host
+    because their cross-process semantics are ambiguous. The lift
+    assumes each process holds the same value (true for replicated
+    training state; properly-sharded global arrays pass through)."""
+    out = {}
+    multi = jax.process_count() > 1
+    for k, v in state_dict.items():
+        a = v.data if isinstance(v, Tensor) else v
+        if multi and hasattr(a, "sharding") and a.is_fully_addressable:
+            from jax.experimental import multihost_utils as mhu
+            from jax.sharding import PartitionSpec
+            a = mhu.host_local_array_to_global_array(
+                np.asarray(a), _replicated_global_sharding().mesh,
+                PartitionSpec())
+        out[k] = a
+    return out
 
 
 _ASYNC_CKPT = None
@@ -80,15 +103,104 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
         raise RuntimeError("orbax-checkpoint is required for sharded load")
     path = os.path.abspath(path)
     ckpt = ocp.StandardCheckpointer()
-    template = {
-        k: (jax.ShapeDtypeStruct(v.data.shape, v.data.dtype,
-                                 sharding=getattr(v.data, "sharding", None))
-            if isinstance(v, Tensor) else v)
-        for k, v in state_dict.items()}
+    multi = jax.process_count() > 1
+    rep = _replicated_global_sharding() if multi else None
+
+    def target_sharding(arr):
+        sh = getattr(arr, "sharding", None)
+        # host-local entries restore through a replicated GLOBAL layout
+        # in multi-process jobs (mirror of _to_arrays' lift)
+        if multi and sh is not None and arr.is_fully_addressable:
+            return rep
+        return sh
+
+    lifted = set()
+    template = {}
+    for k, v in state_dict.items():
+        arr = v.data if isinstance(v, Tensor) else v
+        if hasattr(arr, "shape") and hasattr(arr, "dtype"):
+            # bare jax/numpy arrays take the same lifted path Tensors do
+            # (save lifted them too — a host-local template would hit
+            # the exact multi-host layout orbax refuses)
+            arr = jnp.asarray(arr)
+            sh = target_sharding(arr)
+            if sh is rep:
+                lifted.add(k)
+            template[k] = jax.ShapeDtypeStruct(arr.shape, arr.dtype,
+                                               sharding=sh)
+        else:
+            template[k] = v
     restored = ckpt.restore(path, template)
     for k, v in state_dict.items():
+        r = restored[k]
+        if k in lifted:
+            # back to the process-local single-device layout
+            r = jnp.asarray(r.addressable_data(0))
         if isinstance(v, Tensor):
-            v.data = restored[k]
+            v.data = r
         else:
-            state_dict[k] = restored[k]
+            state_dict[k] = r
     return state_dict
+
+
+# ---------------------------------------------------------------------------
+# elastic resume: stepped checkpoints + restart-attempt plumbing
+# ---------------------------------------------------------------------------
+# Reference: the elastic manager relaunches trainers and training resumes
+# from the newest checkpoint (fleet/elastic/manager.py:218 + the user
+# script's save/load loop). The launcher here exports
+# PADDLE_RESTART_ATTEMPT on every attempt (distributed/launch); these
+# helpers are the in-tree consumer: save per-step directories, find the
+# newest COMPLETE one (orbax commits atomically via tmp-dir + rename, so
+# a directory that exists is a finished checkpoint), restore into the
+# live state and hand back the step to continue from.
+
+def restart_attempt() -> int:
+    """Which elastic restart attempt this process is (0 = first run).
+    Set by ``paddle_tpu.distributed.launch --max_restarts N``."""
+    return int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
+
+
+def save_checkpoint(state_dict: Dict[str, Any], root: str, step: int,
+                    keep: Optional[int] = None, async_save: bool = False):
+    """Save ``state_dict`` under ``root/step_<step>``; with ``keep``,
+    prune all but the newest ``keep`` completed steps."""
+    path = os.path.join(os.path.abspath(root), f"step_{int(step)}")
+    out = save_state_dict(state_dict, path, async_save=async_save)
+    if keep is not None:
+        import shutil
+        for s, p in sorted(checkpoint_steps(root))[:-keep]:
+            shutil.rmtree(p, ignore_errors=True)
+    return out
+
+
+def checkpoint_steps(root: str):
+    """[(step, path)] of completed checkpoints under ``root``."""
+    root = os.path.abspath(root)
+    out = []
+    if not os.path.isdir(root):
+        return out
+    for name in os.listdir(root):
+        if name.startswith("step_"):
+            try:
+                out.append((int(name[5:]), os.path.join(root, name)))
+            except ValueError:
+                continue
+    return out
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    steps = checkpoint_steps(root)
+    return max(steps)[1] if steps else None
+
+
+def load_latest_checkpoint(state_dict: Dict[str, Any], root: str) -> int:
+    """Restore the newest ``root/step_*`` into ``state_dict``; returns
+    the restored step, or -1 when no checkpoint exists (fresh start —
+    begin at step 0)."""
+    steps = checkpoint_steps(root)
+    if not steps:
+        return -1
+    step, path = max(steps)
+    load_state_dict(state_dict, path)
+    return step

@@ -111,3 +111,63 @@ def test_multinode_requires_master(tmp_path):
         capture_output=True, text=True, env=env, timeout=60)
     assert res.returncode != 0
     assert "--master" in res.stderr
+
+
+def test_elastic_restart_resumes_from_checkpoint(tmp_path):
+    """The full elastic loop (VERDICT r3 #7): a 2-proc job trains and
+    checkpoints every step; rank 0 is killed mid-run on attempt 0; the
+    launcher restarts the job (--max_restarts 1) and the script resumes
+    from the newest checkpoint via PADDLE_RESTART_ATTEMPT +
+    load_latest_checkpoint — it must NOT restart from step 0."""
+    ck = tmp_path / "ckpt"
+    res = _run_launch(
+        ["--nproc", "2", "--max_restarts", "1",
+         "--env", f"CKPT_DIR={ck}", "--env", f"MARK_DIR={tmp_path}"],
+        """
+        import os
+        import numpy as np
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import paddle_tpu as pt
+        from paddle_tpu.distributed.env import init_parallel_env, get_rank
+        from paddle_tpu.distributed.checkpoint import (
+            restart_attempt, save_checkpoint, load_latest_checkpoint)
+
+        init_parallel_env()
+        rank = get_rank()
+        attempt = restart_attempt()
+        root = os.environ["CKPT_DIR"]
+
+        state = {"w": pt.to_tensor(jnp.zeros((4,), jnp.float32)),
+                 "step": pt.to_tensor(jnp.zeros((), jnp.int32))}
+        last = load_latest_checkpoint(state, root)
+        start = last + 1
+        if attempt == 0:
+            assert start == 0, start
+        else:
+            # the restart must CONTINUE, not retrain from scratch
+            assert start >= 3, f"resumed at {start}"
+            assert float(state["w"].numpy().sum()) > 0
+
+        for step in range(start, 6):
+            state["w"] = state["w"] + 1.0          # "training"
+            state["step"] = pt.to_tensor(jnp.asarray(step, jnp.int32))
+            save_checkpoint(state, root, step)
+            if attempt == 0 and step == 3 and rank == 0:
+                os._exit(13)                        # simulated crash
+
+        if rank == 0:
+            with open(os.path.join(os.environ["MARK_DIR"],
+                                   "done.txt"), "w") as f:
+                f.write(f"attempt={attempt} start={start} "
+                        f"w={float(state['w'].numpy()[0])}")
+        print("TRAINED", rank, "from", start)
+        """, tmp_path, timeout=300)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    marker = (tmp_path / "done.txt").read_text()
+    assert "attempt=1" in marker, marker
+    # resumed at >= step 4 (step 3's checkpoint was committed pre-crash)
+    assert any(f"start={s}" in marker for s in (4, 5)), marker
+    # w counts one increment per step across BOTH attempts: exactly 6
+    assert "w=6.0" in marker, marker
