@@ -243,6 +243,12 @@ class Tensor:
     def __setitem__(self, idx, value):
         idx = _unwrap_index(idx)
         v = value._data if isinstance(value, Tensor) else value
+        if getattr(self._data, "_is_lazy", False):
+            # pending segment output (jit/segments): in-place update
+            # needs the concrete array — force the segment
+            self._data = self._data._force()
+        if getattr(v, "_is_lazy", False):
+            v = v._force()
         self._data = self._data.at[idx].set(v)
 
     def __len__(self):

@@ -9,10 +9,13 @@ LeakyReLU/Hardswish/Hardsigmoid, MaxPool2D, AvgPool2D,
 AdaptiveAvgPool2D (global), Flatten, Dropout (eval identity),
 PixelShuffle-free Sequential composition.
 
-Layer call order is recorded with forward hooks on a tracing run; the
-exporter requires a LINEAR chain (each layer consumes the previous
-layer's output — true for Sequential-style models) and raises for
-branching graphs, pointing at jit.save (StableHLO) for those.
+The graph is recorded on a tracing run as a DAG of events: forward
+hooks capture leaf-layer calls, and the op registry's trace hook
+captures the FUNCTIONAL glue between them (residual adds, flatten(1),
+scalar scaling) — so branchy graphs like ResNet's residual blocks
+export as real ONNX, not just linear Sequential chains. Graphs using
+ops with no ONNX mapping fall back to jit.save (StableHLO) with a
+warning.
 """
 from __future__ import annotations
 
@@ -239,10 +242,71 @@ class _Emitter:
             return None
         return None
 
+    _ELTWISE = {"add": "Add", "subtract": "Sub", "multiply": "Mul",
+                "divide": "Div"}
+
+    def emit_functional(self, opname, args, kwargs, out_t, names,
+                        traced_ids):
+        """Emit a node for a FUNCTIONAL registry op recorded between
+        layer calls (the residual add / flatten(1) glue in forward()
+        bodies — what makes branchy graphs like ResNet exportable).
+        Returns the output name, or None when unsupported.
+
+        ``traced_ids``: ids of every tensor PRODUCED during the trace.
+        A produced-but-unnamed tensor (e.g. an element of a tuple
+        output) must abort the export — baking it as a constant would
+        freeze a zeros-derived activation into the model. Tensors that
+        predate the trace (user constants) are genuine initializers.
+        """
+        from ..core.tensor import Tensor
+
+        def in_name(v):
+            if isinstance(v, Tensor):
+                nm = names.get(id(v))
+                if nm is not None:
+                    return nm
+                if id(v) in traced_ids:
+                    return None  # un-named intermediate: not exportable
+                return self.add_init("const", np.asarray(v.data))
+            return self.add_init("const", np.asarray(v, np.float32))
+
+        o = self.tname(opname)
+        if opname in self._ELTWISE:
+            an, bn = in_name(args[0]), in_name(args[1])
+            if an is None or bn is None:
+                return None
+            self.nodes.append(_node(self._ELTWISE[opname], [an, bn], [o]))
+            return o
+        if opname == "relu":
+            an = in_name(args[0])
+            if an is None:
+                return None
+            self.nodes.append(_node("Relu", [an], [o]))
+            return o
+        if opname in ("flatten", "reshape"):
+            # static re-shape with a dynamic batch: Reshape with 0 in
+            # dim 0 (ONNX: copy the input's dim) — only valid when the
+            # op PRESERVES dim 0 (flatten(start_axis=0) / reshape([-1])
+            # fold the batch in and must fall back)
+            src = args[0]
+            if not (isinstance(src, Tensor) and src.ndim >= 1
+                    and out_t.ndim >= 1
+                    and src.shape[0] == out_t.shape[0]):
+                return None
+            an = in_name(src)
+            if an is None:
+                return None
+            tgt = [0] + [int(d) for d in out_t.shape[1:]]
+            shp = self.add_init("shape", np.asarray(tgt, np.int64))
+            self.nodes.append(_node("Reshape", [an, shp], [o]))
+            return o
+        return None
+
 
 def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
            **configs) -> str:
-    """Export a Sequential-style Layer to a real .onnx file.
+    """Export a Layer's traced graph (DAG, residual adds included) to a
+    real .onnx file.
 
     Falls back to jit.save (StableHLO) with a warning when the model
     contains layers or graph shapes the ONNX emitter doesn't cover —
@@ -257,25 +321,57 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
     decl_shape = [d if (d or 0) > 0 else None for d in spec.shape]
     shape = [d if d is not None else 1 for d in decl_shape]
 
-    # record call order with hooks on a tracing forward
-    calls = []
+    # Trace to an EVENT list: one event per supported leaf layer (the
+    # structured emitters above), plus one event per FUNCTIONAL registry
+    # op executed outside any leaf layer (the residual add, flatten(1),
+    # F.relu glue in forward() bodies) — captured via the registry's
+    # _ONNX_TRACE hook. Primitive ops fired INSIDE a leaf layer are
+    # subsumed by that layer's event (depth counter).
+    events = []
     hooks = []
+    depth = [0]
+    traced_ids = set()  # every tensor PRODUCED during the trace
+
+    def _note(out):
+        from ..core.tensor import Tensor
+        for t in (out if isinstance(out, (tuple, list)) else (out,)):
+            if isinstance(t, Tensor):
+                traced_ids.add(id(t))
+
+    def pre(l, inputs):
+        depth[0] += 1
 
     def rec(l, inputs, output):
-        calls.append((l, inputs, output))
+        depth[0] -= 1
+        _note(output)
+        if depth[0] == 0:
+            events.append(("layer", l, inputs, output))
 
     leaves = [sub for _, sub in layer.named_sublayers(include_self=True)
               if not list(sub.sublayers())]
     for sub in leaves:
+        hooks.append(sub.register_forward_pre_hook(pre))
         hooks.append(sub.register_forward_post_hook(rec))
+
+    def op_rec(name, args, kwargs, out):
+        _note(out)
+        if depth[0] == 0:
+            events.append(("op", name, args, kwargs, out))
+
     import jax.numpy as jnp
     from ..core.tensor import Tensor
+    from ..autograd import tape as _tape
+    from ..ops import registry as _registry
     was_training = layer.training
     layer.eval()
     x = Tensor(jnp.zeros(tuple(shape), jnp.float32))
+    prev_hook = _registry._ONNX_TRACE
+    _registry._ONNX_TRACE = op_rec
     try:
-        y = layer(x)
+        with _tape.no_grad():
+            y = layer(x)
     finally:
+        _registry._ONNX_TRACE = prev_hook
         if was_training:
             layer.train()
         for h in hooks:
@@ -283,39 +379,43 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
 
     em = _Emitter()
     out_name = "input"
-    obj_to_name = {}
-    supported = bool(calls)
-    for ci, (l, inputs, output) in enumerate(calls):
-        src = inputs[0] if isinstance(inputs, tuple) else inputs
-        # linear chain check: the FIRST layer must consume the traced
-        # input itself and every later layer the previous output —
-        # otherwise functional pre/inter-processing in forward() would
-        # be silently dropped from the graph
-        if ci == 0:
-            if src is not x:
+    obj_to_name = {id(x): "input"}
+    supported = bool(events)
+    for ev in events:
+        if ev[0] == "layer":
+            _, l, inputs, output = ev
+            src = inputs[0] if isinstance(inputs, tuple) else inputs
+            if id(src) not in obj_to_name:
+                supported = False  # layer fed by something untraced
+                break
+            nm = em.emit(l, obj_to_name[id(src)])
+            if nm is None:
                 supported = False
                 break
-        elif id(src) not in obj_to_name:
-            supported = False
-            break
-        cur_in = obj_to_name.get(id(src), "input")
-        nm = em.emit(l, cur_in)
-        if nm is None:
-            supported = False
-            break
-        obj_to_name = {id(output): nm}
-        out_name = nm
-    # the model's return value must BE the last layer's output, or
-    # forward() post-processing would be dropped
-    if supported and id(y) not in obj_to_name:
+            obj_to_name[id(output)] = nm
+            out_name = nm
+        else:
+            _, opname, args, kwargs, out = ev
+            nm = em.emit_functional(opname, args, kwargs, out,
+                                    obj_to_name, traced_ids)
+            if nm is None:
+                supported = False
+                break
+            obj_to_name[id(out)] = nm
+            out_name = nm
+    # the model's return value must BE a traced output, or forward()
+    # post-processing would be dropped
+    if supported and id(y) in obj_to_name:
+        out_name = obj_to_name[id(y)]
+    else:
         supported = False
-    if not supported or not calls:
+    if not supported or not events:
         import warnings
         jit.save(layer, path, input_spec=input_spec)
         warnings.warn(
-            "onnx.export covers Sequential-style chains of "
-            "Linear/Conv/BN/activation/pool layers; this model uses "
-            "other shapes — exported StableHLO to "
+            "onnx.export covers DAGs of Linear/Conv/BN/activation/pool "
+            "layers plus elementwise/reshape glue; this model uses ops "
+            "without an ONNX mapping — exported StableHLO to "
             f"{path}.pdmodel instead (paddle_tpu.inference loads it)")
         return path + ".pdmodel"
 

@@ -98,6 +98,9 @@ _VJP_CACHE_MAX = 4096
 # active partial-graph recorder (jit/segments.py sets/clears this; kept
 # here so the hot dispatch path reads one module global, no import)
 _ACTIVE_SEGMENT = None
+# op-level trace callback (onnx/export.py graph capture): called with
+# (name, args, kwargs, wrapped_out) on the no-grad dispatch path
+_ONNX_TRACE = None
 
 
 def _flatten_call(args, kwargs):
@@ -239,7 +242,10 @@ def call_op(name: str, fn: Callable, args: tuple, kwargs: dict,
         uw_kwargs = {k: _map_structure(lambda t: t._data, v)
                      for k, v in kwargs.items()}
         out = fn(*uw_args, **uw_kwargs)
-        return _wrap_outputs(name, out, node=None)
+        wrapped = _wrap_outputs(name, out, node=None)
+        if _ONNX_TRACE is not None:
+            _ONNX_TRACE(name, args, kwargs, wrapped)
+        return wrapped
 
     diff = [t for t in tensors if not t.stop_gradient or t._node is not None]
 
