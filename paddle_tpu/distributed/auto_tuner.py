@@ -78,13 +78,24 @@ def estimate_memory(m: ModelDesc, c: Candidate) -> float:
         * m.dtype_bytes / c.tp
     layer_peak = local_b * m.seq_len * (4 * m.hidden + 3 * m.ffn) \
         * m.dtype_bytes / c.tp
-    return params + grads + opt + resid + layer_peak
+    # lm-head logits (the fused-CE residual; vocab-sharded under tp) —
+    # the dominant term the first model version missed
+    # (tools/validate_tuner.py measured -11..-18% without it)
+    logits = local_b * m.seq_len * m.vocab * m.dtype_bytes / c.tp
+    return params + grads + opt + resid + layer_peak + logits
 
 
 def estimate_step_cost(m: ModelDesc, c: Candidate,
-                       flops_per_sec: float = 150e12,
+                       flops_per_sec: float = 125e12,
                        ici_bytes_per_sec: float = 40e9) -> float:
-    """Relative step time: MXU time + pipeline bubble + ICI collectives."""
+    """Relative step time: MXU time + pipeline bubble + ICI collectives.
+
+    ``flops_per_sec`` default is the MEASURED effective single-chip
+    throughput at bench shapes with remat recompute folded in (~125
+    TF/s on v5e; tools/validate_tuner.py), not the 197 TF/s paper peak
+    — the validation table in docs/PERF.md shows the residual error is
+    depth-dependent (the MXU-only model ignores elementwise time, which
+    grows as 1/hidden)."""
     tokens = m.global_batch * m.seq_len
     flops = 6 * m.n_params * tokens / c.world
     t_mxu = flops / flops_per_sec
@@ -132,16 +143,22 @@ def candidates(n_devices: int, m: ModelDesc,
 
 def search(n_devices: int, m: ModelDesc, hbm_bytes: float = 16e9,
            measure: Optional[Callable[[Candidate], float]] = None,
-           top_k: int = 5, **kw) -> List[Candidate]:
+           top_k: int = 5, headroom: float = 1.15, **kw) -> List[Candidate]:
     """Prune by the memory model, rank by the cost model, optionally
     re-rank the top_k by measuring real steps (the reference tuner's
-    prune-then-launch loop)."""
+    prune-then-launch loop).
+
+    ``headroom`` derates HBM for the model's measured bias + XLA
+    temp/fragmentation slack (docs/PERF.md validation table): an
+    under-estimating pruner admits OOM configs, the costlier failure.
+    """
     cands = candidates(n_devices, m, **kw)
     for c in cands:
         c.mem_bytes = estimate_memory(m, c)
-        if c.mem_bytes > hbm_bytes:
+        if c.mem_bytes * headroom > hbm_bytes:
             c.feasible = False
-            c.reason = (f"est. {c.mem_bytes/2**30:.1f} GiB > "
+            c.reason = (f"est. {c.mem_bytes/2**30:.1f} GiB x "
+                        f"{headroom} headroom > "
                         f"{hbm_bytes/2**30:.1f} GiB HBM")
             continue
         c.step_cost = estimate_step_cost(m, c)
