@@ -27,6 +27,7 @@ from ..autograd import tape as _tape
 class Tensor:
     __slots__ = ("_data", "stop_gradient", "_grad", "_node", "_out_index",
                  "_grad_hooks", "_retain_grads", "name", "persistable",
+                 "_partial_dims", "_partial_reduce",  # dist Partial state
                  "__weakref__")
 
     def __init__(self, data, stop_gradient: bool = True, name: str = ""):

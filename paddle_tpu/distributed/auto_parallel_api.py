@@ -10,21 +10,31 @@ placements → PartitionSpec + device_put, and "reshard" is a resharding
 device_put that XLA turns into the right collective.
 
 Partial placements: in the reference, Partial marks per-rank unreduced
-values (the 'p' in the r/s/p lattice). Under a single controller a global
-array is never in a partial state outside shard_map, so Partial here maps
-to replication (already-reduced); it is accepted for API compatibility and
-is meaningful in the shard_map-level collectives (communication.py).
+values (the 'p' in the r/s/p lattice, reshard/ 30 C++ files). The
+single-controller encoding here is a CONTRIBUTION STACK: a Partial
+tensor's payload carries one leading axis per partial mesh dim, sharded
+over that mesh dim — each mesh slice holds its own unreduced term (an
+r→p conversion puts the whole value in slot 0 and zeros elsewhere, the
+reference's owner-rank convention). ``reshard`` then realises the
+lattice edges with their true costs: p→r sums over the stacked axis
+(XLA: all-reduce), p→s(d) sums with the result sharded on d (XLA:
+reduce-scatter). A Partial tensor must be resharded before elementwise
+use — mirroring the reference, where SPMD rules insert that reduction.
 """
 from __future__ import annotations
 
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec
 
 from .placement import Placement, Shard, Replicate, Partial
 from .process_mesh import ProcessMesh
 from ..core.tensor import Tensor
+
+_REDUCERS = {"sum": jnp.sum, "avg": jnp.mean, "mean": jnp.mean,
+             "max": jnp.max, "min": jnp.min}
 
 
 def _to_spec(mesh: ProcessMesh, placements: Sequence[Placement],
@@ -53,13 +63,19 @@ def _to_spec(mesh: ProcessMesh, placements: Sequence[Placement],
     return PartitionSpec(*entries)
 
 
-def _placements_of(arr: jax.Array, mesh: ProcessMesh) -> List[Placement]:
-    """Derive reference-style placements from an array's NamedSharding."""
+def _placements_of(t, mesh: ProcessMesh) -> List[Placement]:
+    """Derive reference-style placements from a Tensor (or array)."""
     placements: List[Placement] = [Replicate()] * mesh.ndim
+    arr = t.data if isinstance(t, Tensor) else t
+    pdims = getattr(t, "_partial_dims", ()) or ()
+    pred = getattr(t, "_partial_reduce", ()) or ()
+    for k, d in enumerate(pdims):
+        placements[d] = Partial(pred[k] if k < len(pred) else "sum")
     sharding = getattr(arr, "sharding", None)
     if not isinstance(sharding, NamedSharding):
         return placements
-    for tdim, entry in enumerate(sharding.spec):
+    lead = len(pdims)  # contribution-stack axes precede tensor dims
+    for tdim, entry in enumerate(sharding.spec[lead:]):
         if entry is None:
             continue
         names = entry if isinstance(entry, tuple) else (entry,)
@@ -69,28 +85,126 @@ def _placements_of(arr: jax.Array, mesh: ProcessMesh) -> List[Placement]:
     return placements
 
 
+def _mark_partial(out: Tensor, pdims, reduces) -> Tensor:
+    out._partial_dims = tuple(pdims)
+    out._partial_reduce = tuple(reduces)
+    return out
+
+
 def shard_tensor(data, mesh: ProcessMesh,
                  placements: Sequence[Placement],
                  dtype=None, place=None, stop_gradient=None) -> Tensor:
-    """Place ``data`` on ``mesh`` with ``placements`` (api.py:194)."""
+    """Place ``data`` on ``mesh`` with ``placements`` (api.py:194).
+
+    A ``Partial`` placement produces the contribution-stack encoding
+    (module docstring): the logical value is preserved (slot 0 holds it,
+    other slots are the reduce identity), per-device memory is the
+    original shard size (the stack axis is sharded over the mesh dim).
+    """
     t = data if isinstance(t := data, Tensor) else Tensor(data)
     if len(placements) != mesh.ndim:
         raise ValueError(
             f"need {mesh.ndim} placements (one per mesh dim), "
             f"got {len(placements)}")
-    spec = _to_spec(mesh, placements, t.ndim)
-    arr = jax.device_put(t.data, NamedSharding(mesh.jax_mesh, spec))
-    out = Tensor(arr, stop_gradient=(t.stop_gradient if stop_gradient is None
-                                     else stop_gradient))
-    return out
+    if getattr(t, "_partial_dims", None):
+        out = reshard(t, mesh, placements)
+        if stop_gradient is not None:
+            out.stop_gradient = stop_gradient
+        return out
+    sg = t.stop_gradient if stop_gradient is None else stop_gradient
+    pdims = tuple(i for i, p in enumerate(placements)
+                  if isinstance(p, Partial))
+    base = _to_spec(mesh, placements, t.ndim)
+    if not pdims:
+        arr = jax.device_put(t.data, NamedSharding(mesh.jax_mesh, base))
+        return Tensor(arr, stop_gradient=sg)
+    for d in pdims:
+        rt = placements[d].reduce_type
+        if rt not in ("sum", "avg", "mean"):
+            raise NotImplementedError(
+                f"r->p with reduce_type={rt!r}: only additive partials "
+                "can be built from a dense value (max/min have no "
+                "owner-plus-identity decomposition that XLA folds)")
+    # build the stack innermost-out so mixed reducers compose exactly:
+    # sum-dims get a one-hot slot (sum == value), mean-dims broadcast
+    # (mean of n copies == value)
+    def build_stack(v):
+        for d in reversed(pdims):
+            n = mesh.shape[d]
+            if placements[d].reduce_type == "sum":
+                v = jnp.zeros((n,) + v.shape, v.dtype).at[0].set(v)
+            else:  # avg/mean
+                v = jnp.broadcast_to(v, (n,) + v.shape)
+        return v
+
+    names = [mesh.dim_names[d] for d in pdims]
+    spec = PartitionSpec(*names, *tuple(base))
+    # build INSIDE jit with the sharded out_shardings: each device
+    # materialises only its own stack slot — an eager zeros+set would
+    # allocate the full n-times stack on one device first
+    arr = jax.jit(build_stack,
+                  out_shardings=NamedSharding(mesh.jax_mesh, spec)
+                  )(t.data)
+    out = Tensor(arr, stop_gradient=sg)
+    return _mark_partial(out, pdims,
+                         [placements[d].reduce_type for d in pdims])
 
 
 def reshard(t: Tensor, mesh: ProcessMesh,
             placements: Sequence[Placement]) -> Tensor:
-    """Transition to new placements (api.py:716). XLA emits the matching
-    collective (all-gather for s→r, dynamic-slice for r→s, all-to-all for
-    s(i)→s(j)) — the whole 30-file reshard lattice collapses to this."""
-    return shard_tensor(t, mesh, placements)
+    """Transition to new placements (api.py:716) — the whole 30-file
+    reshard lattice as layout transitions XLA lowers to collectives:
+    s→r all-gather, r→s slice, s(i)→s(j) all-to-all, p→r sum over the
+    sharded stack (all-reduce), p→s(d) the same sum with the result
+    sharded on d (reduce-scatter). Cross-mesh reshard (a different
+    ProcessMesh over the same devices) is a device_put like any other.
+    """
+    cur_p = tuple(getattr(t, "_partial_dims", ()) or ())
+    if not cur_p:
+        return shard_tensor(t, mesh, placements)
+    if len(placements) != mesh.ndim:
+        raise ValueError(
+            f"need {mesh.ndim} placements (one per mesh dim), "
+            f"got {len(placements)}")
+    reduces = tuple(getattr(t, "_partial_reduce", ()) or ())
+    tgt_p = tuple(i for i, p in enumerate(placements)
+                  if isinstance(p, Partial))
+    new_p = set(tgt_p) - set(cur_p)
+    if new_p:
+        raise NotImplementedError(
+            f"reshard cannot introduce NEW partial dims {sorted(new_p)} "
+            "on an already-partial tensor; reduce first")
+    arr = t.data
+    keep, drop = [], []
+    for k, d in enumerate(cur_p):
+        (keep if d in tgt_p else drop).append(k)
+    norm = lambda r: "mean" if r in ("avg", "mean") else r
+    for k in keep:
+        d = cur_p[k]
+        # kept partial dims: slot count must match the TARGET mesh dim
+        # (kept-partial across a reshaped mesh has no sound remap)...
+        if arr.shape[k] != mesh.shape[d]:
+            raise NotImplementedError(
+                f"Partial dim {d} kept across a mesh change "
+                f"(stack {arr.shape[k]} slots vs mesh dim "
+                f"{mesh.shape[d]}); reduce to Replicate/Shard first")
+        # ...and the requested reduce_type must agree with the stored one
+        if norm(placements[d].reduce_type) != norm(reduces[k]):
+            raise ValueError(
+                f"Partial dim {d} carries reduce_type={reduces[k]!r}; "
+                f"resharding it as Partial({placements[d].reduce_type!r})"
+                " would silently change the pending reduction")
+    for k in sorted(drop, reverse=True):
+        arr = _REDUCERS[reduces[k]](arr, axis=k)
+    remaining = [cur_p[k] for k in keep]
+    # the tensor's LOGICAL rank excludes the contribution-stack axes
+    base = _to_spec(mesh, placements, t.ndim - len(cur_p))
+    names = [mesh.dim_names[d] for d in remaining]
+    spec = PartitionSpec(*names, *tuple(base))
+    out = Tensor(jax.device_put(arr, NamedSharding(mesh.jax_mesh, spec)),
+                 stop_gradient=t.stop_gradient)
+    return _mark_partial(out, remaining,
+                         [reduces[k] for k in keep])
 
 
 def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
@@ -99,14 +213,18 @@ def dtensor_from_fn(fn: Callable, mesh: ProcessMesh,
 
 
 def unshard_dtensor(t: Tensor) -> Tensor:
-    """Gather to a fully-replicated tensor (api.py dtensor_to_local-ish)."""
-    devs = getattr(t.data, "sharding", None)
-    if devs is None:
-        return t
-    mesh = getattr(devs, "mesh", None)
-    if mesh is None:
-        return t
-    arr = jax.device_put(t.data, NamedSharding(mesh, PartitionSpec()))
+    """Gather to a fully-replicated tensor (api.py dtensor_to_local-ish);
+    pending partial reductions are applied first."""
+    cur_p = tuple(getattr(t, "_partial_dims", ()) or ())
+    arr = t.data
+    if cur_p:
+        reduces = tuple(getattr(t, "_partial_reduce", ()) or ())
+        for k in range(len(cur_p) - 1, -1, -1):
+            arr = _REDUCERS[reduces[k]](arr, axis=k)
+    devs = getattr(arr, "sharding", None)
+    mesh = getattr(devs, "mesh", None) if devs is not None else None
+    if mesh is not None:
+        arr = jax.device_put(arr, NamedSharding(mesh, PartitionSpec()))
     return Tensor(arr, stop_gradient=t.stop_gradient)
 
 

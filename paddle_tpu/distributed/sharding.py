@@ -85,9 +85,31 @@ def group_sharded_parallel(model, optimizer, level: str = "os_g",
                            segment_size=None, sync_comm: bool = False,
                            dp_group=None, exclude_layer=None):
     """Reference: paddle.distributed.sharding.group_sharded_parallel.
-    level: "os" (stage 1) | "os_g" (stage 2) | "p_g_os" (stage 3)."""
+    level: "os" (stage 1) | "os_g" (stage 2) | "p_g_os" (stage 3).
+
+    Knobs that configure the reference's hand-rolled communication
+    schedule have no GSPMD equivalent and are rejected loudly rather
+    than silently accepted: XLA owns bucketing (buffer_max_size /
+    segment_size), schedules its own collectives (sync_comm), and HBM
+    offload is a remat/policy decision here (offload)."""
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"unknown sharding level {level!r}")
+    import warnings
+    for name, val, why in [
+            ("offload", offload, "use jax.checkpoint policies / remat "
+             "to trade HBM for FLOPs"),
+            ("sync_buffers", sync_buffers, "buffers replicate under "
+             "GSPMD; there is no per-rank buffer drift to sync"),
+            ("buffer_max_size", buffer_max_size, "XLA's collective "
+             "combiner owns gradient bucketing"),
+            ("segment_size", segment_size, "XLA partitions parameters; "
+             "there is no manual segmenting"),
+            ("sync_comm", sync_comm, "XLA schedules collectives; there "
+             "is no async comm stream to synchronize")]:
+        if val:
+            warnings.warn(
+                f"group_sharded_parallel({name}=...) has no effect in "
+                f"the GSPMD formulation — {why}", stacklevel=2)
     optimizer = shard_optimizer_states(optimizer)
     # stage 2's grad sharding falls out of param/opt layout under GSPMD:
     # grads inherit the layout of their use site (the sharded opt update)
