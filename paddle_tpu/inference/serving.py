@@ -1,0 +1,198 @@
+"""Serving-side dynamic batching.
+
+Reference capability: the inference product's request batching
+(paddle/fluid/inference/api — AnalysisPredictor is wrapped by serving
+frontends that coalesce requests; the fused generation kernels likewise
+exist to serve many streams per device). TPU-native shape: one XLA
+program per (bucketed) batch size, a single background worker that
+coalesces concurrent requests into the largest batch available within a
+latency budget, pads the batch dim to a bucket (bounding the number of
+compilations), runs the predictor once, and scatters the rows back to
+their callers' futures.
+
+    pred = DynamicBatcher(lambda x: predictor(x)[0],
+                          max_batch_size=8, max_delay_ms=4)
+    y = pred.infer(x_row)          # blocking; batched under the hood
+    fut = pred.submit(x_row)       # async; fut.result()
+
+Requests are grouped by their trailing (per-example) shape/dtype —
+mixed-shape traffic never lands in one batch. ``stats`` exposes
+request/batch counts for monitoring the coalescing ratio.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DynamicBatcher"]
+
+
+class _Request:
+    __slots__ = ("arr", "future", "key")
+
+    def __init__(self, arr, key):
+        self.arr = arr
+        self.key = key
+        self.future: Future = Future()
+
+
+class DynamicBatcher:
+    """Coalesce single-example requests into padded batches.
+
+    fn: callable mapping a batched array ``[B, ...]`` to either one
+    array ``[B, ...]`` or a tuple/list of arrays each with leading B.
+    max_batch_size: largest batch handed to ``fn``.
+    max_delay_ms: how long the worker waits for more same-shape
+      requests after the first one arrives (the latency/throughput
+      knob; 0 = never wait).
+    batch_buckets: batch sizes the batch dim is padded UP to (bounds
+      the number of XLA compilations); default powers of two up to
+      max_batch_size.
+    """
+
+    def __init__(self, fn: Callable, max_batch_size: int = 8,
+                 max_delay_ms: float = 4.0,
+                 batch_buckets: Optional[Sequence[int]] = None):
+        if max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        self._fn = fn
+        self._max_b = int(max_batch_size)
+        self._delay = max(float(max_delay_ms), 0.0) / 1e3
+        if batch_buckets is None:
+            batch_buckets = []
+            b = 1
+            while b < self._max_b:
+                batch_buckets.append(b)
+                b *= 2
+            batch_buckets.append(self._max_b)
+        self._buckets = sorted(set(int(b) for b in batch_buckets))
+        if self._buckets[-1] != self._max_b:
+            raise ValueError("batch_buckets must include max_batch_size")
+        self._q: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        # mismatched-shape requests popped mid-coalesce wait here and
+        # SEED the next batch — requeueing to the FIFO's back would let
+        # sustained same-shape traffic starve them forever
+        self._stash: "deque[_Request]" = deque()
+        self.stats = {"requests": 0, "batches": 0, "padded_rows": 0}
+        self._closed = False
+        self._lock = threading.Lock()  # orders submit() vs close()
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    # ------------------------------------------------------------- API ----
+    def submit(self, x) -> Future:
+        """Queue one example (NO leading batch dim); returns a Future of
+        its result row (same structure ``fn`` returns, minus batch)."""
+        arr = np.asarray(x)
+        req = _Request(arr, (arr.shape, str(arr.dtype)))
+        with self._lock:
+            # under the lock, a request either precedes the close
+            # sentinel in the queue (and is drained) or raises — it can
+            # never land behind the sentinel and hang its caller
+            if self._closed:
+                raise RuntimeError("DynamicBatcher is closed")
+            self._q.put(req)
+        return req.future
+
+    def infer(self, x):
+        return self.submit(x).result()
+
+    def close(self):
+        """Drain and stop the worker (idempotent)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._q.put(None)
+        self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---------------------------------------------------------- worker ----
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        return self._buckets[-1]
+
+    def _loop(self):
+        import time
+        stopping = False
+        while not stopping:
+            if self._stash:
+                req = self._stash.popleft()  # stashed requests go FIRST
+            else:
+                req = self._q.get()
+                if req is None:
+                    break
+            batch = [req]
+            # same-shape companions already waiting in the stash
+            for r in list(self._stash):
+                if len(batch) >= self._max_b:
+                    break
+                if r.key == req.key:
+                    self._stash.remove(r)
+                    batch.append(r)
+            deadline = time.monotonic() + self._delay
+            # coalesce same-shape requests until full or the budget ends
+            while len(batch) < self._max_b:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=timeout)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    stopping = True  # run this batch, then drain below
+                    break
+                if nxt.key == req.key:
+                    batch.append(nxt)
+                else:
+                    self._stash.append(nxt)  # seeds the NEXT batch
+            self._run(batch)
+        # drain anything left after close() — every accepted request
+        # resolves (submit() orders itself before the sentinel)
+        leftovers = list(self._stash)
+        self._stash.clear()
+        while True:
+            try:
+                r = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if r is not None:
+                leftovers.append(r)
+        for r in leftovers:
+            self._run([r])
+
+    def _run(self, batch):
+        n = len(batch)
+        b = self._bucket(n)
+        self.stats["requests"] += n
+        self.stats["batches"] += 1
+        self.stats["padded_rows"] += b - n
+        stacked = np.stack([r.arr for r in batch])
+        if b > n:
+            pad = np.zeros((b - n,) + stacked.shape[1:], stacked.dtype)
+            stacked = np.concatenate([stacked, pad])
+        try:
+            out = self._fn(stacked)
+        except Exception as e:  # propagate to every caller in the batch
+            for r in batch:
+                r.future.set_exception(e)
+            return
+        multi = isinstance(out, (tuple, list))
+        outs = list(out) if multi else [out]
+        outs = [np.asarray(o) for o in outs]
+        for i, r in enumerate(batch):
+            row = tuple(o[i] for o in outs) if multi else outs[0][i]
+            r.future.set_result(row)

@@ -236,9 +236,32 @@ def save(layer_or_fn, path: str, input_spec: Optional[Sequence] = None,
                 lambda t: t.data if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor))
 
-        args_shape = [jax.ShapeDtypeStruct(tuple(s.shape),
-                                           jnp.dtype(str(s.dtype)))
-                      for s in input_spec]
+        # InputSpec dims that are None/-1 export as SYMBOLIC dims (the
+        # reference's dynamic-batch saved models). Naming rules, shared
+        # one scope so equal names unify across inputs:
+        #   * a STRING dim is that symbol verbatim — the explicit way to
+        #     tie dims across inputs (InputSpec(["batch", 6]) twice);
+        #   * None/-1 at axis 0 is "batch" for every input (multi-input
+        #     models combine along the batch dim; distinct per-input
+        #     symbols could never unify and the export would fail);
+        #   * None/-1 elsewhere gets a unique symbol b{i}_{j}.
+        scope = jexport.SymbolicScope()
+
+        def sds(spec, i):
+            dims = tuple(spec.shape)
+            if any(d is None or isinstance(d, str)
+                   or (isinstance(d, int) and d < 0) for d in dims):
+                def sym(j, d):
+                    if isinstance(d, str):
+                        return d
+                    if d is None or d < 0:
+                        return "batch" if j == 0 else f"b{i}_{j}"
+                    return str(d)
+                txt = ", ".join(sym(j, d) for j, d in enumerate(dims))
+                dims = jexport.symbolic_shape(txt, scope=scope)
+            return jax.ShapeDtypeStruct(dims, jnp.dtype(str(spec.dtype)))
+
+        args_shape = [sds(s, i) for i, s in enumerate(input_spec)]
         params_shape = [jax.ShapeDtypeStruct(p.data.shape, p.data.dtype)
                         for p in params]
         exported = jexport.export(jax.jit(pure))(params_shape, args_shape)

@@ -47,3 +47,70 @@ def test_predictor_rejects_trt(saved_model):
     cfg = infer.Config(path)
     with pytest.raises(NotImplementedError):
         cfg.enable_tensorrt_engine()
+
+
+# ---------------------------------------------------------------------------
+# dynamic batching (inference/serving.py)
+# ---------------------------------------------------------------------------
+
+def test_dynamic_batcher_coalesces_and_matches_single():
+    import threading
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.inference.serving import DynamicBatcher
+
+    w = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    fn = jax.jit(lambda x: x @ jnp.asarray(w))
+
+    with DynamicBatcher(fn, max_batch_size=8, max_delay_ms=30) as b:
+        xs = [np.random.RandomState(i).randn(8).astype(np.float32)
+              for i in range(12)]
+        futs = []
+        # submit concurrently so the worker can coalesce
+        threads = [threading.Thread(target=lambda x=x: futs.append(
+            (x, b.submit(x)))) for x in xs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for x, f in futs:
+            np.testing.assert_allclose(np.asarray(f.result()), x @ w,
+                                       rtol=1e-5)
+        stats = dict(b.stats)
+    assert stats["requests"] == 12
+    assert stats["batches"] < 12, stats  # some coalescing happened
+
+
+def test_dynamic_batcher_shape_isolation_and_padding():
+    from paddle_tpu.inference.serving import DynamicBatcher
+    calls = []
+
+    def fn(x):
+        calls.append(x.shape)
+        return x * 2
+
+    with DynamicBatcher(fn, max_batch_size=4, max_delay_ms=0) as b:
+        r1 = b.infer(np.ones((3,), np.float32))
+        r2 = b.infer(np.ones((5,), np.float32))
+    np.testing.assert_array_equal(r1, np.full((3,), 2, np.float32))
+    np.testing.assert_array_equal(r2, np.full((5,), 2, np.float32))
+    # each ran in its own (bucketed) batch; batch dims are bucket sizes
+    assert all(s[0] in (1, 2, 4) for s in calls), calls
+    assert {s[1:] for s in calls} == {(3,), (5,)}
+
+
+def test_dynamic_batcher_tuple_outputs_and_errors():
+    from paddle_tpu.inference.serving import DynamicBatcher
+
+    def fn(x):
+        if np.isnan(x).any():
+            raise ValueError("nan batch")
+        return x + 1, x.sum(axis=tuple(range(1, x.ndim)))
+
+    with DynamicBatcher(fn, max_batch_size=2, max_delay_ms=0) as b:
+        row, s = b.infer(np.ones((2, 2), np.float32))
+        np.testing.assert_array_equal(row, np.full((2, 2), 2, np.float32))
+        assert float(s) == 4.0
+        f = b.submit(np.full((2, 2), np.nan, np.float32))
+        with pytest.raises(ValueError, match="nan batch"):
+            f.result()

@@ -88,3 +88,52 @@ def test_distributed_checkpoint_reshard(tmp_path):
     state = {"w": target}
     dckpt.load_state_dict(state, path)
     np.testing.assert_array_equal(state["w"].numpy(), w.numpy())
+
+
+def test_jit_save_dynamic_batch_dim(tmp_path):
+    """InputSpec([None, D]) exports a symbolic batch dim — the loaded
+    program accepts ANY batch size (the serving path's requirement)."""
+    import paddle_tpu as pt
+    from paddle_tpu.jit import InputSpec
+    m = pt.nn.Sequential(pt.nn.Linear(6, 3))
+    pt.jit.save(m, str(tmp_path / "dyn"), input_spec=[InputSpec([None, 6])])
+    loaded = pt.jit.load(str(tmp_path / "dyn"))
+    w = np.asarray(m[0].weight.data)
+    b = np.asarray(m[0].bias.data)
+    for bs in (1, 2, 7):
+        x = np.random.RandomState(bs).randn(bs, 6).astype(np.float32)
+        out = loaded(x)
+        np.testing.assert_allclose(np.asarray(out.data), x @ w + b,
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_jit_save_multi_input_shared_batch(tmp_path):
+    """Two dynamic-batch inputs that combine in forward: their None dims
+    must unify into ONE symbolic batch or the export cannot trace."""
+    import paddle_tpu as pt
+    from paddle_tpu.jit import InputSpec
+
+    class TwoIn(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = pt.nn.Linear(6, 2)
+
+        def forward(self, a, b):
+            return self.fc(a + b)
+
+    m = TwoIn()
+    pt.jit.save(m, str(tmp_path / "two"),
+                input_spec=[InputSpec([None, 6]), InputSpec([None, 6])])
+    loaded = pt.jit.load(str(tmp_path / "two"))
+    a = np.random.RandomState(0).randn(3, 6).astype(np.float32)
+    b = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+    w = np.asarray(m.fc.weight.data)
+    bias = np.asarray(m.fc.bias.data)
+    np.testing.assert_allclose(np.asarray(loaded(a, b).data),
+                               (a + b) @ w + bias, rtol=1e-4, atol=1e-5)
+    # string dims are usable symbols too
+    pt.jit.save(m, str(tmp_path / "twos"),
+                input_spec=[InputSpec(["n", 6]), InputSpec(["n", 6])])
+    loaded2 = pt.jit.load(str(tmp_path / "twos"))
+    np.testing.assert_allclose(np.asarray(loaded2(a, b).data),
+                               (a + b) @ w + bias, rtol=1e-4, atol=1e-5)
