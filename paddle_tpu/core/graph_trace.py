@@ -1,0 +1,106 @@
+"""Shared layer-graph tracer.
+
+One tracing forward that records, at TOP level (outside any leaf
+layer), both leaf-layer calls and functional registry ops — the
+machinery behind `onnx/export.py` (graph emission) and
+`inference/passes.py` (dataflow-verified folds). Keeping it in one
+place means tuple outputs, kwargs tensors and consumer accounting
+behave identically for every consumer of the trace.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Set, Tuple
+
+import jax
+
+from .tensor import Tensor
+
+
+@dataclass
+class TraceResult:
+    #: ordered top-level events:
+    #:   ("layer", layer, inputs, output) | ("op", name, args, kwargs, out)
+    events: List[Tuple] = field(default_factory=list)
+    #: id(tensor) -> number of top-level consumptions (leaf-layer inputs
+    #: + depth-0 op args + model outputs)
+    consumers: Dict[int, int] = field(default_factory=dict)
+    #: ids of every tensor PRODUCED during the trace
+    traced_ids: Set[int] = field(default_factory=set)
+    #: per-layer top-level call counts (object identity)
+    layer_calls: Dict[int, int] = field(default_factory=dict)
+    #: the model's return value
+    y: Any = None
+    #: strong refs — a GC'd tensor's id would be recycled mid-trace
+    keep: List[Any] = field(default_factory=list)
+
+    def consumed(self, v):
+        if isinstance(v, Tensor):
+            self.keep.append(v)
+            self.consumers[id(v)] = self.consumers.get(id(v), 0) + 1
+
+    def produced(self, out):
+        for t in (out if isinstance(out, (tuple, list)) else (out,)):
+            if isinstance(t, Tensor):
+                self.keep.append(t)
+                self.traced_ids.add(id(t))
+
+
+def trace_layer_graph(model, x: Tensor) -> TraceResult:
+    """Run ``model(x)`` in eval/no-grad with recording hooks installed;
+    restores training mode and hooks afterwards."""
+    from ..autograd import tape as _tape
+    from ..ops import registry as _registry
+
+    res = TraceResult()
+    depth = [0]
+    hooks = []
+
+    def pre(l, inputs):
+        if depth[0] == 0:
+            for v in (inputs if isinstance(inputs, tuple) else (inputs,)):
+                res.consumed(v)
+        depth[0] += 1
+
+    def post(l, inputs, output):
+        depth[0] -= 1
+        res.produced(output)
+        if depth[0] == 0:
+            res.events.append(("layer", l, inputs, output))
+            res.layer_calls[id(l)] = res.layer_calls.get(id(l), 0) + 1
+            src = inputs[0] if isinstance(inputs, tuple) else inputs
+            res.keep.append(src)
+
+    leaves = [s for _, s in model.named_sublayers(include_self=True)
+              if not list(s.sublayers())]
+    for s in leaves:
+        hooks.append(s.register_forward_pre_hook(pre))
+        hooks.append(s.register_forward_post_hook(post))
+
+    def op_rec(name, args, kwargs, out):
+        res.produced(out)
+        if depth[0] == 0:
+            for a in list(args) + list(kwargs.values()):
+                jax.tree_util.tree_map(
+                    res.consumed, a,
+                    is_leaf=lambda v: isinstance(v, Tensor))
+            res.events.append(("op", name, args, kwargs, out))
+
+    was_training = model.training
+    model.eval()
+    prev_hook = _registry._ONNX_TRACE
+    _registry._ONNX_TRACE = op_rec
+    try:
+        with _tape.no_grad():
+            res.y = model(x)
+    finally:
+        _registry._ONNX_TRACE = prev_hook
+        if was_training:
+            model.train()
+        for h in hooks:
+            h.remove()
+    # the model's outputs are consumers too: a tensor that is RETURNED
+    # must not be treated as exclusively feeding its one layer consumer
+    for t in (res.y if isinstance(res.y, (tuple, list)) else (res.y,)):
+        res.consumed(t)
+    return res

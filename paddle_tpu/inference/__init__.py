@@ -179,6 +179,10 @@ class GenerationPredictor:
         return np.asarray(out)
 
 
+from .passes import fold_batch_norms  # noqa: E402,F401  (IR-pass analogue)
+from .serving import DynamicBatcher  # noqa: E402,F401
+
+
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 

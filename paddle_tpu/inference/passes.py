@@ -1,0 +1,141 @@
+"""Inference-time graph optimization passes.
+
+Reference capability: the AnalysisPredictor's IR pass library
+(paddle/fluid/framework/ir/ — 290 fusion passes, of which
+conv_bn_fuse_pass and friends are the workhorses for CNN deployment).
+On TPU, XLA already fuses elementwise chains at compile time, so most
+of that library is moot — but PARAMETER-level folds still pay: folding
+a BatchNorm's affine into the preceding Conv/Linear weights removes the
+op (and its weights) from the saved artifact entirely, before XLA ever
+sees it.
+
+``fold_batch_norms(model, input_spec)`` rewrites the model IN PLACE:
+
+    w' = w * gamma / sqrt(var + eps)        (per out-channel)
+    b' = (b - mean) * gamma / sqrt(var + eps) + beta
+
+The conv→bn pairing is DATAFLOW-verified, not guessed from attribute
+order: a tracing forward (hooks + the registry's op-trace, the
+onnx/export.py machinery) records which leaf produced each tensor and
+how many times it is consumed; a BatchNorm folds only when its input is
+a Conv/Linear output consumed by nothing else. The folded BatchNorm is
+replaced by an identity layer so container indices keep working.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["fold_batch_norms"]
+
+
+def _bn_affine(bn) -> Tuple[np.ndarray, np.ndarray]:
+    """Per-channel (scale, shift): y = x*scale + shift in eval mode."""
+    mean = np.asarray(bn._mean.data, np.float64)
+    var = np.asarray(bn._variance.data, np.float64)
+    gamma = (np.asarray(bn.weight.data, np.float64)
+             if bn.weight is not None else np.ones_like(mean))
+    beta = (np.asarray(bn.bias.data, np.float64)
+            if bn.bias is not None else np.zeros_like(mean))
+    inv = gamma / np.sqrt(var + bn.epsilon)
+    return inv, beta - mean * inv
+
+
+def _fold_into(prev, bn) -> bool:
+    """Fold ``bn`` into ``prev`` (Conv*/Linear); True on success."""
+    from .. import nn
+    scale, shift = _bn_affine(bn)
+    w = np.asarray(prev.weight.data, np.float64)
+    if isinstance(prev, nn.Linear):
+        if w.shape[1] != scale.shape[0]:
+            return False
+        w_new = w * scale[None, :]          # [in, out] x per-out scale
+    elif isinstance(prev, (nn.Conv1D, nn.Conv2D, nn.Conv3D)):
+        if w.shape[0] != scale.shape[0]:
+            return False
+        w_new = w * scale.reshape((-1,) + (1,) * (w.ndim - 1))
+    else:
+        return False
+    b_old = (np.asarray(prev.bias.data, np.float64)
+             if prev.bias is not None else 0.0)
+    b_new = b_old * scale + shift
+    dtype = np.asarray(prev.weight.data).dtype
+    prev.weight.data = jnp.asarray(w_new.astype(dtype))
+    if prev.bias is not None:
+        prev.bias.data = jnp.asarray(b_new.astype(dtype))
+    else:
+        bias = prev.create_parameter((scale.shape[0],), is_bias=True)
+        bias.data = jnp.asarray(b_new.astype(dtype))
+        prev.bias = bias
+    return True
+
+
+def fold_batch_norms(model, input_spec) -> int:
+    """Fold eval-mode BatchNorms into their dataflow-preceding
+    Conv/Linear layers; returns the number folded.
+
+    input_spec: one InputSpec (or plain shape list) for the tracing
+    forward — dims that are None/-1 trace as 1.
+    """
+    from .. import nn
+
+    if model.training:
+        raise ValueError(
+            "fold_batch_norms needs eval mode (model.eval()): folding "
+            "bakes the RUNNING statistics into the weights")
+    spec = input_spec
+    if isinstance(spec, (list, tuple)) and len(spec) and (
+            hasattr(spec[0], "shape") or isinstance(spec[0], (list, tuple))):
+        spec = spec[0]  # [InputSpec(...)] or [(1, 3, H, W)] wrapper
+    shape = [1 if (d is None or (isinstance(d, int) and d < 0)) else int(d)
+             for d in (spec.shape if hasattr(spec, "shape") else spec)]
+
+    from ..core.graph_trace import trace_layer_graph
+    from ..core.tensor import Tensor
+    tr = trace_layer_graph(model, Tensor(jnp.zeros(tuple(shape),
+                                                   jnp.float32)))
+    layer_events = []
+    for ev in tr.events:
+        if ev[0] != "layer":
+            continue
+        _, l, inputs, output = ev
+        src = inputs[0] if isinstance(inputs, tuple) else inputs
+        layer_events.append((l, id(src), id(output)))
+    consumers = tr.consumers
+    produced_by = {out_id: l for l, _, out_id in layer_events}
+
+    # parent map so the folded bn can be replaced in its container
+    parent_of = {}
+    for _, container in model.named_sublayers(include_self=True):
+        for name, sub in getattr(container, "_sub_layers", {}).items():
+            parent_of[id(sub)] = (container, name)
+
+    foldable = (nn.Linear, nn.Conv1D, nn.Conv2D, nn.Conv3D)
+    bns = (nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D, nn.BatchNorm3D)
+    folded = 0
+    done = set()
+    for l, in_id, _ in layer_events:
+        if not isinstance(l, bns) or id(l) in done:
+            continue
+        prev = produced_by.get(in_id)
+        if prev is None or not isinstance(prev, foldable):
+            continue
+        # each layer must run exactly ONCE in the trace: a reused conv
+        # feeds other call sites (folding would corrupt them), a reused
+        # bn would be folded into the conv twice (scale squared)
+        if tr.layer_calls.get(id(prev)) != 1 or \
+                tr.layer_calls.get(id(l)) != 1:
+            continue
+        if consumers.get(in_id, 0) != 1:
+            continue  # the conv output feeds something else too
+            # (model outputs count as consumers: trace_layer_graph)
+        if id(l) not in parent_of:
+            continue
+        if _fold_into(prev, l):
+            container, name = parent_of[id(l)]
+            container._sub_layers[name] = nn.Identity()
+            done.add(id(l))
+            folded += 1
+    return folded

@@ -217,7 +217,7 @@ class _Emitter:
                 "LeakyRelu", [x_name], [out],
                 [_attr_float("alpha", float(alpha))]))
             return out
-        if t in ("Dropout", "Dropout2D", "Dropout3D"):
+        if t in ("Dropout", "Dropout2D", "Dropout3D", "Identity"):
             self.nodes.append(_node("Identity", [x_name], [out]))
             return out
         if isinstance(layer, (nn.MaxPool2D, nn.AvgPool2D)):
@@ -321,61 +321,18 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
     decl_shape = [d if (d or 0) > 0 else None for d in spec.shape]
     shape = [d if d is not None else 1 for d in decl_shape]
 
-    # Trace to an EVENT list: one event per supported leaf layer (the
+    # Trace to an EVENT list (core/graph_trace.py — shared with the
+    # inference passes): one event per supported leaf layer (the
     # structured emitters above), plus one event per FUNCTIONAL registry
     # op executed outside any leaf layer (the residual add, flatten(1),
-    # F.relu glue in forward() bodies) — captured via the registry's
-    # _ONNX_TRACE hook. Primitive ops fired INSIDE a leaf layer are
-    # subsumed by that layer's event (depth counter).
-    events = []
-    hooks = []
-    depth = [0]
-    traced_ids = set()  # every tensor PRODUCED during the trace
-
-    def _note(out):
-        from ..core.tensor import Tensor
-        for t in (out if isinstance(out, (tuple, list)) else (out,)):
-            if isinstance(t, Tensor):
-                traced_ids.add(id(t))
-
-    def pre(l, inputs):
-        depth[0] += 1
-
-    def rec(l, inputs, output):
-        depth[0] -= 1
-        _note(output)
-        if depth[0] == 0:
-            events.append(("layer", l, inputs, output))
-
-    leaves = [sub for _, sub in layer.named_sublayers(include_self=True)
-              if not list(sub.sublayers())]
-    for sub in leaves:
-        hooks.append(sub.register_forward_pre_hook(pre))
-        hooks.append(sub.register_forward_post_hook(rec))
-
-    def op_rec(name, args, kwargs, out):
-        _note(out)
-        if depth[0] == 0:
-            events.append(("op", name, args, kwargs, out))
-
+    # F.relu glue in forward() bodies). Primitive ops fired INSIDE a
+    # leaf layer are subsumed by that layer's event.
     import jax.numpy as jnp
     from ..core.tensor import Tensor
-    from ..autograd import tape as _tape
-    from ..ops import registry as _registry
-    was_training = layer.training
-    layer.eval()
+    from ..core.graph_trace import trace_layer_graph
     x = Tensor(jnp.zeros(tuple(shape), jnp.float32))
-    prev_hook = _registry._ONNX_TRACE
-    _registry._ONNX_TRACE = op_rec
-    try:
-        with _tape.no_grad():
-            y = layer(x)
-    finally:
-        _registry._ONNX_TRACE = prev_hook
-        if was_training:
-            layer.train()
-        for h in hooks:
-            h.remove()
+    tr = trace_layer_graph(layer, x)
+    events, traced_ids, y = tr.events, tr.traced_ids, tr.y
 
     em = _Emitter()
     out_name = "input"

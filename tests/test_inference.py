@@ -4,6 +4,7 @@ Mirrors the reference's inference API tests (test/cpp/inference/api,
 python predictor tests) minus TRT.
 """
 import numpy as np
+import jax.numpy as jnp
 import pytest
 
 import paddle_tpu as pt
@@ -114,3 +115,129 @@ def test_dynamic_batcher_tuple_outputs_and_errors():
         f = b.submit(np.full((2, 2), np.nan, np.float32))
         with pytest.raises(ValueError, match="nan batch"):
             f.result()
+
+
+# ---------------------------------------------------------------------------
+# optimization passes (inference/passes.py)
+# ---------------------------------------------------------------------------
+
+def test_fold_batch_norms_resnet_matches_and_shrinks():
+    import paddle_tpu as pt
+    from paddle_tpu.inference import fold_batch_norms
+    from paddle_tpu.vision.models import resnet18
+
+    m = resnet18(num_classes=7)
+    m.eval()
+    # give BN stats non-trivial values so the fold actually does math
+    rng = np.random.RandomState(0)
+    for _, sub in m.named_sublayers(include_self=True):
+        if type(sub).__name__.startswith("BatchNorm"):
+            sub._mean.data = jnp.asarray(rng.randn(sub.num_features)
+                                         .astype(np.float32) * 0.1)
+            sub._variance.data = jnp.asarray(
+                1.0 + rng.rand(sub.num_features).astype(np.float32))
+    x = pt.to_tensor(rng.randn(2, 3, 32, 32).astype(np.float32))
+    before = m(x).numpy()
+    n = fold_batch_norms(m, [(1, 3, 32, 32)])
+    assert n == 20, n  # every BN in resnet18 folds (incl. downsample)
+    after = m(x).numpy()
+    np.testing.assert_allclose(after, before, rtol=2e-4, atol=2e-5)
+    # the folded model has no BatchNorm layers left
+    assert not any(type(s).__name__.startswith("BatchNorm")
+                   for _, s in m.named_sublayers())
+    # exported ONNX no longer contains BatchNormalization nodes
+    from paddle_tpu.jit import InputSpec
+    from test_onnx_export import _op_types
+    import tempfile, os
+    out = pt.onnx.export(m, os.path.join(tempfile.mkdtemp(), "folded"),
+                         input_spec=[InputSpec([1, 3, 32, 32])])
+    ops = _op_types(open(out, "rb").read())
+    assert "BatchNormalization" not in ops
+    assert ops.count("Conv") == 20
+
+
+def test_fold_batch_norms_respects_dataflow_fanout():
+    import paddle_tpu as pt
+    from paddle_tpu.inference import fold_batch_norms
+
+    class FanOut(pt.nn.Layer):
+        """conv output feeds BOTH the bn and a residual add — folding
+        the bn would corrupt the second consumer."""
+        def __init__(self):
+            super().__init__()
+            self.conv = pt.nn.Conv2D(3, 3, 1)
+            self.bn = pt.nn.BatchNorm2D(3)
+
+        def forward(self, x):
+            h = self.conv(x)
+            return self.bn(h) + h
+
+    m = FanOut()
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(1)
+                     .randn(1, 3, 4, 4).astype(np.float32))
+    before = m(x).numpy()
+    n = fold_batch_norms(m, [(1, 3, 4, 4)])
+    assert n == 0  # correctly refused
+    np.testing.assert_allclose(m(x).numpy(), before)
+
+
+def test_fold_batch_norms_requires_eval():
+    import paddle_tpu as pt
+    from paddle_tpu.inference import fold_batch_norms
+    m = pt.nn.Sequential(pt.nn.Conv2D(3, 4, 1), pt.nn.BatchNorm2D(4))
+    with pytest.raises(ValueError, match="eval"):
+        fold_batch_norms(m, [(1, 3, 4, 4)])
+
+
+def test_fold_batch_norms_refuses_returned_intermediate():
+    import paddle_tpu as pt
+    from paddle_tpu.inference import fold_batch_norms
+
+    class MultiOut(pt.nn.Layer):
+        """conv output is RETURNED as well as normalised — folding
+        would corrupt the returned features."""
+        def __init__(self):
+            super().__init__()
+            self.conv = pt.nn.Conv2D(3, 3, 1)
+            self.bn = pt.nn.BatchNorm2D(3)
+
+        def forward(self, x):
+            h = self.conv(x)
+            return self.bn(h), h
+
+    m = MultiOut()
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(2)
+                     .randn(1, 3, 4, 4).astype(np.float32))
+    b0, b1 = (o.numpy() for o in m(x))
+    assert fold_batch_norms(m, [(1, 3, 4, 4)]) == 0
+    a0, a1 = (o.numpy() for o in m(x))
+    np.testing.assert_allclose(a0, b0)
+    np.testing.assert_allclose(a1, b1)
+
+
+def test_fold_batch_norms_refuses_reused_layers():
+    import paddle_tpu as pt
+    from paddle_tpu.inference import fold_batch_norms
+
+    class Reuse(pt.nn.Layer):
+        """the same conv+bn pair runs twice — folding once per EVENT
+        would square the scale; folding at all corrupts the second
+        call site when only one is bn-followed."""
+        def __init__(self):
+            super().__init__()
+            self.conv = pt.nn.Conv2D(3, 3, 1)
+            self.bn = pt.nn.BatchNorm2D(3)
+
+        def forward(self, x):
+            y = self.bn(self.conv(x))
+            return self.bn(self.conv(y))
+
+    m = Reuse()
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(3)
+                     .randn(1, 3, 4, 4).astype(np.float32))
+    before = m(x).numpy()
+    assert fold_batch_norms(m, [(1, 3, 4, 4)]) == 0
+    np.testing.assert_allclose(m(x).numpy(), before)
