@@ -77,6 +77,26 @@ def trace_layer_graph(model, x: Tensor) -> TraceResult:
         hooks.append(s.register_forward_pre_hook(pre))
         hooks.append(s.register_forward_post_hook(post))
 
+    # pre-hooks receive only POSITIONAL inputs (Layer.__call__, paddle
+    # hook parity) — wrap each leaf's forward so tensors passed as
+    # kwargs count as consumers too (depth == 1 inside a top-level
+    # call: the pre-hook already incremented)
+    wrapped_leaves = []
+
+    def _wrap_forward(orig):
+        def wrapped(*a, **kw):
+            if depth[0] == 1 and kw:
+                for v in kw.values():
+                    jax.tree_util.tree_map(
+                        res.consumed, v,
+                        is_leaf=lambda t: isinstance(t, Tensor))
+            return orig(*a, **kw)
+        return wrapped
+
+    for s in leaves:
+        wrapped_leaves.append((s, s.__dict__.get("forward")))
+        object.__setattr__(s, "forward", _wrap_forward(s.forward))
+
     def op_rec(name, args, kwargs, out):
         res.produced(out)
         if depth[0] == 0:
@@ -99,8 +119,14 @@ def trace_layer_graph(model, x: Tensor) -> TraceResult:
             model.train()
         for h in hooks:
             h.remove()
+        for s, saved in wrapped_leaves:
+            if saved is None:
+                s.__dict__.pop("forward", None)
+            else:
+                object.__setattr__(s, "forward", saved)
     # the model's outputs are consumers too: a tensor that is RETURNED
     # must not be treated as exclusively feeding its one layer consumer
-    for t in (res.y if isinstance(res.y, (tuple, list)) else (res.y,)):
-        res.consumed(t)
+    # (walk the FULL structure — dicts/nested containers included)
+    jax.tree_util.tree_map(res.consumed, res.y,
+                           is_leaf=lambda t: isinstance(t, Tensor))
     return res

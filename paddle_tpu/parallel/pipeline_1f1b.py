@@ -225,3 +225,24 @@ def split_chunks_round_robin(layer_params, num_layers: int,
     return jax.tree_util.tree_map(
         lambda p: p.reshape((VS, num_layers // VS) + p.shape[1:]),
         layer_params)
+
+
+def schedule_efficiency(num_stages: int, num_microbatches: int,
+                        virtual_chunks: int = 1) -> float:
+    """Useful-work fraction of the traced 1F1B schedule.
+
+    The schedule runs ``M + 2S - 1`` lockstep ticks and every tick
+    executes all S slots (masked work included — an SPMD traced program
+    cannot skip a slot), so efficiency = M / (M + 2S - 1). VPP does not
+    enter: every device computes its V chunks every tick (module
+    docstring), so V multiplies useful and wasted work alike. This is
+    the quantity to DRIVE SCHEDULING DECISIONS with: raise M until the
+    bubble amortizes (the reference's lever too — its per-rank 1F1B has
+    the same (2S-1)-tick fill/drain, pipeline_parallel.py:565).
+    tests/test_pipeline_1f1b.py checks the compiled step's XLA flop
+    count against this prediction.
+    """
+    S, M = int(num_stages), int(num_microbatches)
+    if S < 1 or M < 1:
+        raise ValueError("num_stages and num_microbatches must be >= 1")
+    return M / (M + 2 * S - 1)

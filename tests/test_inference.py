@@ -241,3 +241,47 @@ def test_fold_batch_norms_refuses_reused_layers():
     before = m(x).numpy()
     assert fold_batch_norms(m, [(1, 3, 4, 4)]) == 0
     np.testing.assert_allclose(m(x).numpy(), before)
+
+
+def test_fold_batch_norms_refuses_dict_and_kwarg_consumers():
+    import paddle_tpu as pt
+    from paddle_tpu.inference import fold_batch_norms
+
+    class DictOut(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.conv = pt.nn.Conv2D(3, 3, 1)
+            self.bn = pt.nn.BatchNorm2D(3)
+
+        def forward(self, x):
+            h = self.conv(x)
+            return {"bn": self.bn(h), "raw": h}
+
+    m = DictOut()
+    m.eval()
+    x = pt.to_tensor(np.random.RandomState(4)
+                     .randn(1, 3, 4, 4).astype(np.float32))
+    raw_before = m(x)["raw"].numpy()
+    assert fold_batch_norms(m, [(1, 3, 4, 4)]) == 0
+    np.testing.assert_allclose(m(x)["raw"].numpy(), raw_before)
+
+    class KwargSkip(pt.nn.Layer):
+        class Head(pt.nn.Layer):
+            def forward(self, x, skip=None):
+                return x + skip
+
+        def __init__(self):
+            super().__init__()
+            self.conv = pt.nn.Conv2D(3, 3, 1)
+            self.bn = pt.nn.BatchNorm2D(3)
+            self.head = self.Head()
+
+        def forward(self, x):
+            h = self.conv(x)
+            return self.head(self.bn(h), skip=h)
+
+    k = KwargSkip()
+    k.eval()
+    before = k(x).numpy()
+    assert fold_batch_norms(k, [(1, 3, 4, 4)]) == 0
+    np.testing.assert_allclose(k(x).numpy(), before)
