@@ -218,6 +218,8 @@ def case(pred_fn_pairs, default=None, name=None):
         p, f = pair
         if not callable(f):
             raise TypeError("fn in pred_fn_pairs must be callable")
+        if isinstance(p, (bool, int)) and not isinstance(p, Tensor):
+            p = Tensor(jnp.asarray(bool(p)))  # python-bool pred
         preds.append(p)
         fns.append(f)
     if default is None:

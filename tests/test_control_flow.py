@@ -170,3 +170,10 @@ def test_switch_case_under_to_static():
         out = f(x, pt.to_tensor(np.asarray(bi, np.int32)))
         np.testing.assert_allclose(out.numpy(), [want])
     assert not f._fell_back
+
+
+def test_case_accepts_python_bool_preds():
+    x = t([2.0])
+    out = snn.case([(False, lambda: x * 10), (True, lambda: x + 1)],
+                   default=lambda: x)
+    np.testing.assert_allclose(out.numpy(), [3.0])
