@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from typing import Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -115,11 +116,82 @@ def fused_moe(x, gate_weight, ffn1_weight, ffn2_weight, *, top_k: int = 2,
     return y
 
 
-def masked_multihead_attention(x, cache_kv=None, *args, **kw):
-    raise NotImplementedError(
-        "decode-time masked_multihead_attention: use "
-        "paddle_tpu.ops.pallas.flash_attention with a KV cache "
-        "(models/llama.py decode path)")
+def masked_multihead_attention(x, cache_kv=None, bias=None, src_mask=None,
+                               cum_offsets=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               seq_len: int = 1, **kw):
+    """One-token decode attention over a KV cache (reference
+    masked_multihead_attention.py over
+    masked_multihead_attention_kernel.cu).
+
+    x: ``[B, 3*H*Dh]`` fused qkv for the CURRENT token. cache_kv:
+    ``[2, B, H, S_max, Dh]``. sequence_lengths: ``[B]`` or ``[B, 1]``
+    int — the position the new token occupies (and the number of valid
+    cached keys before it); defaults to ``seq_len - 1`` for every row.
+    bias: ``[3, H, Dh]`` qkv bias. src_mask: additive mask broadcast to
+    ``[B, 1, 1, S_max]``. Returns ``(out [B, H*Dh], cache_kv_out)`` —
+    cache semantics are FUNCTIONAL (a new array), not in-place like the
+    CUDA op; quant/beam arguments are not supported.
+    """
+    from ....core.tensor import Tensor
+
+    def arr(v):
+        return v.data if isinstance(v, Tensor) else jnp.asarray(v)
+
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention needs cache_kv "
+                         "[2, B, H, S_max, Dh]")
+    if beam_cache_offset is not None:
+        raise NotImplementedError(
+            "beam search cache offsets are not supported; use the "
+            "models/llama.py generate path for batched decoding")
+    if rotary_tensor is not None or cum_offsets is not None:
+        raise NotImplementedError(
+            "rotary_tensor/cum_offsets are not supported: apply rope to "
+            "the qkv BEFORE this op (fused_rotary_position_embedding) — "
+            "silently skipping the rotation would corrupt decode numerics")
+    quant = {k: v for k, v in kw.items()
+             if k in ("qkv_out_scale", "out_shift", "out_smooth")
+             and v is not None}
+    if quant or kw.get("out_scale", -1) not in (-1, None):
+        raise NotImplementedError(
+            f"quantized decode ({sorted(quant) or 'out_scale'}) is not "
+            "supported; see paddle_tpu.quantization for PTQ/QAT")
+    xv = arr(x)
+    ck = arr(cache_kv)
+    _, B, H, S, Dh = ck.shape
+    qkv = xv.reshape(B, 3, H, Dh)
+    if bias is not None:
+        qkv = qkv + arr(bias).reshape(1, 3, H, Dh).astype(qkv.dtype)
+    q, k, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]       # [B, H, Dh]
+    if sequence_lengths is None:
+        pos = jnp.full((B,), seq_len - 1, jnp.int32)
+    else:
+        pos = arr(sequence_lengths).reshape(B).astype(jnp.int32)
+
+    # scatter the new k/v into each row's position
+    onehot = jax.nn.one_hot(pos, S, dtype=ck.dtype)  # [B, S]
+    upd = onehot[None, :, None, :, None]             # [1, B, 1, S, 1]
+    new_kv = jnp.stack([k, v])[:, :, :, None, :]     # [2, B, H, 1, Dh]
+    ck_out = ck * (1 - upd) + new_kv * upd
+
+    key_pos = jnp.arange(S)[None, :]                 # [1, S]
+    valid = key_pos <= pos[:, None]                  # [B, S]
+    scores = jnp.einsum("bhd,bhsd->bhs", q, ck_out[0]).astype(jnp.float32)
+    scores = scores / np.sqrt(Dh)
+    if src_mask is not None:
+        # [B|1, 1, 1, S'] additive mask, batch broadcastable
+        m = arr(src_mask).astype(jnp.float32)
+        m = m.reshape(m.shape[0], -1)[:, :S]          # [B|1, S]
+        scores = scores + jnp.broadcast_to(m[:, None, :],
+                                           scores.shape)
+    scores = jnp.where(valid[:, None, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(ck.dtype)
+    out = jnp.einsum("bhs,bhsd->bhd", probs, ck_out[1])
+    out = out.reshape(B, H * Dh).astype(xv.dtype)
+    if isinstance(x, Tensor):
+        return Tensor(out), Tensor(ck_out)
+    return out, ck_out
 
 
 def fused_multi_head_attention(q, k, v, *, causal=True, **kw):

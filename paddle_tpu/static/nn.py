@@ -218,7 +218,7 @@ def case(pred_fn_pairs, default=None, name=None):
         p, f = pair
         if not callable(f):
             raise TypeError("fn in pred_fn_pairs must be callable")
-        if isinstance(p, (bool, int)) and not isinstance(p, Tensor):
+        if isinstance(p, (bool, int)):
             p = Tensor(jnp.asarray(bool(p)))  # python-bool pred
         preds.append(p)
         fns.append(f)

@@ -182,3 +182,72 @@ def test_ptq_convert_int8_numerics():
     fresh(x)
     fresh.set_state_dict(sd)
     np.testing.assert_allclose(np.asarray(fresh(x).data), got, atol=1e-6)
+
+
+def test_masked_multihead_attention_matches_reference_loop():
+    """Decode-step fused attention (incubate.nn.functional
+    masked_multihead_attention): per-row cache scatter + causal-masked
+    softmax over the valid prefix, vs a numpy transcript."""
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+
+    rng = np.random.RandomState(0)
+    B, H, S, D = 3, 2, 8, 4
+    x = rng.randn(B, 3 * H * D).astype(np.float32)
+    cache = rng.randn(2, B, H, S, D).astype(np.float32)
+    lens = np.asarray([2, 5, 0], np.int32)   # new token's position per row
+    bias = rng.randn(3, H, D).astype(np.float32)
+
+    out, ck = masked_multihead_attention(
+        x, cache_kv=cache.copy(), bias=bias, sequence_lengths=lens)
+    out, ck = np.asarray(out), np.asarray(ck)
+
+    qkv = x.reshape(B, 3, H, D) + bias[None]
+    for b in range(B):
+        p = int(lens[b])
+        ref_k = cache[0, b].copy()
+        ref_v = cache[1, b].copy()
+        ref_k[:, p] = qkv[b, 1]
+        ref_v[:, p] = qkv[b, 2]
+        np.testing.assert_allclose(ck[0, b], ref_k, rtol=1e-5)
+        np.testing.assert_allclose(ck[1, b], ref_v, rtol=1e-5)
+        for h in range(H):
+            s = ref_k[h, :p + 1] @ qkv[b, 0, h] / np.sqrt(D)
+            w = np.exp(s - s.max()); w /= w.sum()
+            ref_o = w @ ref_v[h, :p + 1]
+            np.testing.assert_allclose(out[b, h * D:(h + 1) * D], ref_o,
+                                       rtol=1e-4, atol=1e-5)
+
+
+def test_masked_multihead_attention_validation():
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+    with pytest.raises(ValueError, match="cache_kv"):
+        masked_multihead_attention(np.zeros((1, 24), np.float32))
+    with pytest.raises(NotImplementedError, match="beam"):
+        masked_multihead_attention(
+            np.zeros((1, 24), np.float32),
+            cache_kv=np.zeros((2, 1, 2, 4, 4), np.float32),
+            beam_cache_offset=np.zeros((1, 1, 8)))
+
+
+def test_masked_multihead_attention_mask_broadcast_and_guards():
+    from paddle_tpu.incubate.nn.functional import masked_multihead_attention
+    rng = np.random.RandomState(1)
+    B, H, S, D = 3, 2, 4, 4
+    x = rng.randn(B, 3 * H * D).astype(np.float32)
+    cache = rng.randn(2, B, H, S, D).astype(np.float32)
+    # shared-across-batch additive mask [1, 1, 1, S] must broadcast
+    mask = np.zeros((1, 1, 1, S), np.float32)
+    out0, _ = masked_multihead_attention(x, cache_kv=cache.copy(),
+                                         sequence_lengths=np.full(B, 2))
+    out1, _ = masked_multihead_attention(x, cache_kv=cache.copy(),
+                                         sequence_lengths=np.full(B, 2),
+                                         src_mask=mask)
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=1e-6)
+    # unsupported features raise instead of silently corrupting decode
+    with pytest.raises(NotImplementedError, match="rope|rotary"):
+        masked_multihead_attention(x, cache_kv=cache.copy(),
+                                   rotary_tensor=np.zeros((B, 1, 1, S, D)))
+    with pytest.raises(NotImplementedError, match="quant"):
+        masked_multihead_attention(x, cache_kv=cache.copy(),
+                                   qkv_out_scale=np.ones((3, H, D)))
