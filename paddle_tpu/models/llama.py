@@ -65,8 +65,11 @@ class LlamaConfig:
     # fused rmsnorm/rope pallas kernels between the GEMMs
     # (ops/pallas/fused_norm_rope; counterpart of the reference's
     # fused_rms_norm/fused_rope fusion kernels). "auto": on when running
-    # on TPU with an unsharded (tp=cp=1) layer body — the pallas calls
-    # are not GSPMD-partitionable, so a sharded stream would all-gather.
+    # on TPU. Under a tp/cp-sharded residual stream the kernels run per
+    # shard via the *_sharded shard_map entries (norm/rope are token- and
+    # head-local, like the reference's per-rank fused kernels under TP);
+    # in the pp>1 stage loop — where stages run under vmap, which does
+    # not compose with shard_map — the jnp formulation runs instead.
     # True/"pallas": always (interpret mode off-TPU). False: never.
     use_fused_norm_rope: Any = "auto"
     # context parallelism: "none" | "ring" | "ulysses" | "zigzag" —
@@ -210,36 +213,104 @@ def _fused_nr_on(cfg: LlamaConfig, mesh) -> bool:
     if v in (True, "pallas"):
         return True
     try:
-        on_tpu = jax.default_backend() == "tpu"
+        return jax.default_backend() == "tpu"
     except Exception:
-        on_tpu = False
-    unsharded = mesh is None or (mesh.shape.get("tp", 1) == 1
-                                 and mesh.shape.get("cp", 1) == 1)
-    return on_tpu and unsharded
+        return False
+
+
+def _tp_heads_shardable(cfg: LlamaConfig, mesh) -> bool:
+    """Whether q/k/v head dims can shard over tp: the GQA group structure
+    survives a head split iff BOTH head counts divide the tp degree."""
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    return (tp > 1 and cfg.num_attention_heads % tp == 0
+            and cfg.num_key_value_heads % tp == 0)
+
+
+def _norm_fn(cfg: LlamaConfig, mesh, fused: bool, h_spec=None):
+    """The rms_norm callable: fused pallas kernel (per-shard via shard_map
+    when ``h_spec`` gives the stream's PartitionSpec) or the jnp path."""
+    if fused and h_spec is not None:
+        from ..ops.pallas.fused_norm_rope import fused_rms_norm_sharded
+        return lambda x, w: fused_rms_norm_sharded(x, w, mesh, h_spec,
+                                                   cfg.rms_norm_eps)
+    if fused:
+        from ..ops.pallas.fused_norm_rope import fused_rms_norm
+        return lambda x, w: fused_rms_norm(x, w, cfg.rms_norm_eps)
+    return lambda x, w: rms_norm(x, w, cfg.rms_norm_eps)
+
+
+def _fused_shard_specs(cfg: LlamaConfig, mesh, sp_spec):
+    """PartitionSpecs for running the fused norm/rope kernels per shard
+    when the residual stream is sequence-sharded (megatron SP over tp, or
+    context parallel over cp).
+
+    Returns ``(h_spec, rope_specs)`` where ``rope_specs`` is
+    ``(q_spec, k_spec, pos_spec)`` or None (rope then runs the jnp path —
+    e.g. GQA head counts not divisible by the tp degree). Returns None
+    outright when there is no mesh context to shard_map over.
+    """
+    if mesh is None or sp_spec is None:
+        return None
+    h_spec = sp_spec.spec if hasattr(sp_spec, "spec") else sp_spec
+    dp_ax, seq_ax = h_spec[0], h_spec[1]
+    tp = mesh.shape.get("tp", 1)
+    # q/k leave the column-parallel QKV matmul head-sharded over tp
+    head_ax = "tp" if _tp_heads_shardable(cfg, mesh) else None
+    if seq_ax == "tp":
+        # megatron SP: the matmul all-gathers the seq dim; heads carry tp
+        if head_ax is None:
+            rope_specs = None
+        else:
+            qk = P(dp_ax, None, "tp", None)
+            rope_specs = (qk, qk, P(dp_ax, None))
+    elif seq_ax is not None:
+        # context parallel: seq stays sharded through rope (positions are
+        # per-token, so any layout — zigzag included — shards with it)
+        if tp > 1 and head_ax is None:
+            rope_specs = None  # heads carry tp but do not divide it
+        else:
+            qk = P(dp_ax, seq_ax, head_ax, None)
+            rope_specs = (qk, qk, P(dp_ax, seq_ax))
+    else:
+        rope_specs = None
+    return h_spec, rope_specs
 
 
 def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None,
-           fused_nr=False):
+           fused_nr=False, mesh=None):
     """The transformer block math shared by the training path
     (decoder_layer) and the KV-cache decode path (forward_with_cache):
     rms_norm -> QKV -> rope -> ``attn_fn(q, k, v)`` -> o-proj+residual ->
     rms_norm -> SwiGLU+residual. One source of truth — attention strategy
-    is the only thing the two paths vary."""
+    is the only thing the two paths vary.
+
+    With ``fused_nr`` and a sequence-sharded residual stream (sp_spec),
+    the fused pallas kernels run per shard via the *_sharded shard_map
+    entries (fused_norm_rope.py) — norm and rope are token/head-local, so
+    the sharded stream no longer forces the slow jnp path."""
     B, T, D = h.shape
     H, Hkv, Dh = cfg.num_attention_heads, cfg.num_key_value_heads, cfg.head_dim
-    if fused_nr:
-        from ..ops.pallas.fused_norm_rope import fused_rms_norm, fused_rope
-        norm = lambda x, w: fused_rms_norm(x, w, cfg.rms_norm_eps)
+    sharded = None
+    if fused_nr and sp_spec is not None:
+        sharded = _fused_shard_specs(cfg, mesh, sp_spec)
+        if sharded is None:
+            fused_nr = False  # sharded stream, no mesh context: jnp
+    norm = _norm_fn(cfg, mesh, fused_nr, sharded[0] if sharded else None)
+    if fused_nr and sharded is not None and sharded[1] is not None:
+        from ..ops.pallas.fused_norm_rope import fused_rope_sharded
+        q_spec, k_spec, pos_spec = sharded[1]
+        rope_fn = lambda q, k: fused_rope_sharded(
+            q, k, positions, mesh, q_spec, k_spec, pos_spec, cfg.rope_theta)
+    elif fused_nr and sharded is None:
+        from ..ops.pallas.fused_norm_rope import fused_rope
+        rope_fn = lambda q, k: fused_rope(q, k, positions, cfg.rope_theta)
     else:
-        norm = lambda x, w: rms_norm(x, w, cfg.rms_norm_eps)
+        rope_fn = lambda q, k: rope(q, k, positions, cfg.rope_theta, Dh)
     x = norm(h, lp["attn_norm"])
     q = (x @ lp["wq"]).reshape(B, T, H, Dh)
     k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
     v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
-    if fused_nr:
-        q, k = fused_rope(q, k, positions, cfg.rope_theta)
-    else:
-        q, k = rope(q, k, positions, cfg.rope_theta, Dh)
+    q, k = rope_fn(q, k)
     o = attn_fn(q, k, v)
     # tag for remat policies: lets a save_only_these_names policy keep the
     # kernel output so backward recompute skips the flash forward (the
@@ -251,7 +322,7 @@ def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None,
         # output over tp along the seq dim (sequence_parallel_utils.py:427)
         h = lax.with_sharding_constraint(h, sp_spec)
 
-    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    x = norm(h, lp["mlp_norm"])
     h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
     if sp_spec is not None:
         h = lax.with_sharding_constraint(h, sp_spec)
@@ -260,7 +331,10 @@ def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None,
 
 def _train_attn_fn(cfg: LlamaConfig, mesh):
     """Attention callable for the training path: context-parallel when a
-    cp axis is live, otherwise the flash kernel per cfg."""
+    cp axis is live, otherwise the flash kernel per cfg — run per tp
+    shard over the head dim when tp shards the stream (attention is
+    head-local; GQA grouping survives because Hkv % tp == 0), so the
+    opaque pallas call never makes GSPMD all-gather the activations."""
     cp_on = (cfg.context_parallel != "none" and mesh is not None
              and mesh.shape.get("cp", 1) > 1)
     if cp_on:
@@ -270,6 +344,14 @@ def _train_attn_fn(cfg: LlamaConfig, mesh):
     from ..ops.pallas.flash_attention import flash_attention as _fa
     fa = cfg.use_flash_attention
     impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
+    if _tp_heads_shardable(cfg, mesh):
+        from jax import shard_map
+        dp_ax = "dp" if "dp" in mesh.shape else None
+        spec = P(dp_ax, None, "tp", None)
+        body = lambda ql, kl, vl: _fa(ql, kl, vl, causal=True, impl=impl)
+        return lambda q, k, v: shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)(q, k, v)
     return lambda q, k, v: _fa(q, k, v, causal=True, impl=impl)
 
 
@@ -281,11 +363,9 @@ def decoder_layer(lp, h, cfg: LlamaConfig, sp_spec=None, mesh=None,
     B, T, _ = h.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T), (B, T))
-    # sp_spec set means the residual stream is sequence-sharded — the
-    # pallas kernels would force an all-gather there, so stay unfused
-    fused_nr = _fused_nr_on(cfg, mesh) and sp_spec is None
     return _block(lp, h, positions, cfg, _train_attn_fn(cfg, mesh),
-                  sp_spec=sp_spec, fused_nr=fused_nr)
+                  sp_spec=sp_spec, fused_nr=_fused_nr_on(cfg, mesh),
+                  mesh=mesh)
 
 
 def _scan_layers(layer_params, h, cfg: LlamaConfig, sp_spec=None, remat=False,
@@ -335,11 +415,9 @@ def forward(params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
         h = lax.with_sharding_constraint(h, sp_spec)
     h = _scan_layers(params["layers"], h, cfg, sp_spec, remat=cfg.remat,
                      mesh=mesh, positions=positions)
-    if _fused_nr_on(cfg, mesh) and sp_spec is None:
-        from ..ops.pallas.fused_norm_rope import fused_rms_norm
-        h = fused_rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    else:
-        h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    norm = _norm_fn(cfg, mesh, _fused_nr_on(cfg, mesh),
+                    sp_spec.spec if sp_spec is not None else None)
+    h = norm(h, params["final_norm"])
     return h @ params["lm_head"]
 
 
