@@ -18,14 +18,19 @@ executor runs it — collapses on TPU to:
      fit step — that materialises the optimizer's lazily-created
      accumulator slots, which then become traced inputs.
 
-Pipeline parallelism (``pp_degree > 1``): the model must be a sequence
-of structurally identical blocks (a ``Sequential`` of one repeated
-block type — the transformer shape). Blocks' stacked parameters get a
-leading ``[pp, layers/stage, ...]`` axis sharded over the mesh's pp
-axis and run through ``parallel.pipeline_spmd`` (microbatched GPipe:
-the stage shift lowers to collective_permute). Heterogeneous graph
-partitioning — the reference's program-slicing partitioner — is out of
-scope; the Engine raises with that explanation instead of guessing.
+Pipeline parallelism (``pp_degree > 1``): the model must execute as a
+sequence of top-level layers (``Sequential``s are flattened one level)
+containing a run of structurally identical blocks — the transformer
+shape: optional heterogeneous HEAD layers (embedding), N identical
+blocks, optional heterogeneous TAIL layers (final norm / lm head).
+The identical blocks' stacked parameters get a leading
+``[pp, layers/stage, ...]`` axis sharded over the mesh's pp axis and
+run through ``parallel.pipeline_spmd`` (microbatched GPipe: the stage
+shift lowers to collective_permute); the heterogeneous ends run at
+GSPMD level before/after the pipeline, the ``models/llama.py``
+``forward_pipelined`` layout (reference counterpart: the program-slicing
+partitioner static/partitioner.py puts them on the first/last stage).
+Fully heterogeneous graphs (no identical-block run) still raise.
 """
 from __future__ import annotations
 
@@ -119,11 +124,54 @@ class Engine:
                                             s.pp_degree)
         return Mesh(arr, ("dp", "mp", "pp"))
 
-    def _plan_param(self, name: str, p: Tensor) -> P:
-        """Rule-based planner (the completer/planner stand-in): shard the
-        biggest dim of large >=2D params over mp; replicate the rest."""
+    def _param_owners(self) -> dict:
+        """id(param) -> owning Layer, for usage-aware planning."""
+        owners = {}
+        for layer in self.model.sublayers(include_self=True):
+            for p in getattr(layer, "_parameters", {}).values():
+                if p is not None:
+                    owners[id(p)] = layer
+        return owners
+
+    def _mpu_hint(self, p: Tensor, owner) -> Optional[P]:
+        """Usage-aware placement from mpu layer types (r4 Weak #5: the
+        size heuristic never consults how a param is USED; the Column/
+        Row/Vocab parallel layer types are explicit usage declarations —
+        the role the reference's spmd_rules library plays for arbitrary
+        programs)."""
+        from ... import distributed as _dist
+        mpu = _dist.mpu
         s = self.strategy
         shape = p.data.shape
+
+        def ok(d):
+            return shape[d] % s.mp_degree == 0
+
+        if isinstance(owner, mpu.ColumnParallelLinear):
+            if p is owner.weight and len(shape) == 2 and ok(1):
+                return P(None, "mp")
+            if getattr(owner, "bias", None) is p and ok(0):
+                return P("mp")
+        elif isinstance(owner, mpu.RowParallelLinear):
+            if p is owner.weight and len(shape) == 2 and ok(0):
+                return P("mp", None)
+            if getattr(owner, "bias", None) is p:
+                return P()  # row-parallel bias stays replicated
+        elif isinstance(owner, mpu.VocabParallelEmbedding):
+            if p is owner.weight and len(shape) == 2 and ok(0):
+                return P("mp", None)
+        return None
+
+    def _plan_param(self, name: str, p: Tensor, owner=None) -> P:
+        """Rule-based planner (the completer/planner stand-in): mpu layer
+        types give usage hints; otherwise shard the biggest dim of large
+        >=2D params over mp; replicate the rest."""
+        s = self.strategy
+        shape = p.data.shape
+        if s.mp_degree > 1 and owner is not None:
+            hint = self._mpu_hint(p, owner)
+            if hint is not None:
+                return hint
         if (s.mp_degree <= 1 or len(shape) < 2
                 or p.data.size < s.min_shard_size):
             return P()
@@ -137,58 +185,79 @@ class Engine:
                 return P(*spec)
         return P()
 
-    def _partition_blocks(self) -> List:
-        """Split the model into pp-stage-able blocks; raise with the
-        design boundary when the model is not a homogeneous sequence."""
+    def _flat_units(self) -> List:
+        """Top-level execution units: the model's top-level sublayers,
+        with ``Sequential`` containers flattened one level (the common
+        ``self.blocks = Sequential(...)`` pattern) — the Engine pp
+        contract is that the model's forward IS these units in order."""
+        from ...nn.layer import Sequential
+        units = []
+        for sub in getattr(self.model, "_sub_layers", {}).values():
+            if isinstance(sub, Sequential):
+                units.extend(sub._sub_layers.values())
+            else:
+                units.append(sub)
+        return units
+
+    def _partition_blocks(self):
+        """Split the model into (pre_layers, identical_blocks,
+        post_layers) for pipeline staging: the longest run of
+        structurally identical same-type blocks is pipelined; the
+        heterogeneous ends (embedding / head — reference: first/last
+        stages of the program-slicing partitioner,
+        static/partitioner.py) run at GSPMD level around it."""
         S = self.strategy.pp_degree
-        subs = list(getattr(self.model, "_sub_layers", {}).values())
-        if len(subs) < S:
-            raise ValueError(
-                f"pp_degree={S} needs >= {S} top-level sublayers, model "
-                f"has {len(subs)}")
-        block_param_ids = {id(q) for b in subs for q in b.parameters()}
+        units = self._flat_units()
+        unit_param_ids = {id(q) for b in units for q in b.parameters()}
         own = [p for p in self.model.parameters()
-               if id(p) not in block_param_ids]
+               if id(p) not in unit_param_ids]
         if own:
             raise ValueError(
                 "Engine pipeline parallelism requires ALL parameters to "
-                "live in the model's top-level sublayers (a Sequential "
-                "of blocks); found parameters owned by the model itself")
+                "live in the model's top-level sublayers (run in "
+                "definition order); found parameters owned by the model "
+                "itself")
+        if any(True for _ in self.model.buffers()):
+            raise ValueError(
+                "Engine pipeline parallelism does not support buffers "
+                "(running stats): block weights stack on a pp-sharded "
+                "stage axis with no mutable-state slot; use buffer-free "
+                "layers or the dp/mp path")
 
-        def sig(block):
-            return tuple((tuple(p.data.shape), str(p.data.dtype))
-                         for p in block.parameters())
+        def sig(b):
+            ps = tuple((tuple(p.data.shape), str(p.data.dtype))
+                       for p in b.parameters())
+            # type too: equal param shapes with different forward code
+            # (Relu vs Gelu blocks) would silently run block[0]'s math
+            return (type(b), ps) if ps else None
 
-        sigs = {sig(b) for b in subs}
-        # one block TYPE too: equal param shapes with different forward
-        # code (Relu vs Gelu blocks) would silently run block[0]'s math
-        # for every stage
-        if len({type(b) for b in subs}) != 1:
+        sigs = [sig(u) for u in units]
+        best_len, best_start = 0, 0
+        i = 0
+        while i < len(units):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(units) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best_len:
+                best_len, best_start = j - i, i
+            i = j
+        if best_len < S:
             raise ValueError(
-                "Engine pipeline parallelism needs ONE repeated block "
-                f"type; got {sorted({type(b).__name__ for b in subs})} — "
-                "different forwards cannot share the stacked stage "
-                "template")
-        if len(sigs) != 1:
-            raise ValueError(
-                "Engine pipeline parallelism needs structurally identical "
-                "blocks (same parameter shapes/dtypes per block) so their "
-                "weights stack on a pp-sharded layer axis; this model's "
-                "blocks differ. Heterogeneous program partitioning is the "
-                "reference's static-graph partitioner — out of scope here; "
-                "use the model-level pp paths (models/llama.py) or make "
-                "the model a Sequential of one repeated block")
-        if len(subs) % S:
-            raise ValueError(
-                f"{len(subs)} blocks not divisible by pp_degree {S}")
-        for b in subs:
-            # recursive: nested sublayers' buffers (BatchNorm running
-            # stats) disqualify too — only parameters are stage-stacked
-            if any(True for _ in b.buffers()):
-                raise ValueError(
-                    "pp blocks with buffers (running stats) are not "
-                    "stackable; use buffer-free blocks")
-        return subs
+                f"pp_degree={S} needs a run of >= {S} structurally "
+                f"identical blocks (same type + param shapes/dtypes); the "
+                f"longest run in this model is {best_len}. Fully "
+                "heterogeneous program partitioning is the reference's "
+                "static-graph partitioner — out of scope; use the "
+                "model-level pp paths (models/llama.py) or repeat a block")
+        # absorb a non-divisible remainder into the pre layers (those
+        # blocks run un-pipelined at GSPMD level; legal, just unstaged)
+        rem = best_len % S
+        start = best_start + rem
+        end = best_start + best_len
+        return units[:start], units[start:end], units[end:]
 
     def prepare(self):
         """Plan + shard all parameters (idempotent)."""
@@ -198,6 +267,7 @@ class Engine:
         if self.strategy.pp_degree > 1:
             self._pp_blocks = self._partition_blocks()
         self.plan = {}
+        owners = self._param_owners()
         for name, p in self.model.named_parameters():
             existing = getattr(p.data, "sharding", None)
             # a user placement is a NamedSharding with at least one
@@ -209,7 +279,7 @@ class Engine:
                             for ax in tuple(existing.spec))):
                 self.plan[name] = existing.spec  # user placement wins
                 continue
-            spec = self._plan_param(name, p)
+            spec = self._plan_param(name, p, owners.get(id(p)))
             self.plan[name] = spec
             p.data = jax.device_put(p.data, NamedSharding(self._mesh,
                                                           spec))
@@ -220,27 +290,35 @@ class Engine:
     def _trainables(self) -> List:
         return [p for p in self.model.parameters() if not p.stop_gradient]
 
-    def _loss_arrays(self, params) -> Callable:
-        """Pure (param_arrays, x, y) -> scalar loss array, running the
-        eager Layer over traced values (the to_static capture trick)."""
+    def _loss_arrays(self, params, bufs) -> Callable:
+        """Pure (param_arrays, buf_arrays, x, y) -> (loss, new_bufs),
+        running the eager Layer over traced values (the to_static capture
+        trick). Buffers (BatchNorm running stats, SpectralNorm u/v) are
+        threaded as traced inputs AND returned — binding them keeps the
+        forward's in-place buffer writes from leaking tracers into the
+        Layer, and returning them keeps the stats updating per step."""
         from ...autograd import tape as _tape
 
-        def lf(parrs, x, y, karr=None):
+        def lf(parrs, barrs, x, y, karr=None):
             kctx = (_bind([_GenKeyState()], [karr]) if karr is not None
                     else contextlib.nullcontext())
-            with _bind(params, parrs), kctx, _tape.no_grad():
+            with _bind(params, parrs), _bind(bufs, barrs), kctx, \
+                    _tape.no_grad():
                 out = self.model(Tensor(x))
                 l = self.loss(out, Tensor(y, stop_gradient=True))
-            return l.data if isinstance(l, Tensor) else l
+                new_b = [b._data for b in bufs]
+            return (l.data if isinstance(l, Tensor) else l), new_b
         return lf
 
     def _pp_loss_arrays(self, params) -> Callable:
-        """Pure loss with the homogeneous blocks run as a GPipe pipeline
-        over the mesh pp axis (parallel/pipeline_spmd)."""
+        """Pure loss with the identical-block run as a GPipe pipeline
+        over the mesh pp axis (parallel/pipeline_spmd); the heterogeneous
+        pre/post layers (embedding / head) run at GSPMD level around it
+        (the models/llama.py forward_pipelined layout)."""
         from ...autograd import tape as _tape
         from ...parallel.pipeline_spmd import microbatch, pipeline_spmd
 
-        blocks = self._pp_blocks
+        pre, blocks, post = self._pp_blocks
         S = self.strategy.pp_degree
         M = self.strategy.num_microbatches
         mesh = self._mesh
@@ -248,8 +326,12 @@ class Engine:
         template = blocks[0]
         tparams = list(template.parameters())
         pos = {id(p): i for i, p in enumerate(params)}
-        # [block][param_j] -> index into the flat trainables list
+        # [block][param_j] -> index into the flat param-array list
         block_idx = [[pos[id(p)] for p in b.parameters()] for b in blocks]
+        pre_params = [p for b in pre for p in b.parameters()]
+        post_params = [p for b in post for p in b.parameters()]
+        pre_idx = [pos[id(p)] for p in pre_params]
+        post_idx = [pos[id(p)] for p in post_params]
         # per-leaf stacked sharding: pp on the stage axis, the planner's
         # mp placement (same across blocks, by homogeneity) on the rest
         leaf_specs = [tuple(p.data.sharding.spec)
@@ -257,10 +339,22 @@ class Engine:
                                     NamedSharding) else (None,) * p.data.ndim
                       for p in blocks[0].parameters()]
 
-        def lf(parrs, x, y, karr=None):
+        def run_layers(layers, lparams, larrs, state):
+            with _tape.no_grad(), _bind(lparams, larrs):
+                for lyr in layers:
+                    t = lyr(Tensor(state))
+                    state = t.data if isinstance(t, Tensor) else t
+            return state
+
+        def lf(parrs, barrs, x, y, karr=None):
+            del barrs  # pp rejects buffered models in _partition_blocks
             kctx = (_bind([_GenKeyState()], [karr]) if karr is not None
                     else contextlib.nullcontext())
             with kctx:
+                state = x
+                if pre:
+                    state = run_layers(pre, pre_params,
+                                       [parrs[i] for i in pre_idx], state)
                 stacked = []
                 for j in range(len(tparams)):
                     s = jnp.stack([parrs[block_idx[b][j]]
@@ -271,25 +365,28 @@ class Engine:
                                          P("pp", None, *leaf_specs[j])))
                     stacked.append(s)
 
-                def stage_fn(sp, state):
+                def stage_fn(sp, st):
                     # sp leaves: [Lb/S, ...]; run the stage's blocks
                     with _tape.no_grad():
                         for l in range(Lb // S):
                             with _bind(tparams, [leaf[l] for leaf in sp]):
-                                t = template(Tensor(state))
-                            state = t.data if isinstance(t, Tensor) else t
-                    return state
+                                t = template(Tensor(st))
+                            st = t.data if isinstance(t, Tensor) else t
+                    return st
 
-                xm = microbatch(x, M)
+                xm = microbatch(state, M)
                 xm = lax.with_sharding_constraint(
                     xm, NamedSharding(mesh, P(None, "dp",
                                               *([None] * (xm.ndim - 2)))))
                 out = pipeline_spmd(stage_fn, stacked, xm, num_stages=S)
                 out = out.reshape((-1,) + out.shape[2:])
+                if post:
+                    out = run_layers(post, post_params,
+                                     [parrs[i] for i in post_idx], out)
                 with _tape.no_grad():
                     l = self.loss(Tensor(out),
                                   Tensor(y, stop_gradient=True))
-            return l.data if isinstance(l, Tensor) else l
+            return (l.data if isinstance(l, Tensor) else l), []
         return lf
 
     def _build_jit_step(self):
@@ -297,23 +394,28 @@ class Engine:
             # pp stacks EVERY block param (frozen ones included — the
             # position map must cover b.parameters() exactly); the
             # optimizer still skips frozen params (no grad assigned)
-            params = [p for b in self._pp_blocks for p in b.parameters()]
+            pre, blocks, post = self._pp_blocks
+            params = [p for b in (*pre, *blocks, *post)
+                      for p in b.parameters()]
+            bufs = []
             lf = self._pp_loss_arrays(params)
         else:
             params = self._trainables()
-            lf = self._loss_arrays(params)
+            bufs = list(self.model.buffers())
+            lf = self._loss_arrays(params, bufs)
         # thread the global RNG key through the step so dropout-style
         # ops resample every call instead of replaying the trace-time key
         state_t = self.optimizer._all_state_tensors() + [_GenKeyState()]
         opt = self.optimizer
 
-        def pure(parrs, sarrs, x, y):
+        def pure(parrs, sarrs, barrs, x, y):
             # last state slot is the RNG key: one child seeds this step's
             # dropout masks (threaded INTO the loss so the forward under
             # value_and_grad uses a traced key, not a baked constant),
             # the other becomes the next step's key
             k_inner, k_next = jax.random.split(sarrs[-1])
-            loss, grads = jax.value_and_grad(lf)(parrs, x, y, k_inner)
+            (loss, new_b), grads = jax.value_and_grad(lf, has_aux=True)(
+                parrs, barrs, x, y, k_inner)
             with _bind(params, parrs), _bind(state_t[:-1], sarrs[:-1]):
                 saved = [p._grad for p in params]
                 for p, g in zip(params, grads):
@@ -324,21 +426,25 @@ class Engine:
                 new_s = [t._data for t in state_t[:-1]] + [k_next]
                 for p, sg in zip(params, saved):
                     p._grad = sg
-            return loss, new_p, new_s
+            return loss, new_p, new_s, new_b
 
         self._params = params
+        self._bufs = bufs
         self._state_t = state_t
-        self._jit_step = jax.jit(pure, donate_argnums=(0, 1))
+        self._jit_step = jax.jit(pure, donate_argnums=(0, 1, 2))
 
     def _run_jit_step(self, x, y):
         self.optimizer._sync_lr()
-        loss, new_p, new_s = self._jit_step(
+        loss, new_p, new_s, new_b = self._jit_step(
             [p._data for p in self._params],
-            [t._data for t in self._state_t], x, y)
+            [t._data for t in self._state_t],
+            [b._data for b in self._bufs], x, y)
         for p, a in zip(self._params, new_p):
             p._data = a
         for t, a in zip(self._state_t, new_s):
             t._data = a
+        for b, a in zip(self._bufs, new_b):
+            b._data = a
         return loss
 
     def _eager_step(self, x, y):
@@ -352,9 +458,18 @@ class Engine:
     def _shard_arr(self, arr):
         a = arr.data if isinstance(arr, Tensor) else jnp.asarray(
             np.asarray(arr))
-        if a.ndim and a.shape[0] % self.strategy.dp_degree == 0:
+        dp = self.strategy.dp_degree
+        if a.ndim and a.shape[0] % dp == 0:
             spec = P("dp", *([None] * (a.ndim - 1)))
             a = jax.device_put(a, NamedSharding(self._mesh, spec))
+        elif a.ndim and dp > 1:
+            # a silently replicated batch trains dp-degree-times slower
+            # with zero diagnostics — warn (r4 Weak #2)
+            import warnings
+            warnings.warn(
+                f"batch dim {a.shape[0]} not divisible by dp_degree {dp}: "
+                "this batch runs REPLICATED across the dp axis (no data "
+                "parallelism). Pad the batch or pick a divisible size.")
         return a
 
     @staticmethod
@@ -413,21 +528,28 @@ class Engine:
         from ...autograd import tape as _tape
         if self._jit_fwd is None:
             params = list(self.model.parameters())
+            bufs = list(self.model.buffers())
             key_state = _GenKeyState()
 
-            def pure(parrs, karr, x):
-                with _bind(params, parrs), _bind([key_state], [karr]), \
-                        _tape.no_grad():
+            def pure(parrs, barrs, karr, x):
+                # buffers are bound as traced INPUTS so eval-mode reads
+                # (BN running stats) see post-training values instead of
+                # constants baked at first trace; _bind restores them on
+                # exit, so train-mode mutations cannot leak tracers
+                with _bind(params, parrs), _bind(bufs, barrs), \
+                        _bind([key_state], [karr]), _tape.no_grad():
                     out = self.model(Tensor(x))
                     out = out.data if isinstance(out, Tensor) else out
                     new_key = key_state._data
                 return out, new_key
 
             self._fwd_params = params
+            self._fwd_bufs = bufs
             self._fwd_key = key_state
             self._jit_fwd = jax.jit(pure)
         out, new_key = self._jit_fwd(
-            [p._data for p in self._fwd_params], self._fwd_key._data, x)
+            [p._data for p in self._fwd_params],
+            [b._data for b in self._fwd_bufs], self._fwd_key._data, x)
         self._fwd_key._data = new_key
         return Tensor(out)
 
@@ -502,4 +624,5 @@ class Engine:
             raise RuntimeError("run fit() for at least 2 steps first")
         return self._jit_step.lower(
             [p._data for p in self._params],
-            [t._data for t in self._state_t], x, y).compile().as_text()
+            [t._data for t in self._state_t],
+            [b._data for b in self._bufs], x, y).compile().as_text()

@@ -164,12 +164,28 @@ def restart_attempt() -> int:
 def save_checkpoint(state_dict: Dict[str, Any], root: str, step: int,
                     keep: Optional[int] = None, async_save: bool = False):
     """Save ``state_dict`` under ``root/step_<step>``; with ``keep``,
-    prune all but the newest ``keep`` completed steps."""
+    prune all but the newest ``keep`` completed steps.
+
+    Pruning runs on process 0 only (every process rmtree-ing the shared
+    directory concurrently races), counts the just-scheduled step even
+    when an async save has not committed it yet, and never touches steps
+    >= the current one (an in-flight async commit must survive)."""
+    if keep is not None and keep < 1:
+        raise ValueError(f"keep must be >= 1, got {keep} "
+                         "(keep=0 would prune nothing, silently)")
     path = os.path.join(os.path.abspath(root), f"step_{int(step)}")
     out = save_state_dict(state_dict, path, async_save=async_save)
-    if keep is not None:
+    if keep is not None and jax.process_index() == 0:
         import shutil
-        for s, p in sorted(checkpoint_steps(root))[:-keep]:
+        # only steps strictly OLDER than the current save are candidates:
+        # with async_save the current step may not be committed yet (so
+        # checkpoint_steps misses it), and racing its tmp-dir commit
+        # would corrupt the newest checkpoint
+        older = sorted(s_p for s_p in checkpoint_steps(root)
+                       if s_p[0] < int(step))
+        n_keep_older = keep - 1  # the current step occupies one keep slot
+        doomed = older[:-n_keep_older] if n_keep_older > 0 else older
+        for s, p in doomed:
             shutil.rmtree(p, ignore_errors=True)
     return out
 

@@ -26,12 +26,59 @@ executable.
 from __future__ import annotations
 
 import weakref
+from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+# compiled-segment cache bound (LRU): a varying-shape inference server
+# must not leak one pinned executable per (ops, shapes) signature forever
+_EXEC_CACHE_MAX = 256
+
+
+def _freeze_cell(v, depth: int = 0):
+    """A hashable stand-in for one closure-cell value.
+
+    Containers tuple-ize (static/nn.py's ``captured`` is a fresh LIST
+    each call); Tensors key by OBJECT identity — safe because the
+    recorded fns ``_bind`` those exact objects and read their values
+    from traced arrays, so two closures over the same Tensor objects
+    replay identically. Raw arrays (value-carrying, unbindable) raise,
+    forcing the id(fn) fallback."""
+    if depth > 3:
+        raise TypeError("closure too deep")
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze_cell(x, depth + 1) for x in v)
+    from ..core.tensor import Tensor
+    if isinstance(v, Tensor):
+        return ("__tensor__", id(v))
+    if hasattr(v, "shape") and hasattr(v, "dtype"):
+        raise TypeError("raw array in closure")
+    hash(v)
+    return v
+
+
+def _fn_cache_key(fn):
+    """Key a recorded op's fn by its code object + frozen closure cells:
+    APIs that build a fresh closure per call (static/nn.py cond/case/
+    while close over a fresh ``captured`` list of stable Tensors + the
+    user's stable branch callables) would never hit an ``id(fn)`` key —
+    every flush would re-jit and permanently pin the dead closure
+    (ADVICE r4). Falls back to identity when a cell defies freezing."""
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return id(fn)
+    cells = ()
+    if getattr(fn, "__closure__", None):
+        try:
+            cells = tuple(_freeze_cell(c.cell_contents)
+                          for c in fn.__closure__)
+        except Exception:
+            return id(fn)
+    return (code, cells)
 
 def current() -> Optional["SegmentRecorder"]:
     from ..ops import registry as _registry
@@ -123,7 +170,7 @@ class SegmentRecorder:
         self.inputs: List[Any] = []         # concrete input arrays
         self._input_ids: Dict[int, int] = {}
         self._lazy_out: List[List[weakref.ref]] = []  # per-op LazyValues
-        self._exec_cache: Dict[Tuple, Any] = {}
+        self._exec_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self.stats = {"ops_recorded": 0, "ops_eager": 0, "segments": 0,
                       "cache_hits": 0}
 
@@ -232,7 +279,7 @@ class SegmentRecorder:
             # refs with different axes must NOT share an executable
             statics = tuple(hashable(x) for x in leaves
                             if not isinstance(x, _Ref))
-            sig.append((name, id(fn), refs, statics))
+            sig.append((name, _fn_cache_key(fn), refs, statics))
         in_sig = tuple((tuple(a.shape), str(jnp.result_type(a)))
                        for a in self.inputs)
         return (tuple(sig), in_sig)
@@ -269,10 +316,13 @@ class SegmentRecorder:
 
             runner = jax.jit(replay)
             self._exec_cache[sig] = runner
+            if len(self._exec_cache) > _EXEC_CACHE_MAX:
+                self._exec_cache.popitem(last=False)  # LRU eviction
         else:
             # the cached executable replays the ops IT was built from —
-            # valid because the signature (ops, fn ids, refs, statics,
-            # input avals) matches exactly
+            # valid because the signature (ops, fn code+closure values,
+            # refs, statics, input avals) matches exactly
+            self._exec_cache.move_to_end(sig)
             self.stats["cache_hits"] += 1
 
         results = runner(list(self.inputs))

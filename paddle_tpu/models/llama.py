@@ -218,6 +218,21 @@ def _fused_nr_on(cfg: LlamaConfig, mesh) -> bool:
         return False
 
 
+def _spec_divides(mesh, spec, shape) -> bool:
+    """Whether every sharded dim of ``shape`` divides its mesh axis size
+    (shard_map requires even splits; GSPMD would pad, shard_map raises)."""
+    for dim, ax in zip(shape, tuple(spec)):
+        if ax is None:
+            continue
+        axes = ax if isinstance(ax, (tuple, list)) else (ax,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        if dim % size:
+            return False
+    return True
+
+
 def _tp_heads_shardable(cfg: LlamaConfig, mesh) -> bool:
     """Whether q/k/v head dims can shard over tp: the GQA group structure
     survives a head split iff BOTH head counts divide the tp degree."""
@@ -293,8 +308,14 @@ def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None,
     sharded = None
     if fused_nr and sp_spec is not None:
         sharded = _fused_shard_specs(cfg, mesh, sp_spec)
+        if sharded is not None and not _spec_divides(mesh, sharded[0],
+                                                    h.shape):
+            sharded = None  # uneven split: shard_map would raise
         if sharded is None:
             fused_nr = False  # sharded stream, no mesh context: jnp
+        elif sharded[1] is not None and not _spec_divides(
+                mesh, sharded[1][0], (B, T, H, Dh)):
+            sharded = (sharded[0], None)  # rope alone falls back to jnp
     norm = _norm_fn(cfg, mesh, fused_nr, sharded[0] if sharded else None)
     if fused_nr and sharded is not None and sharded[1] is not None:
         from ..ops.pallas.fused_norm_rope import fused_rope_sharded
@@ -349,9 +370,15 @@ def _train_attn_fn(cfg: LlamaConfig, mesh):
         dp_ax = "dp" if "dp" in mesh.shape else None
         spec = P(dp_ax, None, "tp", None)
         body = lambda ql, kl, vl: _fa(ql, kl, vl, causal=True, impl=impl)
-        return lambda q, k, v: shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)(q, k, v)
+
+        def attn(q, k, v):
+            if not _spec_divides(mesh, spec, q.shape):
+                # uneven batch split: plain GSPMD call instead of a
+                # shard_map trace error
+                return _fa(q, k, v, causal=True, impl=impl)
+            return shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)(q, k, v)
+        return attn
     return lambda q, k, v: _fa(q, k, v, causal=True, impl=impl)
 
 
@@ -415,9 +442,13 @@ def forward(params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
         h = lax.with_sharding_constraint(h, sp_spec)
     h = _scan_layers(params["layers"], h, cfg, sp_spec, remat=cfg.remat,
                      mesh=mesh, positions=positions)
-    norm = _norm_fn(cfg, mesh, _fused_nr_on(cfg, mesh),
-                    sp_spec.spec if sp_spec is not None else None)
-    h = norm(h, params["final_norm"])
+    fin_spec = sp_spec.spec if sp_spec is not None else None
+    if fin_spec is not None and not _spec_divides(mesh, fin_spec, h.shape):
+        fin_spec = None  # uneven split: run the jnp norm instead
+        fused_fin = False
+    else:
+        fused_fin = _fused_nr_on(cfg, mesh)
+    h = _norm_fn(cfg, mesh, fused_fin, fin_spec)(h, params["final_norm"])
     return h @ params["lm_head"]
 
 

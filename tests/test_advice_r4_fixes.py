@@ -1,0 +1,97 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md r4):
+
+1. Engine jitted step leaking tracers into model buffers — covered by
+   test_auto_parallel_engine.py::test_engine_jitted_bn_buffers_*.
+2. Segment _exec_cache unbounded + keyed by id(fn): fresh closures per
+   call (static/nn.py cond/case/while) re-jitted every flush and pinned
+   dead closures forever (jit/segments.py).
+3. save_checkpoint keep-pruning: keep=0 pruned nothing, every process
+   pruned concurrently, async saves left an extra stale checkpoint
+   (distributed/checkpoint.py).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as pt
+from paddle_tpu.core.tensor import Tensor
+from paddle_tpu.jit import segments as seg
+from paddle_tpu.distributed import checkpoint as ckpt
+
+
+def _record_one(rec, fn, x):
+    with rec.active():
+        out = rec.record("mul_test", fn, (Tensor(x),), {}, need_grad=False)
+        rec.flush()
+    return out
+
+
+def test_segment_cache_hits_across_fresh_closures():
+    """Same code + same closure values must share one executable even
+    when the fn OBJECT is fresh each call (id(fn) keying never hit)."""
+    rec = seg.SegmentRecorder()
+    x = jnp.ones((4,))
+
+    def make(scale):
+        return lambda a: a * scale
+
+    for _ in range(3):
+        _record_one(rec, make(2.0), x)  # fresh closure, equal contents
+    assert rec.stats["cache_hits"] == 2, rec.stats
+    assert len(rec._exec_cache) == 1
+
+    # different closure VALUES must not share (2.0 vs 3.0)
+    _record_one(rec, make(3.0), x)
+    assert len(rec._exec_cache) == 2
+
+
+def test_segment_cache_bounded_lru():
+    rec = seg.SegmentRecorder()
+    old = seg._EXEC_CACHE_MAX
+    seg._EXEC_CACHE_MAX = 4
+    try:
+        for n in range(1, 11):  # 10 distinct shapes -> 10 signatures
+            _record_one(rec, lambda a: a * 2.0, jnp.ones((n,)))
+        assert len(rec._exec_cache) <= 4
+    finally:
+        seg._EXEC_CACHE_MAX = old
+
+
+def test_checkpoint_keep_zero_rejected():
+    with pytest.raises(ValueError, match="keep"):
+        ckpt.save_checkpoint({"a": np.zeros(2)}, "/tmp/_never", 0, keep=0)
+
+
+def test_checkpoint_keep_prunes_older_only(tmp_path):
+    root = str(tmp_path / "ck")
+    for step in range(1, 5):
+        state = {"w": Tensor(np.full((2,), float(step), np.float32))}
+        ckpt.save_checkpoint(state, root, step, keep=2)
+    steps = sorted(s for s, _ in ckpt.checkpoint_steps(root))
+    assert steps == [3, 4], steps
+    # the newest survives intact and restores
+    state = {"w": Tensor(np.zeros((2,), np.float32))}
+    assert ckpt.load_latest_checkpoint(state, root) == 4
+    np.testing.assert_allclose(np.asarray(state["w"].data), 4.0)
+
+
+def test_segment_cache_hits_for_cond_style_closures():
+    """The advisor's cited workload: fn closes over a fresh LIST of
+    stable Tensors + stable callables — must share one executable."""
+    rec = seg.SegmentRecorder()
+    state = Tensor(jnp.ones((4,)))
+
+    def stable_branch(a):
+        return a + 1.0
+
+    def make_fn():
+        captured = [state]  # fresh list per call, stable contents
+        return lambda a: stable_branch(a * len(captured))
+
+    x = jnp.ones((4,))
+    for _ in range(3):
+        _record_one(rec, make_fn(), x)
+    assert rec.stats["cache_hits"] == 2, rec.stats

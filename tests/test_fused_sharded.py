@@ -201,3 +201,19 @@ def test_fused_sharding_introduces_no_extra_all_gather():
     n_dense = _count(_tiny(use_fused_norm_rope=False))
     assert n_fused <= n_dense, (
         f"fused path added all-gathers: {n_fused} vs {n_dense}")
+
+
+def test_fused_falls_back_on_non_divisible_shapes():
+    """Uneven seq/batch splits must fall back to the jnp path, not crash
+    the shard_map trace (code-review r5 regression)."""
+    mesh = _tp_mesh()
+    cfg = L.LlamaConfig.tiny(dtype=jnp.float32, remat=False,
+                             use_fused_norm_rope="pallas",
+                             use_flash_attention=True)
+    # T=15 not divisible by tp=2; B=3 not divisible by dp=2
+    toks = jax.random.randint(jax.random.PRNGKey(9), (3, 16), 0,
+                              cfg.vocab_size, dtype=jnp.int32)
+    batch = {"tokens": toks[:, :-1], "labels": toks[:, 1:]}
+    loss_f, _ = _grads(cfg, mesh, batch)
+    loss_d, _ = _grads(_tiny(use_fused_norm_rope=False), mesh, batch)
+    np.testing.assert_allclose(loss_f, loss_d, rtol=2e-5)
