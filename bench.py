@@ -115,6 +115,7 @@ def main():
         deep_cfg, deep_B, deep_iters = None, 0, 0
 
     decode_tok_s = None
+    paged_tok_s = dense_batch_tok_s = None
     deep = {}
     hm = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
     with hm.mesh:
@@ -135,6 +136,49 @@ def main():
             out = gen(state["params"], prompt)
             int(out[0, -1])  # host sync
             decode_tok_s = gen_new / (time.perf_counter() - t0)
+
+            # batched MIXED-LENGTH decode: paged KV (block tables, pallas
+            # paged_attention) vs the dense cache padded to max length.
+            # 32 concurrent streams, prompts 64..2016 tokens; decode time
+            # isolated by differencing a long and a short generation
+            # (identical prefill cancels).
+            Bs = 32
+            lens_mix = [64 + (2016 - 64) * i // (Bs - 1) for i in range(Bs)]
+            t0max = 2048  # splash prefill needs T % 512 == 0
+            pad_prompt = jax.random.randint(
+                jax.random.PRNGKey(3), (Bs, t0max), 0, cfg.vocab_size,
+                dtype=jnp.int32)
+            lens_arr = jnp.asarray(lens_mix, jnp.int32)
+            n_long, n_short = 40, 8
+
+            def timed(fn, *args):
+                out = fn(*args)          # compile + warmup
+                int(out[0, -1])
+                best = float("inf")
+                for _ in range(2):
+                    t0 = time.perf_counter()
+                    out = fn(*args)
+                    int(out[0, -1])
+                    best = min(best, time.perf_counter() - t0)
+                return best
+
+            def paged_for(n):
+                fn = jax.jit(partial(L.generate_paged, cfg=cfg,
+                                     max_new_tokens=n, page_size=32,
+                                     attn_impl="pallas"))
+                return lambda: fn(state["params"], pad_prompt, lens_arr)
+
+            def dense_for(n):
+                fn = jax.jit(partial(L.generate, cfg=cfg,
+                                     max_new_tokens=n))
+                return lambda: fn(state["params"], pad_prompt)
+
+            def rate2(mk):
+                return Bs * (n_long - n_short) / (
+                    timed(mk(n_long)) - timed(mk(n_short)))
+
+            paged_tok_s = rate2(paged_for)
+            dense_batch_tok_s = rate2(dense_for)
 
         if deep_cfg is not None:
             del state  # free the flagship's HBM before the deep compile
@@ -157,6 +201,10 @@ def main():
         "tokens_per_sec": round(B * T / dt, 1),
         "decode_tokens_per_sec": (round(decode_tok_s, 1)
                                   if decode_tok_s else None),
+        "paged_decode_tokens_per_sec": (round(paged_tok_s, 1)
+                                        if paged_tok_s else None),
+        "dense_batch_decode_tokens_per_sec": (
+            round(dense_batch_tok_s, 1) if dense_batch_tok_s else None),
         "step_ms": round(dt * 1e3, 2),
         "params_b": round(count_params(cfg) / 1e9, 3),
         "loss": float(loss),

@@ -239,7 +239,10 @@ class Tensor:
     def __getitem__(self, idx):
         from ..ops import registry
         idx = _unwrap_index(idx)
-        return registry.call_op("getitem", lambda x: x[idx], (self,), {})
+        # key passed as a (static) kwarg, not a closure cell: trace
+        # consumers (onnx export) need to SEE the index
+        return registry.call_op("getitem", lambda x, key: x[key], (self,),
+                                {"key": idx})
 
     def __setitem__(self, idx, value):
         idx = _unwrap_index(idx)

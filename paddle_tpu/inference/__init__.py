@@ -178,6 +178,51 @@ class GenerationPredictor:
             self._params, prompt, key=jax.random.PRNGKey(seed))
         return np.asarray(out)
 
+    def _paged_fn(self, B, bucket, max_new_tokens, temperature, top_p,
+                  page_size):
+        import jax
+        from functools import partial
+        key_ = ("paged", B, bucket, max_new_tokens, temperature, top_p,
+                page_size)
+        if key_ not in self._compiled:
+            self._compiled[key_] = jax.jit(partial(
+                self._L.generate_paged, cfg=self._cfg,
+                max_new_tokens=max_new_tokens, page_size=page_size,
+                temperature=temperature, top_p=top_p))
+        return self._compiled[key_]
+
+    def generate_ragged(self, prompts, max_new_tokens: int, *,
+                        temperature: float = 0.0, top_p: float = 1.0,
+                        seed: int = 0, page_size: int = 16):
+        """Mixed-length batched decode over the paged KV cache
+        (models/llama.py generate_paged; reference capability:
+        block_multihead_attention serving decode). ``prompts`` is a list
+        of 1-D token-id sequences; they are right-padded to one
+        power-of-two bucket (bounding compiles) and decoded in ONE
+        program whose attention reads only each sequence's valid pages.
+        Returns a list of ``[max_new_tokens]`` continuations."""
+        import jax
+        import jax.numpy as jnp
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        lens = [len(p) for p in prompts]
+        t0 = max(lens)
+        bucket = 1 << max(t0 - 1, 0).bit_length()
+        if bucket + max_new_tokens > self._max_len:
+            raise ValueError(
+                f"prompt bucket {bucket} + continuation {max_new_tokens} "
+                f"exceeds max_len {self._max_len}")
+        B = len(prompts)
+        padded = np.zeros((B, bucket), np.int32)
+        for i, p in enumerate(prompts):
+            padded[i, :lens[i]] = np.asarray(p, np.int32)
+        out = self._paged_fn(B, bucket, max_new_tokens, temperature,
+                             top_p, page_size)(
+            self._params, jnp.asarray(padded),
+            jnp.asarray(lens, jnp.int32), key=jax.random.PRNGKey(seed))
+        out = np.asarray(out)
+        return [out[i] for i in range(B)]
+
 
 from .passes import fold_batch_norms  # noqa: E402,F401  (IR-pass analogue)
 from .serving import DynamicBatcher  # noqa: E402,F401

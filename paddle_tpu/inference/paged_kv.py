@@ -1,0 +1,368 @@
+"""Paged (block-table) KV cache for batched decode serving.
+
+Reference capability: the paged KV cache behind the reference's serving
+decode — paddle/phi/kernels/fusion/gpu/block_multi_head_attention_kernel.cu
+exposed at python/paddle/incubate/nn/functional/block_multihead_attention.py
+(fixed-size KV blocks, per-sequence block tables, attention over valid
+blocks only).
+
+TPU-native shape: one KV page pool array per layer
+(``[Hkv, total_pages, page_size, Dh]``), int32 per-sequence page tables,
+and the Pallas ``paged_attention`` kernel
+(jax.experimental.pallas.ops.tpu.paged_attention) whose grid walks only
+each sequence's VALID pages — decode HBM traffic scales with
+``sum(len_b)`` instead of the ``B * max_len`` a dense
+``[B, max_len, Hkv, Dh]`` cache pays on every step. Off-TPU a gathered
+dense formulation with identical semantics runs instead (tests compare
+the two).
+
+Page allocation is host-side (`PagePool`, a free list): serving code
+allocates pages as sequences grow and frees them when streams finish —
+the jitted decode step only ever sees the pool arrays + tables.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["PagePool", "paged_attention", "write_prompt_pages",
+           "write_token_pages"]
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+class PagePool:
+    """Host-side free-list allocator over ``total_pages`` KV pages.
+
+    The reference's block manager role (block_multihead_attention's
+    block tables are produced by the serving layer's block allocator);
+    here it hands out page indices for the pool arrays the jitted step
+    consumes. Page 0 is reserved as the trash page masked writes land
+    on, so valid tables never contain 0.
+    """
+
+    TRASH = 0
+
+    def __init__(self, total_pages: int, page_size: int):
+        if total_pages < 2:
+            raise ValueError("need >= 2 pages (page 0 is the trash page)")
+        self.page_size = int(page_size)
+        self.total_pages = int(total_pages)
+        self._free: List[int] = list(range(total_pages - 1, 0, -1))
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int = 1) -> List[int]:
+        if n > len(self._free):
+            raise RuntimeError(
+                f"KV page pool exhausted: need {n}, have {len(self._free)} "
+                f"of {self.total_pages}")
+        return [self._free.pop() for _ in range(n)]
+
+    def alloc_for_len(self, length: int) -> List[int]:
+        """Pages covering ``length`` tokens."""
+        return self.alloc(max(1, -(-int(length) // self.page_size)))
+
+    def free(self, pages) -> None:
+        for p in pages:
+            p = int(p)
+            if p != self.TRASH:
+                self._free.append(p)
+
+
+def _ref_paged_attention(q, k_pages, v_pages, lengths, page_indices,
+                         sm_scale):
+    """Dense reference with paged semantics: gather each sequence's
+    pages, mask positions >= length. q ``[B, H, Dh]``; pages
+    ``[Hkv, P, ps, Dh]``; returns ``[B, H, Dh]``. One formulation —
+    the stats variant — is the single source of the math."""
+    out, _, _ = _ref_paged_attention_stats(
+        (q * sm_scale).astype(q.dtype), k_pages, v_pages, lengths,
+        page_indices)
+    return out
+
+
+def paged_attention(q, k_pages, v_pages, lengths, page_indices,
+                    sm_scale: Optional[float] = None,
+                    pages_per_compute_block: int = 4, impl: str = "auto"):
+    """Decode attention over a paged KV cache.
+
+    q: ``[B, H, Dh]`` (one query token per sequence).
+    k_pages/v_pages: ``[Hkv, total_pages, page_size, Dh]``.
+    lengths: i32 ``[B]`` valid tokens per sequence (INCLUDING the one
+    just written for the current step).
+    page_indices: i32 ``[B, pages_per_seq]``.
+    impl: "auto" (pallas kernel on TPU, reference elsewhere), "pallas"
+    (strict), "dense".
+    """
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    if impl not in ("auto", "pallas", "dense"):
+        raise ValueError(f"impl must be auto|pallas|dense, got {impl!r}")
+    use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu())
+    if use_pallas:
+        from jax.experimental.pallas.ops.tpu.paged_attention import (
+            paged_attention as _kernel)
+        pps = page_indices.shape[1]
+        blk = pages_per_compute_block
+        while pps % blk:
+            blk -= 1
+        # the kernel applies no softmax scale (all its "scales" are int8
+        # quantization scales) — fold it into q like the splash wrapper
+        return _kernel((q * sm_scale).astype(q.dtype), k_pages, v_pages,
+                       lengths, page_indices,
+                       pages_per_compute_block=blk)
+    return _ref_paged_attention(q, k_pages, v_pages, lengths, page_indices,
+                                sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# split decode: paged prompt + dense tail, merged by online-softmax stats
+# ---------------------------------------------------------------------------
+# Per-sequence page SCATTERS are pathologically slow on TPU (measured
+# ~14 ms/step inside a scan at B=32 — XLA lowers the batched scatter to
+# full-pool traffic), so the decode hot path never writes pages at all:
+# prompt KV lands in pages ONCE (a pure reshape for contiguous tables),
+# generated tokens append to a small dense tail buffer with a
+# lockstep dynamic_update_slice (one shared scalar index), and each
+# step merges  attention-over-pages  with  attention-over-tail  using
+# the numerically exact flash combine
+#     m = max(m_p, m_t);  out = (e^{m_p-m} l_p o_p + e^{m_t-m} l_t o_t)
+#                               / (e^{m_p-m} l_p + e^{m_t-m} l_t).
+# The pallas kernel already computes (m, l) and its stock wrapper
+# discards them; _stats_call below re-plumbs the same kernel body with
+# the stats returned.
+
+
+def _stats_call(q, k_pages, v_pages, lengths, page_indices,
+                pages_per_compute_block: int):
+    """The upstream paged_attention pallas kernel, returning
+    (out_normalized, m, l). Plumbing mirrors the stock wrapper's
+    unquantized single-core path (jax.experimental.pallas.ops.tpu.
+    paged_attention.paged_attention_kernel.paged_attention), which
+    computes these stats and throws them away."""
+    import functools
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    from jax.experimental.pallas.ops.tpu.paged_attention import (
+        paged_attention_kernel as pk)
+
+    batch_size, num_q_heads, head_dim = q.shape
+    num_kv_heads, _, page_size, _ = k_pages.shape
+    _, pages_per_sequence = page_indices.shape
+    num_groups = num_q_heads // num_kv_heads
+
+    if num_groups % 8 != 0:
+        q = q.reshape(batch_size, num_q_heads, 1, head_dim)
+        q_block_spec = pl.BlockSpec(
+            (None, num_groups, None, head_dim),
+            lambda core_index, b, h, *_: (b, h, 0, 0))
+        q_dtype = jnp.float32
+    else:
+        q_block_spec = pl.BlockSpec(
+            (None, num_groups, head_dim),
+            lambda core_index, b, h, *_: (b, h, 0))
+        q_dtype = q.dtype
+
+    kernel = pk.paged_flash_attention_kernel_inline_seq_dim
+    # the inline-seq-dim kernel folds the page loop inside: 3-D grid
+    grid = (1, batch_size, num_kv_heads)
+    dimension_semantics = ("parallel", "arbitrary", "arbitrary")
+    in_specs = [
+        q_block_spec,
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        None,
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        None,
+    ]
+    scratch_shapes = (
+        pltpu.VMEM((2, pages_per_compute_block, page_size, head_dim),
+                   k_pages.dtype),
+        None,
+        pltpu.VMEM((2, pages_per_compute_block, page_size, head_dim),
+                   v_pages.dtype),
+        None,
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+    )
+    out, m, l = pl.pallas_call(
+        functools.partial(
+            kernel,
+            pages_per_sequence=pages_per_sequence,
+            batch_size=batch_size,
+            pages_per_compute_block=pages_per_compute_block,
+            mask_value=-2.3819763e38,
+            attn_logits_soft_cap=None,
+            megacore_mode=None),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            in_specs=in_specs,
+            out_specs=[q_block_spec, q_block_spec, q_block_spec],
+            grid=grid,
+            scratch_shapes=scratch_shapes),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=dimension_semantics),
+        out_shape=[
+            jax.ShapeDtypeStruct(q.shape, q_dtype),
+            jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
+            jax.ShapeDtypeStruct((*q.shape[:-1], 1), jnp.float32),
+        ],
+    )(lengths, page_indices.reshape(-1), jnp.zeros((1,), jnp.int32),
+      jnp.ones((1,), jnp.int32), q.astype(q_dtype), k_pages, None,
+      v_pages, None)
+    B, H = batch_size, num_q_heads
+    return (out.reshape(B, H, head_dim).astype(k_pages.dtype),
+            m.reshape(B, H), l.reshape(B, H))
+
+
+def _ref_paged_attention_stats(q, k_pages, v_pages, lengths, page_indices):
+    """Reference (out_normalized, m, l) with paged semantics; q must
+    already carry the softmax scale (like the kernel's contract)."""
+    B, H, Dh = q.shape
+    Hkv, _, ps, _ = k_pages.shape
+    G = H // Hkv
+
+    def per_seq(qb, tab, ln):
+        S = tab.shape[0] * ps
+        k = k_pages[:, tab].reshape(Hkv, S, Dh)
+        v = v_pages[:, tab].reshape(Hkv, S, Dh)
+        qg = qb.reshape(Hkv, G, Dh)
+        s = jnp.einsum("kgd,ksd->kgs", qg, k).astype(jnp.float32)
+        mask = jnp.arange(S) < ln
+        s = jnp.where(mask[None, None, :], s, -1e30)
+        m = jnp.max(s, axis=-1)
+        p = jnp.exp(s - m[..., None])
+        l = jnp.sum(p, axis=-1)
+        o = jnp.einsum("kgs,ksd->kgd", p.astype(v.dtype), v)
+        o = o / l[..., None].astype(v.dtype)
+        return o.reshape(H, Dh), m.reshape(H), l.reshape(H)
+
+    return jax.vmap(per_seq)(q, page_indices, lengths)
+
+
+def paged_attention_with_tail(q, k_pages, v_pages, prompt_lens,
+                              page_indices, k_tail, v_tail, n_valid,
+                              sm_scale: Optional[float] = None,
+                              pages_per_compute_block: int = 4,
+                              impl: str = "auto"):
+    """Decode attention over paged PROMPT KV merged with a dense TAIL of
+    generated tokens.
+
+    q ``[B, H, Dh]``; k_tail/v_tail ``[B, Nt, Hkv, Dh]`` with the first
+    ``n_valid`` slots live (lockstep across the batch — slot j holds the
+    j-th GENERATED token of each sequence, at absolute position
+    ``prompt_lens[b] + j``).
+    """
+    B, H, Dh = q.shape
+    Hkv = k_pages.shape[0]
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(Dh))
+    qs = (q * sm_scale).astype(q.dtype)
+    use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu())
+    if use_pallas:
+        pps = page_indices.shape[1]
+        blk = pages_per_compute_block
+        while pps % blk:
+            blk -= 1
+        o_p, m_p, l_p = _stats_call(qs, k_pages, v_pages, prompt_lens,
+                                    page_indices, blk)
+    else:
+        o_p, m_p, l_p = _ref_paged_attention_stats(
+            qs, k_pages, v_pages, prompt_lens, page_indices)
+
+    # tail part (dense, tiny): same scaled-q contract
+    Nt = k_tail.shape[1]
+    qg = qs.reshape(B, Hkv, G, Dh)
+    s_t = jnp.einsum("bkgd,bjkd->bkgj", qg, k_tail).astype(jnp.float32)
+    live = jnp.arange(Nt)[None, None, None, :] < n_valid
+    s_t = jnp.where(live, s_t, -1e30)
+    m_t = jnp.max(s_t, axis=-1).reshape(B, H)
+    p_t = jnp.exp(s_t - m_t.reshape(B, Hkv, G)[..., None])
+    p_t = jnp.where(live, p_t, 0.0)  # dead slots: exp(-1e30+1e30)=1
+    l_t = jnp.sum(p_t, axis=-1).reshape(B, H)
+    o_t = jnp.einsum("bkgj,bjkd->bkgd", p_t.astype(v_tail.dtype),
+                     v_tail).reshape(B, H, Dh)  # UNnormalized
+
+    m = jnp.maximum(m_p, m_t)
+    a_p = (jnp.exp(m_p - m) * l_p)[..., None]
+    a_t = jnp.exp(m_t - m)[..., None]
+    num = a_p.astype(o_p.dtype) * o_p + a_t.astype(o_t.dtype) * o_t
+    den = a_p[..., 0] * 1.0 + a_t[..., 0] * l_t
+    return (num / den[..., None].astype(num.dtype)).astype(q.dtype)
+
+
+def prompt_pages_from_dense(k, v, page_size: int):
+    """Build (k_pages, v_pages, tables) from right-padded prompt KV
+    ``[B, T0, Hkv, Dh]`` by pure reshape — no scatter. Page 0 is the
+    (zeroed) trash page; seq b owns pages ``1 + b*pps .. 1 + (b+1)*pps``.
+    Positions beyond each length hold padding the kernel's length mask
+    never reads."""
+    B, T0, Hkv, Dh = k.shape
+    ps = page_size
+    pps = -(-T0 // ps)
+    pad = pps * ps - T0
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # [B, pps, ps, Hkv, Dh] -> [Hkv, B*pps, ps, Dh]
+    def to_pages(x):
+        x = x.reshape(B * pps, ps, Hkv, Dh).transpose(2, 0, 1, 3)
+        trash = jnp.zeros((Hkv, 1, ps, Dh), x.dtype)
+        return jnp.concatenate([trash, x], axis=1)
+    tables = (1 + np.arange(B * pps, dtype=np.int32)).reshape(B, pps)
+    return to_pages(k), to_pages(v), jnp.asarray(tables)
+
+
+def write_token_pages(k_pages, v_pages, k_t, v_t, lengths, page_indices):
+    """Write ONE new token per sequence at position ``lengths[b]``.
+
+    k_t/v_t: ``[B, Hkv, Dh]``. Returns updated (k_pages, v_pages).
+    Sequences whose table row has run out of pages write to the trash
+    page (callers guarantee capacity via PagePool).
+    """
+    ps = k_pages.shape[2]
+    B = k_t.shape[0]
+    b_idx = jnp.arange(B)
+    slot = lengths // ps
+    slot_ok = slot < page_indices.shape[1]
+    page = jnp.where(slot_ok,
+                     page_indices[b_idx, jnp.minimum(
+                         slot, page_indices.shape[1] - 1)],
+                     PagePool.TRASH)
+    off = lengths % ps
+    # pages[:, page[b], off[b]] = token b  ->  value laid out [Hkv, B, Dh]
+    k_pages = k_pages.at[:, page, off].set(k_t.transpose(1, 0, 2))
+    v_pages = v_pages.at[:, page, off].set(v_t.transpose(1, 0, 2))
+    return k_pages, v_pages
+
+
+def write_prompt_pages(k_pages, v_pages, k, v, lengths, page_indices):
+    """Write a whole (right-padded) prompt's KV: positions ``t >=
+    lengths[b]`` land on the trash page.
+
+    k/v: ``[B, T0, Hkv, Dh]``. Returns updated (k_pages, v_pages).
+    """
+    B, T0 = k.shape[0], k.shape[1]
+    ps = k_pages.shape[2]
+    t = jnp.arange(T0)[None, :]                       # [1, T0]
+    valid = t < lengths[:, None]                      # [B, T0]
+    slot = jnp.broadcast_to(
+        jnp.minimum(t // ps, page_indices.shape[1] - 1), (B, T0))
+    page = jnp.take_along_axis(page_indices, slot.astype(jnp.int32),
+                               axis=1)
+    page = jnp.where(valid, page, PagePool.TRASH)     # [B, T0]
+    off = jnp.broadcast_to(t % ps, (B, T0))
+    k_pages = k_pages.at[:, page, off].set(k.transpose(2, 0, 1, 3))
+    v_pages = v_pages.at[:, page, off].set(v.transpose(2, 0, 1, 3))
+    return k_pages, v_pages

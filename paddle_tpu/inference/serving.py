@@ -33,11 +33,12 @@ __all__ = ["DynamicBatcher"]
 
 
 class _Request:
-    __slots__ = ("arr", "future", "key")
+    __slots__ = ("arr", "future", "key", "length")
 
-    def __init__(self, arr, key):
+    def __init__(self, arr, key, length=None):
         self.arr = arr
         self.key = key
+        self.length = length
         self.future: Future = Future()
 
 
@@ -53,14 +54,25 @@ class DynamicBatcher:
     batch_buckets: batch sizes the batch dim is padded UP to (bounds
       the number of XLA compilations); default powers of two up to
       max_batch_size.
+    seq_buckets: RAGGED mode for 1-D token-id requests (paged decode —
+      reference: the serving layer over block_multihead_attention).
+      Each request is right-padded to the smallest bucket >= its
+      length, so MIXED-length requests share one batch; ``fn`` is then
+      called as ``fn(batch [B, Tb], lengths [B])`` and its per-row
+      output is sliced back to each caller. Pairs with
+      ``GenerationPredictor.generate_ragged`` / ``generate_paged``:
+      short requests stop paying long requests' max-length padding.
     """
 
     def __init__(self, fn: Callable, max_batch_size: int = 8,
                  max_delay_ms: float = 4.0,
-                 batch_buckets: Optional[Sequence[int]] = None):
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 seq_buckets: Optional[Sequence[int]] = None):
         if max_batch_size < 1:
             raise ValueError("max_batch_size must be >= 1")
         self._fn = fn
+        self._seq_buckets = (sorted(int(b) for b in seq_buckets)
+                             if seq_buckets else None)
         self._max_b = int(max_batch_size)
         self._delay = max(float(max_delay_ms), 0.0) / 1e3
         if batch_buckets is None:
@@ -89,7 +101,22 @@ class DynamicBatcher:
         """Queue one example (NO leading batch dim); returns a Future of
         its result row (same structure ``fn`` returns, minus batch)."""
         arr = np.asarray(x)
-        req = _Request(arr, (arr.shape, str(arr.dtype)))
+        if self._seq_buckets is not None:
+            if arr.ndim != 1:
+                raise ValueError(
+                    "seq_buckets mode takes 1-D token-id requests, got "
+                    f"shape {arr.shape}")
+            n = arr.shape[0]
+            bucket = next((b for b in self._seq_buckets if n <= b), None)
+            if bucket is None:
+                raise ValueError(
+                    f"request length {n} exceeds the largest seq bucket "
+                    f"{self._seq_buckets[-1]}")
+            padded = np.zeros((bucket,), arr.dtype)
+            padded[:n] = arr
+            req = _Request(padded, ((bucket,), str(arr.dtype)), length=n)
+        else:
+            req = _Request(arr, (arr.shape, str(arr.dtype)))
         with self._lock:
             # under the lock, a request either precedes the close
             # sentinel in the queue (and is drained) or raises — it can
@@ -185,7 +212,12 @@ class DynamicBatcher:
             pad = np.zeros((b - n,) + stacked.shape[1:], stacked.dtype)
             stacked = np.concatenate([stacked, pad])
         try:
-            out = self._fn(stacked)
+            if self._seq_buckets is not None:
+                lengths = np.asarray([r.length for r in batch] +
+                                     [1] * (b - n), np.int32)
+                out = self._fn(stacked, lengths)
+            else:
+                out = self._fn(stacked)
         except Exception as e:  # propagate to every caller in the batch
             for r in batch:
                 r.future.set_exception(e)

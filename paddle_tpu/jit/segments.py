@@ -16,12 +16,21 @@ segment starts after it. A function with one mid-function break thus
 runs as two compiled XLA modules plus the eager break, instead of
 falling back to per-op eager for everything (the round-3 behavior).
 
-Limits (documented, checked at dispatch): ops that need gradient run
-eagerly after a flush — segment capture serves the no-grad/inference
-path the reference's SOT mostly serves; data-dependent output shapes
-flush and run eagerly. Compiled segments are cached by the recorded
-(op, input-signature) sequence, so steady-state calls reuse the
-executable.
+Training THROUGH breaks (tape_aware=True, the to_static default): a
+recorded segment is a pure function of its slotted inputs, so ops that
+need gradient are recorded too, and each flush registers ONE tape
+GradNode over the whole segment — its backward is ``jax.vjp`` of the
+replayed segment (reference counterpart: SOT compiles training
+subgraphs, python/paddle/jit/sot/translate.py:99). A model with one
+data-dependent break therefore trains as two compiled segments + the
+eager break, with gradients flowing across both, instead of the
+wholesale per-op eager fallback. ``create_graph`` double-backward
+through a segment node is not supported (the node records no taped
+forward closure) — the tape raises with that explanation.
+
+Data-dependent output shapes still flush and run eagerly. Compiled
+segments are cached by the recorded (op, input-signature) sequence, so
+steady-state calls reuse the executable.
 """
 from __future__ import annotations
 
@@ -163,16 +172,24 @@ class _Ref:
 
 
 class SegmentRecorder:
-    """Records registry op calls; flushes them as one jitted module."""
+    """Records registry op calls; flushes them as one jitted module.
 
-    def __init__(self):
+    ``tape_aware``: record ops that need gradient too, and register each
+    flushed segment as ONE tape GradNode (backward = jax.vjp of the
+    segment). Off, grad-needing ops flush the segment and run eagerly.
+    """
+
+    def __init__(self, tape_aware: bool = False):
+        self.tape_aware = tape_aware
         self.pending: List[Tuple] = []      # (name, fn, args_t, kwargs_t)
         self.inputs: List[Any] = []         # concrete input arrays
         self._input_ids: Dict[int, int] = {}
         self._lazy_out: List[List[weakref.ref]] = []  # per-op LazyValues
+        self._out_tensors: List[List[weakref.ref]] = []  # wrapping Tensors
+        self._diff_pos: Dict[int, Any] = {}  # input slot -> grad Tensor
         self._exec_cache: "OrderedDict[Tuple, Any]" = OrderedDict()
         self.stats = {"ops_recorded": 0, "ops_eager": 0, "segments": 0,
-                      "cache_hits": 0}
+                      "cache_hits": 0, "grad_segments": 0}
 
     # ------------------------------------------------------------ record --
     def _slot(self, payload) -> _Ref:
@@ -199,14 +216,20 @@ class SegmentRecorder:
         ``None`` to make the caller run it eagerly (after our flush)."""
         from ..core.tensor import Tensor
 
-        if need_grad:
+        if need_grad and not self.tape_aware:
             self.flush()
             self.stats["ops_eager"] += 1
             return None
 
         def to_template(x):
             if isinstance(x, Tensor):
-                return self._slot(x._data)
+                ref = self._slot(x._data)
+                if (self.tape_aware and ref.kind == "in"
+                        and (not x.stop_gradient or x._node is not None)):
+                    # this concrete input needs gradient: it becomes one
+                    # of the flushed segment's GradNode inputs
+                    self._diff_pos[ref.i] = x
+                return ref
             if hasattr(x, "shape") and hasattr(x, "dtype") and \
                     not np.isscalar(x):
                 # raw array leaf (numpy/jax passed outside a Tensor):
@@ -258,7 +281,9 @@ class SegmentRecorder:
         self.pending.append((name, fn, args_t, kwargs_t, treedef))
         self._lazy_out.append([weakref.ref(lv) for lv in lazies])
         self.stats["ops_recorded"] += 1
-        wrapped = [Tensor(lv, stop_gradient=True) for lv in lazies]
+        wrapped = [Tensor(lv, stop_gradient=not need_grad)
+                   for lv in lazies]
+        self._out_tensors.append([weakref.ref(t) for t in wrapped])
         return jax.tree_util.tree_unflatten(treedef, wrapped)
 
     # ------------------------------------------------------------- flush --
@@ -284,48 +309,55 @@ class SegmentRecorder:
                        for a in self.inputs)
         return (tuple(sig), in_sig)
 
+    def _make_replay(self, pending):
+        def replay(inputs):
+            results = []  # per-op flat outputs
+
+            def resolve(x):
+                if isinstance(x, _Ref):
+                    return (inputs[x.i] if x.kind == "in"
+                            else results[x.i][x.j])
+                return x
+
+            for name, fn, args_t, kwargs_t, treedef in pending:
+                a = jax.tree_util.tree_map(
+                    resolve, args_t,
+                    is_leaf=lambda x: isinstance(x, _Ref))
+                k = jax.tree_util.tree_map(
+                    resolve, kwargs_t,
+                    is_leaf=lambda x: isinstance(x, _Ref))
+                out = fn(*a, **k)
+                results.append(jax.tree_util.tree_leaves(out))
+            return results
+        return replay
+
     def flush(self):
         """Compile + run the pending ops as one jitted segment; fill
-        every produced LazyValue with its concrete array."""
+        every produced LazyValue with its concrete array. With recorded
+        grad inputs (tape_aware), also register the segment as one
+        GradNode on the tape."""
         if not self.pending:
             self._reset_inputs()
             return
         pending = self.pending
         sig = self._signature()
-        runner = self._exec_cache.get(sig)
-        if runner is None:
-            def replay(inputs):
-                results = []  # per-op flat outputs
-
-                def resolve(x):
-                    if isinstance(x, _Ref):
-                        return (inputs[x.i] if x.kind == "in"
-                                else results[x.i][x.j])
-                    return x
-
-                for name, fn, args_t, kwargs_t, treedef in pending:
-                    a = jax.tree_util.tree_map(
-                        resolve, args_t,
-                        is_leaf=lambda x: isinstance(x, _Ref))
-                    k = jax.tree_util.tree_map(
-                        resolve, kwargs_t,
-                        is_leaf=lambda x: isinstance(x, _Ref))
-                    out = fn(*a, **k)
-                    results.append(jax.tree_util.tree_leaves(out))
-                return results
-
-            runner = jax.jit(replay)
-            self._exec_cache[sig] = runner
-            if len(self._exec_cache) > _EXEC_CACHE_MAX:
-                self._exec_cache.popitem(last=False)  # LRU eviction
+        if self._diff_pos:
+            results = self._flush_grad(pending, sig)
         else:
-            # the cached executable replays the ops IT was built from —
-            # valid because the signature (ops, fn code+closure values,
-            # refs, statics, input avals) matches exactly
-            self._exec_cache.move_to_end(sig)
-            self.stats["cache_hits"] += 1
-
-        results = runner(list(self.inputs))
+            runner = self._exec_cache.get(sig)
+            if runner is None:
+                runner = jax.jit(self._make_replay(pending))
+                self._exec_cache[sig] = runner
+                if len(self._exec_cache) > _EXEC_CACHE_MAX:
+                    self._exec_cache.popitem(last=False)  # LRU eviction
+            else:
+                # the cached executable replays the ops IT was built
+                # from — valid because the signature (ops, fn
+                # code+closure values, refs, statics, input avals)
+                # matches exactly
+                self._exec_cache.move_to_end(sig)
+                self.stats["cache_hits"] += 1
+            results = runner(list(self.inputs))
         for outs, refs in zip(results, self._lazy_out):
             for arr, r in zip(outs, refs):
                 lv = r()
@@ -334,11 +366,83 @@ class SegmentRecorder:
         self.stats["segments"] += 1
         self.pending = []
         self._lazy_out = []
+        self._out_tensors = []
         self._reset_inputs()
+
+    def _flush_grad(self, pending, sig):
+        """Run the segment under ``jax.vjp`` and register ONE GradNode:
+        the reference's SOT compiles training subgraphs the same way
+        (jit/sot/translate.py:99) — here the subgraph's backward is the
+        vjp of its replay function."""
+        from ..autograd import tape as _tape
+
+        diff_idx = sorted(self._diff_pos)
+        diff_set = set(diff_idx)
+        diff_tensors = [self._diff_pos[i] for i in diff_idx]
+        n_inputs = len(self.inputs)
+        nondiff = [a for i, a in enumerate(self.inputs)
+                   if i not in diff_set]
+
+        gkey = ("grad", sig, tuple(diff_idx))
+        pair = self._exec_cache.get(gkey)
+        if pair is None:
+            replay = self._make_replay(pending)
+
+            def seg_fwd(diff_arrays, nondiff_arrays):
+                it_d, it_n = iter(diff_arrays), iter(nondiff_arrays)
+                inputs = [next(it_d) if i in diff_set else next(it_n)
+                          for i in range(n_inputs)]
+                return replay(inputs)
+
+            def seg_bwd(diff_arrays, nondiff_arrays, cot_tree):
+                # vjp INSIDE the jit (the registry's _build_cached
+                # pattern): the linearize+transpose happens once per
+                # signature at compile time; steady-state flushes are
+                # pure execution of the cached executables
+                _, vjp = jax.vjp(lambda d: seg_fwd(d, nondiff_arrays),
+                                 diff_arrays)
+                (d,) = vjp(cot_tree)
+                return tuple(d)
+
+            pair = (jax.jit(seg_fwd), jax.jit(seg_bwd))
+            self._exec_cache[gkey] = pair
+            if len(self._exec_cache) > _EXEC_CACHE_MAX:
+                self._exec_cache.popitem(last=False)
+        else:
+            self._exec_cache.move_to_end(gkey)
+            self.stats["cache_hits"] += 1
+
+        fwd_jit, bwd_jit = pair
+        diff_arrays = [self.inputs[i] for i in diff_idx]
+        results = fwd_jit(diff_arrays, nondiff)
+
+        flat, treedef = jax.tree_util.tree_flatten(results)
+        avals = [(o.shape, o.dtype) for o in flat]
+
+        def vjp_fn(cot_tree, _b=bwd_jit, _d=diff_arrays, _n=nondiff):
+            return _b(_d, _n, cot_tree)
+
+        # pure_fn=None: create_graph double-backward through a segment
+        # raises with the tape's explanatory error
+        node = _tape.GradNode("jit_segment", vjp_fn, diff_tensors, avals,
+                              treedef, pure_fn=None)
+        # attach the node to every still-alive output Tensor; _out_index
+        # is the global flat position across the segment's ops
+        flat_pos = 0
+        for op_out, trefs in zip(results, self._out_tensors):
+            for j in range(len(op_out)):
+                t = trefs[j]() if j < len(trefs) else None
+                if t is not None and not t.stop_gradient:
+                    t._node = node
+                    t._out_index = flat_pos
+                flat_pos += 1
+        self.stats["grad_segments"] += 1
+        return results
 
     def _reset_inputs(self):
         self.inputs = []
         self._input_ids = {}
+        self._diff_pos = {}
 
     # ------------------------------------------------------------ scope --
     @contextmanager

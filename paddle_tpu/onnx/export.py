@@ -31,14 +31,26 @@ _OPSET = 13
 
 # TensorProto.DataType
 _F32 = 1
+_I32 = 6
 _I64 = 7
 
 
+def _elem_type(dtype) -> int:
+    dt = np.dtype(dtype)
+    if dt == np.int64:
+        return _I64
+    if dt in (np.int32, np.int16, np.int8, np.uint8):
+        return _I32
+    return _F32
+
+
 def _tensor_proto(name: str, arr: np.ndarray) -> bytes:
-    arr = np.ascontiguousarray(arr)
-    dt = _F32 if arr.dtype != np.int64 else _I64
-    if dt == _F32:
-        arr = arr.astype(np.float32)
+    arr = np.asarray(arr)
+    if arr.ndim:  # ascontiguousarray PROMOTES 0-d to 1-d
+        arr = np.ascontiguousarray(arr)
+    dt = _elem_type(arr.dtype)
+    arr = arr.astype({_F32: np.float32, _I32: np.int32,
+                      _I64: np.int64}[dt])
     msg = b"".join([
         *(P.field_varint(1, int(d)) for d in arr.shape),   # dims
         P.field_varint(2, dt),                             # data_type
@@ -116,11 +128,36 @@ def _onnx_pads(pa):
 
 
 class _Emitter:
-    def __init__(self):
+    def __init__(self, names=None, traced_ids=None):
         self.nodes: List[bytes] = []
         self.inits: List[bytes] = []
         self.counter = 0
         self.min_opset = 7
+        # id(tensor) -> graph value name, and the set of ids PRODUCED
+        # during the trace (an unnamed produced tensor aborts export;
+        # a tensor predating the trace is a genuine initializer)
+        self.names = names if names is not None else {}
+        self.traced_ids = traced_ids if traced_ids is not None else set()
+
+    def in_name(self, v, out_t=None) -> Optional[str]:
+        """Graph name for an op input: a traced name, a baked
+        initializer for pre-trace constants (dtype-faithful — an int32
+        ids tensor must not become a float32 initializer), or None when
+        the value is an unnamed traced intermediate."""
+        from ..core.tensor import Tensor
+        if isinstance(v, Tensor):
+            nm = self.names.get(id(v))
+            if nm is not None:
+                return nm
+            if id(v) in self.traced_ids:
+                return None
+            return self.add_init("const", np.asarray(v.data))
+        dt = (np.dtype(str(out_t.dtype).split(".")[-1])
+              if out_t is not None and hasattr(out_t, "dtype")
+              else np.float32)
+        if np.issubdtype(dt, np.integer):
+            dt = np.int64 if dt == np.int64 else np.int32
+        return self.add_init("const", np.asarray(v, dt))
 
     def tname(self, base):
         self.counter += 1
@@ -176,6 +213,27 @@ class _Emitter:
             self.nodes.append(_node(
                 "BatchNormalization", [x_name, scale, bias, mean, var],
                 [out], [_attr_float("epsilon", float(layer.epsilon))]))
+            return out
+        if isinstance(layer, nn.Embedding):
+            w = self.add_init("embed", np.asarray(layer.weight.data))
+            self.nodes.append(_node("Gather", [w, x_name], [out],
+                                    [_attr_int("axis", 0)]))
+            return out
+        if isinstance(layer, nn.LayerNorm):
+            if len(layer.normalized_shape) != 1:
+                return None  # multi-axis norm: StableHLO path
+            nf = layer.normalized_shape[0]
+            scale = self.add_init(
+                "scale", np.asarray(layer.weight.data)
+                if layer.weight is not None else np.ones(nf, np.float32))
+            bias = self.add_init(
+                "b", np.asarray(layer.bias.data)
+                if layer.bias is not None else np.zeros(nf, np.float32))
+            self.nodes.append(_node(
+                "LayerNormalization", [x_name, scale, bias], [out],
+                [_attr_int("axis", -1),
+                 _attr_float("epsilon", float(layer.epsilon))]))
+            self.min_opset = max(self.min_opset, 17)
             return out
         simple = {"ReLU": "Relu", "Sigmoid": "Sigmoid", "Tanh": "Tanh",
                   "Hardswish": "HardSwish", "Hardsigmoid": "HardSigmoid"}
@@ -245,31 +303,26 @@ class _Emitter:
     _ELTWISE = {"add": "Add", "subtract": "Sub", "multiply": "Mul",
                 "divide": "Div"}
 
-    def emit_functional(self, opname, args, kwargs, out_t, names,
-                        traced_ids):
-        """Emit a node for a FUNCTIONAL registry op recorded between
-        layer calls (the residual add / flatten(1) glue in forward()
-        bodies — what makes branchy graphs like ResNet exportable).
-        Returns the output name, or None when unsupported.
+    def _n(self, op_type, inputs, base, attrs=()):
+        o = self.tname(base)
+        self.nodes.append(_node(op_type, inputs, [o], list(attrs)))
+        return o
 
-        ``traced_ids``: ids of every tensor PRODUCED during the trace.
-        A produced-but-unnamed tensor (e.g. an element of a tuple
-        output) must abort the export — baking it as a constant would
-        freeze a zeros-derived activation into the model. Tensors that
-        predate the trace (user constants) are genuine initializers.
+    def emit_functional(self, opname, args, kwargs, out_t):
+        """Emit node(s) for a FUNCTIONAL registry op recorded between
+        layer calls — the residual add / flatten(1) glue plus the
+        transformer set (matmul, softmax, transpose, reshape, gelu/erf,
+        getitem, scaled_dot_product_attention) that makes the in-repo
+        ERNIE encoder export as real ONNX. Returns the output name, or
+        None when unsupported (the caller falls back to StableHLO).
+
+        Unnamed traced intermediates (see in_name) abort the export —
+        baking them would freeze a zeros-derived activation into the
+        model. Tensors predating the trace are genuine initializers.
         """
         from ..core.tensor import Tensor
 
-        def in_name(v):
-            if isinstance(v, Tensor):
-                nm = names.get(id(v))
-                if nm is not None:
-                    return nm
-                if id(v) in traced_ids:
-                    return None  # un-named intermediate: not exportable
-                return self.add_init("const", np.asarray(v.data))
-            return self.add_init("const", np.asarray(v, np.float32))
-
+        in_name = lambda v: self.in_name(v, out_t)
         o = self.tname(opname)
         if opname in self._ELTWISE:
             an, bn = in_name(args[0]), in_name(args[1])
@@ -283,6 +336,95 @@ class _Emitter:
                 return None
             self.nodes.append(_node("Relu", [an], [o]))
             return o
+        if opname == "erf":
+            an = in_name(args[0])
+            if an is None:
+                return None
+            self.nodes.append(_node("Erf", [an], [o]))
+            self.min_opset = max(self.min_opset, 9)
+            return o
+        if opname == "matmul":
+            if kwargs.get("transpose_x") or kwargs.get("transpose_y"):
+                return None
+            an, bn = in_name(args[0]), in_name(args[1])
+            if an is None or bn is None:
+                return None
+            self.nodes.append(_node("MatMul", [an, bn], [o]))
+            return o
+        if opname == "softmax":
+            an = in_name(args[0])
+            if an is None:
+                return None
+            axis = kwargs.get("axis", args[1] if len(args) > 1 else -1)
+            self.nodes.append(_node("Softmax", [an], [o],
+                                    [_attr_int("axis", int(axis))]))
+            self.min_opset = max(self.min_opset, 13)
+            return o
+        if opname == "transpose":
+            perm = kwargs.get("perm", args[1] if len(args) > 1 else None)
+            an = in_name(args[0])
+            if an is None or perm is None:
+                return None
+            self.nodes.append(_node(
+                "Transpose", [an], [o],
+                [_attr_ints("perm", [int(p) for p in perm])]))
+            return o
+        if opname == "gelu":
+            an = in_name(args[0])
+            if an is None:
+                return None
+            approx = kwargs.get("approximate",
+                                args[1] if len(args) > 1 else False)
+            if approx:
+                # 0.5 x (1 + tanh(sqrt(2/pi) (x + 0.044715 x^3)))
+                c = lambda v: self.add_init("c", np.float32(v))
+                x3 = self._n("Mul", [an, self._n(
+                    "Mul", [an, an], "x2")], "x3")
+                inner = self._n("Add", [an, self._n(
+                    "Mul", [x3, c(0.044715)], "sx3")], "inner")
+                th = self._n("Tanh", [self._n(
+                    "Mul", [inner, c(float(np.sqrt(2.0 / np.pi)))],
+                    "si")], "th")
+                one = self._n("Add", [th, c(1.0)], "one_p")
+                half = self._n("Mul", [an, c(0.5)], "halfx")
+                self.nodes.append(_node("Mul", [half, one], [o]))
+                return o
+            # exact: 0.5 x (1 + erf(x / sqrt(2)))
+            c = lambda v: self.add_init("c", np.float32(v))
+            er = self._n("Erf", [self._n(
+                "Div", [an, c(float(np.sqrt(2.0)))], "xs")], "erf")
+            one = self._n("Add", [er, c(1.0)], "one_p")
+            half = self._n("Mul", [an, c(0.5)], "halfx")
+            self.nodes.append(_node("Mul", [half, one], [o]))
+            self.min_opset = max(self.min_opset, 9)
+            return o
+        if opname == "getitem":
+            # single integer index on one axis (seq[:, 0] pooling):
+            # Gather with a scalar index drops that axis, like numpy
+            src = args[0]
+            key = kwargs.get("key", args[1] if len(args) > 1 else None)
+            if not isinstance(src, Tensor) or key is None:
+                return None
+            key = key if isinstance(key, tuple) else (key,)
+            ints = [(i, k) for i, k in enumerate(key)
+                    if isinstance(k, int)]
+            full = all(isinstance(k, int)
+                       or (isinstance(k, slice)
+                           and k == slice(None, None, None))
+                       for k in key)
+            if len(ints) != 1 or not full:
+                return None
+            an = in_name(src)
+            if an is None:
+                return None
+            axis, idx = ints[0]
+            gi = self.add_init("idx", np.asarray(idx, np.int64))
+            self.nodes.append(_node("Gather", [an, gi], [o],
+                                    [_attr_int("axis", axis)]))
+            self.min_opset = max(self.min_opset, 13)  # negative indices
+            return o
+        if opname == "scaled_dot_product_attention_ref":
+            return self._emit_sdpa(args, kwargs, out_t, o)
         if opname in ("flatten", "reshape"):
             # static re-shape with a dynamic batch: Reshape with 0 in
             # dim 0 (ONNX: copy the input's dim) — only valid when the
@@ -301,6 +443,47 @@ class _Emitter:
             self.nodes.append(_node("Reshape", [an, shp], [o]))
             return o
         return None
+
+    def _emit_sdpa(self, args, kwargs, out_t, o):
+        """scaled_dot_product_attention as an ONNX subgraph:
+        Transpose -> MatMul -> Mul(scale) [-> Add(bias)] -> Softmax ->
+        MatMul -> Transpose (inputs/outputs [B, T, H, Dh])."""
+        q, k, v = args[0], args[1], args[2]
+        attn_mask = kwargs.get("attn_mask",
+                               args[3] if len(args) > 3 else None)
+        is_causal = kwargs.get("is_causal",
+                               args[5] if len(args) > 5 else False)
+        if is_causal:
+            return None  # causal mask: StableHLO path
+        qn, kn, vn = (self.in_name(a, out_t) for a in (q, k, v))
+        if qn is None or kn is None or vn is None:
+            return None
+        scale = kwargs.get("scale", args[6] if len(args) > 6 else None)
+        if scale is None:
+            scale = 1.0 / float(np.sqrt(q.shape[-1]))
+        tp = lambda nm, perm: self._n(
+            "Transpose", [nm], "tr", [_attr_ints("perm", perm)])
+        qt = tp(qn, (0, 2, 1, 3))
+        kt = tp(kn, (0, 2, 3, 1))
+        vt = tp(vn, (0, 2, 1, 3))
+        sc = self._n("Mul", [self._n("MatMul", [qt, kt], "qk"),
+                             self.add_init("scale", np.float32(scale))],
+                     "scaled")
+        cur = sc
+        if attn_mask is not None:
+            if getattr(getattr(attn_mask, "data", attn_mask), "dtype",
+                       None) == np.bool_:
+                return None  # boolean mask (where-select): fall back
+            mn = self.in_name(attn_mask, out_t)
+            if mn is None:
+                return None
+            cur = self._n("Add", [cur, mn], "biased")
+        sm = self._n("Softmax", [cur], "probs", [_attr_int("axis", -1)])
+        self.min_opset = max(self.min_opset, 13)
+        av = self._n("MatMul", [sm, vt], "attn")
+        self.nodes.append(_node("Transpose", [av], [o],
+                                [_attr_ints("perm", (0, 2, 1, 3))]))
+        return o
 
 
 def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
@@ -330,22 +513,26 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
     import jax.numpy as jnp
     from ..core.tensor import Tensor
     from ..core.graph_trace import trace_layer_graph
-    x = Tensor(jnp.zeros(tuple(shape), jnp.float32))
+    in_dtype = jnp.dtype(str(getattr(spec, "dtype", "float32")))
+    x = Tensor(jnp.zeros(tuple(shape), in_dtype))
     tr = trace_layer_graph(layer, x)
     events, traced_ids, y = tr.events, tr.traced_ids, tr.y
 
-    em = _Emitter()
-    out_name = "input"
     obj_to_name = {id(x): "input"}
+    em = _Emitter(names=obj_to_name, traced_ids=traced_ids)
+    out_name = "input"
     supported = bool(events)
     for ev in events:
         if ev[0] == "layer":
             _, l, inputs, output = ev
             src = inputs[0] if isinstance(inputs, tuple) else inputs
-            if id(src) not in obj_to_name:
-                supported = False  # layer fed by something untraced
+            # in_name also bakes PRE-trace constants (e.g. position ids
+            # an embedding layer consumes) as initializers
+            x_name = em.in_name(src)
+            if x_name is None:
+                supported = False  # layer fed by an unnamed traced value
                 break
-            nm = em.emit(l, obj_to_name[id(src)])
+            nm = em.emit(l, x_name)
             if nm is None:
                 supported = False
                 break
@@ -353,8 +540,7 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
             out_name = nm
         else:
             _, opname, args, kwargs, out = ev
-            nm = em.emit_functional(opname, args, kwargs, out,
-                                    obj_to_name, traced_ids)
+            nm = em.emit_functional(opname, args, kwargs, out)
             if nm is None:
                 supported = False
                 break
@@ -380,10 +566,12 @@ def export(layer, path: str, input_spec=None, opset_version: int = _OPSET,
         *(P.field_message(1, n) for n in em.nodes),
         P.field_string(2, type(layer).__name__),
         *(P.field_message(5, t) for t in em.inits),
-        P.field_message(11, _value_info("input", decl_shape)),
+        P.field_message(11, _value_info("input", decl_shape,
+                                        _elem_type(str(in_dtype)))),
         P.field_message(12, _value_info(
             out_name, [None if decl_shape[0] is None and i == 0 else int(d)
-                       for i, d in enumerate(np.shape(y.data))])),
+                       for i, d in enumerate(np.shape(y.data))],
+            _elem_type(str(y.data.dtype)))),
     ])
     final_opset = max(opset_version, em.min_opset)
     opset = P.field_string(1, "") + P.field_varint(2, final_opset)

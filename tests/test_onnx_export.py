@@ -161,17 +161,160 @@ def test_export_resnet_residual_graph(tmp_path):
 
 
 def test_export_truly_unsupported_still_falls_back(tmp_path):
-    # an op with no ONNX mapping (erf via GELU-free path) keeps the
-    # StableHLO fallback contract
+    # an op with no ONNX mapping keeps the StableHLO fallback contract
+    # (erf graduated to a real mapping in r5; cumsum has none)
     class Odd(pt.nn.Layer):
         def __init__(self):
             super().__init__()
             self.fc = pt.nn.Linear(4, 4)
 
         def forward(self, x):
-            return pt.erf(self.fc(x))
+            return pt.cumsum(self.fc(x), axis=1)
 
     with pytest.warns(UserWarning):
         out = pt.onnx.export(Odd(), str(tmp_path / "odd"),
                              input_spec=[InputSpec([1, 4])])
     assert out.endswith(".pdmodel")
+
+
+# ---------------------------------------------------------------------------
+# r5: transformer op set — the in-repo ERNIE encoder as REAL ONNX
+# (VERDICT r4 #7). Validation: re-parse the wire format and EXECUTE the
+# graph with a minimal numpy interpreter, comparing against the jax
+# forward on the traced input.
+# ---------------------------------------------------------------------------
+
+def _signed(v):
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _parse_attrs(node_bytes):
+    import struct
+    attrs = {}
+    for a in P.fields(node_bytes, 5):
+        name = P.fields(a, 1)[0].decode()
+        ints = P.fields(a, 8)
+        if ints:
+            attrs[name] = [_signed(int(v)) for v in ints]
+            continue
+        i = P.fields(a, 3)
+        if i:
+            attrs[name] = _signed(int(i[0]))
+            continue
+        f = [v for n_, w_, v in P.parse(a) if n_ == 2 and w_ == 5]
+        if f:
+            attrs[name] = struct.unpack("<f", f[0])[0]
+    return attrs
+
+
+_NP_DT = {1: np.float32, 6: np.int32, 7: np.int64}
+
+
+def _load_inits(graph):
+    env = {}
+    for t in P.fields(graph, 5):
+        name = P.fields(t, 8)[0].decode()
+        dims = [int(v) for n_, w_, v in P.parse(t) if n_ == 1 and w_ == 0]
+        dt = _NP_DT[int(P.fields(t, 2)[0])]
+        env[name] = np.frombuffer(P.fields(t, 9)[0], dt).reshape(dims)
+    return env
+
+
+def _run_onnx(model_bytes, input_arr):
+    """Minimal numpy interpreter for the emitted op set."""
+    from math import erf
+    graph = P.fields(model_bytes, 7)[0]
+    env = _load_inits(graph)
+    env[P.fields(P.fields(graph, 11)[0], 1)[0].decode()] = input_arr
+    verf = np.vectorize(erf)
+    for nb in P.fields(graph, 1):
+        ins = [env[i.decode()] for i in P.fields(nb, 1)]
+        (out_name,) = [o.decode() for o in P.fields(nb, 2)]
+        op = P.fields(nb, 4)[0].decode()
+        at = _parse_attrs(nb)
+        if op == "Gemm":
+            r = ins[0] @ ins[1] + (ins[2] if len(ins) > 2 else 0)
+        elif op == "Gather":
+            r = np.take(ins[0], ins[1], axis=at.get("axis", 0))
+        elif op == "LayerNormalization":
+            x, sc, b = ins
+            mu = x.mean(-1, keepdims=True)
+            var = x.var(-1, keepdims=True)
+            r = (x - mu) / np.sqrt(var + at["epsilon"]) * sc + b
+        elif op == "MatMul":
+            r = ins[0] @ ins[1]
+        elif op == "Transpose":
+            r = np.transpose(ins[0], at["perm"])
+        elif op == "Softmax":
+            x = ins[0]
+            m = x.max(at.get("axis", -1), keepdims=True)
+            e = np.exp(x - m)
+            r = e / e.sum(at.get("axis", -1), keepdims=True)
+        elif op in ("Mul", "Add", "Div", "Sub"):
+            f = {"Mul": np.multiply, "Add": np.add,
+                 "Div": np.divide, "Sub": np.subtract}[op]
+            r = f(ins[0], ins[1])
+        elif op == "Erf":
+            r = verf(ins[0]).astype(np.float32)
+        elif op == "Tanh":
+            r = np.tanh(ins[0])
+        elif op == "Relu":
+            r = np.maximum(ins[0], 0)
+        elif op == "Identity":
+            r = ins[0]
+        elif op == "Reshape":
+            tgt = [ins[0].shape[i] if d == 0 else d
+                   for i, d in enumerate(ins[1])]
+            r = ins[0].reshape(tgt)
+        else:
+            raise AssertionError(f"interpreter missing op {op}")
+        env[out_name] = r
+    out_vi = P.fields(graph, 12)[0]
+    return env[P.fields(out_vi, 1)[0].decode()]
+
+
+def test_export_ernie_encoder_real_onnx(tmp_path):
+    """The ERNIE classification model (embeddings -> transformer encoder
+    -> pooler -> head) exports as REAL ONNX and the emitted graph
+    reproduces the jax forward numerically."""
+    from paddle_tpu.models.ernie import (ErnieConfig, ErnieModel,
+                                         ErnieForSequenceClassification)
+    pt.seed(0)
+    cfg = ErnieConfig.tiny(num_hidden_layers=2)
+    m = ErnieForSequenceClassification(ErnieModel(cfg), num_classes=3)
+    m.eval()
+    out = pt.onnx.export(m, str(tmp_path / "ernie"),
+                         input_spec=[InputSpec([1, 8], dtype="int32")])
+    assert out.endswith(".onnx"), "fell back to StableHLO"
+    blob = open(out, "rb").read()
+    ops = _op_types(blob)
+    for needed in ("Gather", "LayerNormalization", "MatMul", "Softmax",
+                   "Transpose", "Erf", "Gemm"):
+        assert needed in ops, (needed, ops)
+    # numeric spot-check on the traced input (zeros ids)
+    ids = np.zeros((1, 8), np.int32)
+    got = _run_onnx(blob, ids)
+    import jax.numpy as jnp
+    from paddle_tpu.core.tensor import Tensor
+    want = np.asarray(m(Tensor(jnp.asarray(ids))).data)
+    np.testing.assert_allclose(got, want, atol=2e-4)
+
+
+def test_export_int_scalar_const_dtype(tmp_path):
+    """ADVICE r4 (low): an integer elementwise constant must emit with
+    the tensor's dtype, not float32."""
+    class AddOne(pt.nn.Layer):
+        def forward(self, x):
+            return x + 1
+
+    m = pt.nn.Sequential()
+    net = AddOne()
+    out = pt.onnx.export(net, str(tmp_path / "addone"),
+                         input_spec=[InputSpec([2, 3], dtype="int32")])
+    if out.endswith(".onnx"):
+        blob = open(out, "rb").read()
+        graph = P.fields(blob, 7)[0]
+        env = _load_inits(graph)
+        assert all(v.dtype != np.float32 for v in env.values()), env
+        got = _run_onnx(blob, np.ones((2, 3), np.int32))
+        np.testing.assert_array_equal(got, 2 * np.ones((2, 3)))

@@ -92,9 +92,10 @@ def test_full_graph_true_still_raises():
         f(t([1.0]))
 
 
-def test_grad_path_falls_back_to_eager_tape():
-    # segment capture is a no-grad facility; training through the
-    # function must keep working via the wholesale eager fallback
+def test_grad_path_trains_correctly():
+    # r4: training fell back to wholesale eager; r5: grad-wanted ops
+    # record into tape-aware segments — either way the grads must be
+    # exactly d(2x^2)/dx
     @pt.jit.to_static(full_graph=False)
     def f(x):
         h = x * x
@@ -133,3 +134,89 @@ def test_shape_metadata_does_not_flush():
     stats = f.graph_break_stats
     assert stats["segments"] >= 1
     assert stats["ops_recorded"] >= 2
+
+
+# ---------------------------------------------------------------------------
+# r5: training THROUGH graph breaks (tape-aware segments, VERDICT r4 #4)
+# ---------------------------------------------------------------------------
+
+def test_training_records_segments_and_matches_eager_grads():
+    """Grad-wanted ops record into compiled segments; each flush is ONE
+    GradNode (backward = jax.vjp of the segment). Reference: SOT compiles
+    training subgraphs (jit/sot/translate.py:99)."""
+    def body(x, w):
+        h = _chain(x * w, 4)
+        if float(h.sum()) > -1e9:            # GRAPH BREAK
+            h = h * 2.0
+        return _chain(h, 4)
+
+    f = pt.jit.to_static(body, full_graph=False)
+    x = t([0.5, 1.0])
+    w = pt.to_tensor(np.asarray([1.5], np.float32), stop_gradient=False)
+    out = f(x, w)
+    out.sum().backward()
+    stats = f.graph_break_stats
+    total = stats["ops_recorded"] + stats["ops_eager"]
+    assert stats["ops_recorded"] / total >= 0.8, stats
+    assert stats["grad_segments"] >= 2, stats
+
+    # eager reference: same math, no segmenting
+    w2 = pt.to_tensor(np.asarray([1.5], np.float32), stop_gradient=False)
+    ref = body(x, w2)
+    ref.sum().backward()
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
+    np.testing.assert_allclose(w.grad.numpy(), w2.grad.numpy(), rtol=1e-5)
+
+
+def test_training_through_break_loss_falls():
+    """A Layer with a data-dependent break actually TRAINS segmented."""
+    class Net(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = pt.nn.Linear(8, 16)
+            self.fc2 = pt.nn.Linear(16, 8)
+
+        def forward(self, x):
+            h = pt.tanh(self.fc1(x))
+            if float(h.mean()) > -1e9:       # GRAPH BREAK
+                h = h * 1.0
+            return self.fc2(h)
+
+    pt.seed(0)
+    net = pt.jit.to_static(Net(), full_graph=False)
+    opt = pt.optimizer.AdamW(learning_rate=5e-3,
+                             parameters=net.parameters())
+    rng = np.random.RandomState(0)
+    x = pt.to_tensor(rng.randn(16, 8).astype(np.float32))
+    y = pt.to_tensor(np.tanh(rng.randn(16, 8)).astype(np.float32))
+    losses = []
+    for _ in range(12):
+        out = net(x)
+        loss = ((out - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    assert losses[-1] < losses[0] * 0.7, losses
+    stats = net.forward.graph_break_stats
+    total = stats["ops_recorded"] + stats["ops_eager"]
+    assert stats["ops_recorded"] / total >= 0.8, stats
+    assert stats["grad_segments"] > 0, stats
+    # steady state reuses the compiled grad segments
+    assert stats["cache_hits"] > 0, stats
+
+
+def test_segment_create_graph_raises_clearly():
+    def body(x, w):
+        h = x * w
+        if float(h.sum()) > -1e9:
+            h = h * 2.0
+        return h
+
+    f = pt.jit.to_static(body, full_graph=False)
+    x = t([1.0])
+    w = pt.to_tensor(np.asarray([2.0], np.float32), stop_gradient=False)
+    out = f(x, w)
+    with pytest.raises((NotImplementedError, RuntimeError)):
+        g = pt.autograd.grad(out.sum(), [w], create_graph=True)
+        g[0].sum().backward()
