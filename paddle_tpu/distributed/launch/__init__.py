@@ -76,17 +76,13 @@ def launch(args: Optional[List[str]] = None) -> int:
     with a fresh coordinator (the elastic-manager restart loop,
     fleet/elastic/manager.py:125 — scoped to whole-job restarts: TPU
     SPMD cannot continue with a partial world the way parameter-server
-    jobs can)."""
+    jobs can). Multi-node jobs agree on restarts through a
+    rendezvous-generation counter on the native TCPStore
+    (_launch_elastic_multinode)."""
     ns = build_parser().parse_args(args)
     attempts = max(int(getattr(ns, "max_restarts", 0)), 0) + 1
     if attempts > 1 and ns.nnodes > 1:
-        # per-node restart loops cannot agree on attempt numbers or
-        # coordinator lifetime without a cross-node rendezvous; restarts
-        # of multi-node jobs belong to the cluster scheduler
-        raise SystemExit(
-            "--max_restarts only supports single-node jobs; multi-node "
-            "elastic restart must come from the job scheduler "
-            "(k8s/GKE restart policy)")
+        return _launch_elastic_multinode(ns, attempts)
     rc = 1
     for attempt in range(attempts):
         rc = _launch_once(ns, attempt)
@@ -100,9 +96,60 @@ def launch(args: Optional[List[str]] = None) -> int:
     return rc
 
 
-def _launch_once(ns, attempt: int = 0) -> int:
+def _launch_elastic_multinode(ns, attempts: int) -> int:
+    """Multi-node elastic restart (reference: the etcd-leased elastic
+    manager, fleet/elastic/manager.py:125,218 — restart events agreed
+    across nodes; scale events remain out of scope, the world size is
+    fixed).
+
+    Every launcher joins a TCPStore rendezvous hosted by node 0 at
+    ``master_port + 1``. Per GENERATION g: launchers barrier on
+    ``elastic_go_<g>``, spawn workers against a generation-specific
+    coordinator (``master_port + 2 + g`` — the dead coordinator's socket
+    may linger), and watch both their children and the shared
+    ``elastic_fail_<g>`` counter. Any worker death anywhere flags the
+    counter; every launcher then tears down its local workers and joins
+    the next generation, whose workers resume from the newest checkpoint
+    via PADDLE_RESTART_ATTEMPT / load_latest_checkpoint.
+    """
+    from ..store import TCPStore
+    if ns.master is None:
+        raise SystemExit("--master host:port is required for multi-node "
+                         "jobs")
+    host, _, port_s = ns.master.partition(":")
+    port = int(port_s)
+    store = TCPStore(host, port + 1, is_master=(ns.node_rank == 0),
+                     world_size=ns.nnodes, timeout=60.0)
+    rc = 1
+    try:
+        for gen in range(attempts):
+            # all launchers check in before any worker of generation g
+            # starts (a straggler joining a dead generation would hang
+            # on its coordinator)
+            n = store.add(f"elastic_ready_{gen}", 1)
+            if n == ns.nnodes:
+                store.set(f"elastic_go_{gen}", b"1")
+            store.wait(f"elastic_go_{gen}")
+            coord = f"{host}:{port + 2 + gen}"
+            rc = _launch_once(ns, gen, master_override=coord,
+                              store=store, gen=gen)
+            if rc == 0 or rc == 130:
+                return rc
+            if gen + 1 < attempts:
+                print(f"[paddle_tpu launch] node {ns.node_rank}: "
+                      f"generation {gen} failed (exit {rc}); "
+                      f"rejoining rendezvous "
+                      f"({attempts - gen - 1} retries left)",
+                      file=sys.stderr)
+        return rc
+    finally:
+        store.close()
+
+
+def _launch_once(ns, attempt: int = 0, master_override: Optional[str]
+                 = None, store=None, gen: int = 0) -> int:
     world = ns.nnodes * ns.nproc
-    master = ns.master
+    master = master_override or ns.master
     if master is None:
         if ns.nnodes > 1:
             raise SystemExit("--master host:port is required for "
@@ -148,15 +195,29 @@ def _launch_once(ns, attempt: int = 0) -> int:
             [sys.executable, "-u", ns.script, *ns.script_args],
             env=env, stdout=out, stderr=out))
 
-    rc = _watch(procs)
+    rc = _watch(procs, store=store, gen=gen)
     for f in logs:
         f.close()
     return rc
 
 
-def _watch(procs: List[subprocess.Popen]) -> int:
+def _kill_all(procs: List[subprocess.Popen]) -> None:
+    for q in procs:
+        if q.poll() is None:
+            q.terminate()
+    deadline = time.time() + 10
+    for q in procs:
+        try:
+            q.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            q.kill()
+
+
+def _watch(procs: List[subprocess.Popen], store=None, gen: int = 0) -> int:
     """Failure propagation (reference launch watchdog): first non-zero
-    exit kills every other worker and becomes the job's exit code."""
+    exit kills every other local worker and becomes the job's exit code.
+    With a rendezvous ``store``, failures also propagate ACROSS nodes
+    through the ``elastic_fail_<gen>`` counter."""
     try:
         while True:
             alive = False
@@ -165,19 +226,25 @@ def _watch(procs: List[subprocess.Popen]) -> int:
                 if code is None:
                     alive = True
                 elif code != 0:
-                    for q in procs:
-                        if q.poll() is None:
-                            q.terminate()
-                    deadline = time.time() + 10
-                    for q in procs:
+                    if store is not None:
                         try:
-                            q.wait(timeout=max(0.1,
-                                               deadline - time.time()))
-                        except subprocess.TimeoutExpired:
-                            q.kill()
+                            store.add(f"elastic_fail_{gen}", 1)
+                        except Exception:
+                            pass  # master launcher gone: local teardown
+                    _kill_all(procs)
                     return code
             if not alive:
                 return 0
+            if store is not None:
+                try:
+                    failed = store.add(f"elastic_fail_{gen}", 0) > 0
+                except Exception:
+                    failed = False
+                if failed:
+                    # a REMOTE worker died: tear down this node's
+                    # workers and rejoin the rendezvous
+                    _kill_all(procs)
+                    return 1
             time.sleep(0.2)
     except KeyboardInterrupt:
         for q in procs:

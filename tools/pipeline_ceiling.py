@@ -1,0 +1,81 @@
+"""Measure the lockstep 1F1B pipeline ceiling at north-star scale.
+
+VERDICT r4 #9: put a number on what the lockstep traced schedule costs
+at pp∈{2,4,8} × M∈{8,16,32} vs the reference's interleaved-1F1B
+analytic bubble. The measurement is structural (the r4-established
+method): trace the ACTUAL train step on the CPU mesh and read the
+schedule scan's trip count out of the jaxpr — every tick executes all
+slots, so measured efficiency = M / ticks. The reference comparison is
+the interleaved-1F1B bubble fraction (S-1)/(V*M + S - 1)
+(pipeline_parallel.py forward_backward_pipeline, VPP chunks V).
+
+Run: python tools/pipeline_ceiling.py   (prints a markdown table)
+"""
+import os
+import sys
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def _scan_lengths(jaxpr, out):
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            out.add(int(eqn.params["length"]))
+        for v in eqn.params.values():
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None:
+                _scan_lengths(inner, out)
+            if isinstance(v, (list, tuple)):
+                for w in v:
+                    inner = getattr(w, "jaxpr", None)
+                    if inner is not None:
+                        _scan_lengths(inner, out)
+    return out
+
+
+def measure(S, M):
+    from paddle_tpu.models import llama as L
+    from paddle_tpu.parallel import init_hybrid_mesh
+
+    cfg = L.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=8, num_attention_heads=4,
+        num_key_value_heads=2, max_position_embeddings=128,
+        dtype=jnp.float32, use_flash_attention=False, remat=False,
+        pp_stages=S, pp_schedule="1f1b", num_microbatches=M)
+    hm = init_hybrid_mesh(dp=1, pp=S, tp=1, set_global=False)
+    with hm.mesh:
+        step, init = L.make_train_step(cfg, hm.mesh)
+        state = init(jax.random.PRNGKey(0))
+        batch = L.make_batch(cfg, batch_size=M * 2, seq_len=16,
+                             mesh=hm.mesh)
+        jaxpr = jax.make_jaxpr(step.__wrapped__)(state, batch)
+    lengths = _scan_lengths(jaxpr.jaxpr, set())
+    ticks = M + 2 * S - 1
+    assert ticks in lengths, (S, M, sorted(lengths))
+    return ticks
+
+
+def main():
+    print("| pp | M | measured ticks | lockstep eff M/ticks | "
+          "ref 1F1B eff (V=1) | ref interleaved eff (V=2) |")
+    print("|---|---|---|---|---|---|")
+    for S in (2, 4, 8):
+        for M in (8, 16, 32):
+            ticks = measure(S, M)
+            lockstep = M / ticks
+            ref1 = 1 - (S - 1) / (M + S - 1)
+            refv = 1 - (S - 1) / (2 * M + S - 1)
+            print(f"| {S} | {M} | {ticks} | {lockstep:.3f} | "
+                  f"{ref1:.3f} | {refv:.3f} |")
+
+
+if __name__ == "__main__":
+    main()
