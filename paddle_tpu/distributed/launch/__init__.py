@@ -120,34 +120,58 @@ def _launch_elastic_multinode(ns, attempts: int) -> int:
     port = int(port_s)
     store = TCPStore(host, port + 1, is_master=(ns.node_rank == 0),
                      world_size=ns.nnodes, timeout=60.0)
+
+    def leave(rc):
+        # a permanently departing launcher (success, interrupt, retries
+        # exhausted) must say so, or peers would wait at the next
+        # rendezvous forever
+        try:
+            store.set("elastic_abort", str(rc).encode())
+        except Exception:
+            pass
+        return rc
+
+    def peer_left() -> bool:
+        try:
+            store.get("elastic_abort")
+            return True
+        except KeyError:
+            return False
+        except Exception:
+            return True  # master launcher (store host) gone
+
     rc = 1
     try:
         for gen in range(attempts):
-            # all launchers check in before any worker of generation g
-            # starts (a straggler joining a dead generation would hang
-            # on its coordinator)
-            n = store.add(f"elastic_ready_{gen}", 1)
-            if n == ns.nnodes:
-                store.set(f"elastic_go_{gen}", b"1")
-            store.wait(f"elastic_go_{gen}")
-            coord = f"{host}:{port + 2 + gen}"
-            rc = _launch_once(ns, gen, master_override=coord,
-                              store=store, gen=gen)
-            if rc == 0 or rc == 130:
+            if gen and peer_left():
+                print(f"[paddle_tpu launch] node {ns.node_rank}: a peer "
+                      "launcher left the job; not restarting",
+                      file=sys.stderr)
                 return rc
+            try:
+                # all launchers check in before any worker of generation
+                # g starts (a straggler joining a dead generation would
+                # hang on its coordinator)
+                store.barrier(f"elastic_{gen}")
+            except Exception:
+                return rc  # rendezvous store gone: master left
+            coord = f"{host}:{port + 2 + gen}"
+            rc = _launch_once(ns, gen, master_override=coord, store=store)
+            if rc == 0 or rc == 130:
+                return leave(rc)
             if gen + 1 < attempts:
                 print(f"[paddle_tpu launch] node {ns.node_rank}: "
                       f"generation {gen} failed (exit {rc}); "
                       f"rejoining rendezvous "
                       f"({attempts - gen - 1} retries left)",
                       file=sys.stderr)
-        return rc
+        return leave(rc)
     finally:
         store.close()
 
 
 def _launch_once(ns, attempt: int = 0, master_override: Optional[str]
-                 = None, store=None, gen: int = 0) -> int:
+                 = None, store=None) -> int:
     world = ns.nnodes * ns.nproc
     master = master_override or ns.master
     if master is None:
@@ -195,7 +219,7 @@ def _launch_once(ns, attempt: int = 0, master_override: Optional[str]
             [sys.executable, "-u", ns.script, *ns.script_args],
             env=env, stdout=out, stderr=out))
 
-    rc = _watch(procs, store=store, gen=gen)
+    rc = _watch(procs, store=store, gen=attempt)
     for f in logs:
         f.close()
     return rc

@@ -168,7 +168,16 @@ class TCPStore:
             if self._lib.pt_store_wait(self._cli, key.encode()) != 0:
                 raise ConnectionError("TCPStore wait failed")
         else:
-            _py_req(self._sock, 3, key)
+            # the client socket carries a short connect/req timeout;
+            # wait() blocks until the key EXISTS, which can legitimately
+            # take much longer (rendezvous skew) — honor the caller's
+            # timeout (None = indefinite) for this one request
+            old = self._sock.gettimeout()
+            self._sock.settimeout(timeout)
+            try:
+                _py_req(self._sock, 3, key)
+            finally:
+                self._sock.settimeout(old)
 
     # -- conveniences -------------------------------------------------------
     def barrier(self, name: str = "barrier") -> None:
