@@ -133,12 +133,36 @@ def _launch_elastic_multinode(ns, attempts: int) -> int:
 
     def peer_left() -> bool:
         try:
-            store.get("elastic_abort")
+            store.get("elastic_abort", decode=False)
             return True
         except KeyError:
             return False
         except Exception:
             return True  # master launcher (store host) gone
+
+    def join_generation(gen, timeout=600.0) -> bool:
+        """Check in for generation ``gen`` and POLL for the go signal —
+        a blocking barrier wait would hang forever on a peer that
+        departed after the peer_left() check (TOCTOU); polling re-checks
+        the abort key each tick and bounds the wait."""
+        try:
+            n = store.add(f"elastic_{gen}_in", 1)
+            if n == ns.nnodes:
+                store.set(f"elastic_{gen}_go", b"1")
+        except Exception:
+            return False  # store gone: master left
+        deadline = time.time() + timeout
+        while True:
+            try:
+                store.get(f"elastic_{gen}_go", decode=False)
+                return True
+            except KeyError:
+                pass
+            except Exception:
+                return False
+            if peer_left() or time.time() > deadline:
+                return False
+            time.sleep(0.5)
 
     rc = 1
     try:
@@ -147,14 +171,9 @@ def _launch_elastic_multinode(ns, attempts: int) -> int:
                 print(f"[paddle_tpu launch] node {ns.node_rank}: a peer "
                       "launcher left the job; not restarting",
                       file=sys.stderr)
-                return rc
-            try:
-                # all launchers check in before any worker of generation
-                # g starts (a straggler joining a dead generation would
-                # hang on its coordinator)
-                store.barrier(f"elastic_{gen}")
-            except Exception:
-                return rc  # rendezvous store gone: master left
+                return leave(rc)
+            if not join_generation(gen):
+                return leave(rc)
             coord = f"{host}:{port + 2 + gen}"
             rc = _launch_once(ns, gen, master_override=coord, store=store)
             if rc == 0 or rc == 130:

@@ -218,6 +218,18 @@ def _py_connect(ip, port, timeout):
             time.sleep(0.05)
 
 
+def _recv_exact(sock, n: int) -> bytes:
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(n - len(data))
+        if not chunk:
+            # peer closed: recv returns b'' forever — looping on it
+            # would busy-spin at 100% CPU instead of failing
+            raise ConnectionError("TCPStore connection closed by peer")
+        data += chunk
+    return data
+
+
 def _py_req(sock, op: int, key: str, payload: bytes = b"",
             raw_reply: int = 0) -> bytes:
     msg = bytes([op]) + struct.pack("<I", len(key)) + key.encode()
@@ -225,15 +237,8 @@ def _py_req(sock, op: int, key: str, payload: bytes = b"",
         msg += struct.pack("<I", len(payload)) + payload
     sock.sendall(msg)
     if raw_reply:
-        data = b""
-        while len(data) < raw_reply:
-            data += sock.recv(raw_reply - len(data))
-        return data
-    hdr = b""
-    while len(hdr) < 4:
-        hdr += sock.recv(4 - len(hdr))
+        return _recv_exact(sock, raw_reply)
+    hdr = _recv_exact(sock, 4)
     (n,) = struct.unpack("<I", hdr)
-    data = b""
-    while len(data) < n:
-        data += sock.recv(n - len(data))
+    data = _recv_exact(sock, n) if n else b""
     return data

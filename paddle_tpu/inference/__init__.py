@@ -224,7 +224,8 @@ class GenerationPredictor:
         return [out[i] for i in range(B)]
 
 
-from .passes import fold_batch_norms  # noqa: E402,F401  (IR-pass analogue)
+from .passes import (fold_batch_norms, remove_dropouts,  # noqa: E402,F401
+                     fuse_linear_chains)  # IR-pass analogues
 from .serving import DynamicBatcher  # noqa: E402,F401
 
 

@@ -28,7 +28,8 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 import jax.numpy as jnp
 
-__all__ = ["fold_batch_norms"]
+__all__ = ["fold_batch_norms", "remove_dropouts",
+           "fuse_linear_chains"]
 
 
 def _bn_affine(bn) -> Tuple[np.ndarray, np.ndarray]:
@@ -72,19 +73,10 @@ def _fold_into(prev, bn) -> bool:
     return True
 
 
-def fold_batch_norms(model, input_spec) -> int:
-    """Fold eval-mode BatchNorms into their dataflow-preceding
-    Conv/Linear layers; returns the number folded.
-
-    input_spec: one InputSpec (or plain shape list) for the tracing
-    forward — dims that are None/-1 trace as 1.
-    """
-    from .. import nn
-
-    if model.training:
-        raise ValueError(
-            "fold_batch_norms needs eval mode (model.eval()): folding "
-            "bakes the RUNNING statistics into the weights")
+def _trace_and_maps(model, input_spec):
+    """Shared pass plumbing: normalize the input spec, run the tracing
+    forward, and build the dataflow maps every rewrite pass needs.
+    Returns (trace, layer_events, produced_by, parent_of)."""
     spec = input_spec
     if isinstance(spec, (list, tuple)) and len(spec) and (
             hasattr(spec[0], "shape") or isinstance(spec[0], (list, tuple))):
@@ -103,14 +95,32 @@ def fold_batch_norms(model, input_spec) -> int:
         _, l, inputs, output = ev
         src = inputs[0] if isinstance(inputs, tuple) else inputs
         layer_events.append((l, id(src), id(output)))
-    consumers = tr.consumers
     produced_by = {out_id: l for l, _, out_id in layer_events}
 
-    # parent map so the folded bn can be replaced in its container
+    # parent map so a rewritten layer can be replaced in its container
     parent_of = {}
     for _, container in model.named_sublayers(include_self=True):
         for name, sub in getattr(container, "_sub_layers", {}).items():
             parent_of[id(sub)] = (container, name)
+    return tr, layer_events, produced_by, parent_of
+
+
+def fold_batch_norms(model, input_spec) -> int:
+    """Fold eval-mode BatchNorms into their dataflow-preceding
+    Conv/Linear layers; returns the number folded.
+
+    input_spec: one InputSpec (or plain shape list) for the tracing
+    forward — dims that are None/-1 trace as 1.
+    """
+    from .. import nn
+
+    if model.training:
+        raise ValueError(
+            "fold_batch_norms needs eval mode (model.eval()): folding "
+            "bakes the RUNNING statistics into the weights")
+    tr, layer_events, produced_by, parent_of = _trace_and_maps(
+        model, input_spec)
+    consumers = tr.consumers
 
     foldable = (nn.Linear, nn.Conv1D, nn.Conv2D, nn.Conv3D)
     bns = (nn.BatchNorm, nn.BatchNorm1D, nn.BatchNorm2D, nn.BatchNorm3D)
@@ -139,3 +149,72 @@ def fold_batch_norms(model, input_spec) -> int:
             done.add(id(l))
             folded += 1
     return folded
+
+
+def remove_dropouts(model) -> int:
+    """Replace every Dropout layer with Identity for deployment
+    (reference: delete_dropout_op_pass / identity_op_clean_pass — the
+    other CNN/transformer deployment workhorse). Eval-mode dropout is
+    already an identity computationally; this removes the op from the
+    saved artifact and the traced graph entirely. Returns the count."""
+    from .. import nn
+    drops = (nn.Dropout, nn.Dropout2D, nn.Dropout3D, nn.AlphaDropout)
+    removed = 0
+    for _, container in model.named_sublayers(include_self=True):
+        subs = getattr(container, "_sub_layers", {})
+        for name, sub in list(subs.items()):
+            if isinstance(sub, drops):
+                subs[name] = nn.Identity()
+                removed += 1
+    return removed
+
+
+def fuse_linear_chains(model, input_spec) -> int:
+    """Fuse dataflow-adjacent Linear->Linear pairs into one Linear:
+    ``W = W1 @ W2``, ``b = b1 @ W2 + b2`` (reference: fc_fuse_pass
+    family — adjacent affine ops collapse; LoRA-merged heads and
+    factorized projections are where this fires in practice).
+
+    Same dataflow verification as fold_batch_norms: the first Linear's
+    output must feed ONLY the second, and both must run exactly once
+    in the trace. Returns the number of pairs fused."""
+    from .. import nn
+
+    fused = 0
+    while True:  # chains of 3+ fold pairwise until fixed point
+        tr, layer_events, produced_by, parent_of = _trace_and_maps(
+            model, input_spec)
+        did = False
+        for l, in_id, _ in layer_events:
+            if not isinstance(l, nn.Linear):
+                continue
+            prev = produced_by.get(in_id)
+            if (not isinstance(prev, nn.Linear) or prev is l
+                    or tr.layer_calls.get(id(prev)) != 1
+                    or tr.layer_calls.get(id(l)) != 1
+                    or tr.consumers.get(in_id, 0) != 1
+                    or id(prev) not in parent_of):
+                continue
+            w1 = np.asarray(prev.weight.data, np.float64)   # [in, mid]
+            w2 = np.asarray(l.weight.data, np.float64)      # [mid, out]
+            dtype = np.asarray(l.weight.data).dtype
+            w = w1 @ w2
+            b = (np.asarray(prev.bias.data, np.float64) @ w2
+                 if prev.bias is not None else 0.0)
+            if l.bias is not None:
+                b = b + np.asarray(l.bias.data, np.float64)
+            l.weight.data = jnp.asarray(w.astype(dtype))
+            has_b = (prev.bias is not None) or (l.bias is not None)
+            if has_b:
+                if l.bias is None:
+                    l.bias = l.create_parameter((w.shape[1],),
+                                                is_bias=True)
+                l.bias.data = jnp.asarray(
+                    np.broadcast_to(b, (w.shape[1],)).astype(dtype))
+            container, name = parent_of[id(prev)]
+            container._sub_layers[name] = nn.Identity()
+            fused += 1
+            did = True
+            break  # re-trace: ids/consumers are stale after a rewrite
+        if not did:
+            return fused

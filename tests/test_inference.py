@@ -285,3 +285,44 @@ def test_fold_batch_norms_refuses_dict_and_kwarg_consumers():
     before = k(x).numpy()
     assert fold_batch_norms(k, [(1, 3, 4, 4)]) == 0
     np.testing.assert_allclose(k(x).numpy(), before)
+
+
+def test_remove_dropouts_pass():
+    """reference: delete_dropout_op_pass — dropouts leave the artifact."""
+    from paddle_tpu.inference import remove_dropouts
+    m = pt.nn.Sequential(pt.nn.Linear(4, 8), pt.nn.Dropout(0.5),
+                         pt.nn.ReLU(), pt.nn.Dropout2D(0.1))
+    assert remove_dropouts(m) == 2
+    assert isinstance(m[1], pt.nn.Identity) and isinstance(m[3],
+                                                           pt.nn.Identity)
+    x = pt.to_tensor(np.ones((2, 4), np.float32))
+    assert m(x).shape == [2, 8]
+
+
+def test_fuse_linear_chains_pass():
+    """reference: fc_fuse family — adjacent affine ops collapse, with
+    dataflow verification (a consumed-elsewhere intermediate blocks)."""
+    from paddle_tpu.inference import fuse_linear_chains
+    from paddle_tpu.jit import InputSpec
+    pt.seed(0)
+    m = pt.nn.Sequential(pt.nn.Linear(4, 16), pt.nn.Linear(16, 8),
+                         pt.nn.Linear(8, 2))  # chain of 3 -> 1 linear
+    x = pt.to_tensor(np.random.RandomState(0).randn(3, 4).astype(np.float32))
+    want = np.asarray(m(x).data)
+    assert fuse_linear_chains(m, [InputSpec([1, 4])]) == 2
+    lins = [l for l in m if isinstance(l, pt.nn.Linear)]
+    assert len(lins) == 1 and tuple(lins[0].weight.shape) == (4, 2)
+    np.testing.assert_allclose(np.asarray(m(x).data), want, atol=1e-4)
+
+    class Branchy(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.a = pt.nn.Linear(4, 4)
+            self.b = pt.nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.a(x)
+            return self.b(h) + h        # h consumed twice: no fuse
+
+    bm = Branchy()
+    assert fuse_linear_chains(bm, [InputSpec([1, 4])]) == 0
