@@ -176,8 +176,20 @@ class TCPStore:
             self._sock.settimeout(timeout)
             try:
                 _py_req(self._sock, 3, key)
+            except socket.timeout:
+                # the server will still send its late reply; the stream
+                # is now desynchronized — poison the connection rather
+                # than let the next request read the stale reply as its
+                # own length header
+                self._sock.close()
+                raise TimeoutError(
+                    f"TCPStore wait({key!r}) timed out after {timeout}s; "
+                    "connection closed (reconnect to continue)")
             finally:
-                self._sock.settimeout(old)
+                try:
+                    self._sock.settimeout(old)
+                except OSError:
+                    pass  # socket closed by the timeout path
 
     # -- conveniences -------------------------------------------------------
     def barrier(self, name: str = "barrier") -> None:

@@ -266,6 +266,8 @@ def paged_attention_with_tail(q, k_pages, v_pages, prompt_lens,
     B, H, Dh = q.shape
     Hkv = k_pages.shape[0]
     G = H // Hkv
+    if impl not in ("auto", "pallas", "dense"):
+        raise ValueError(f"impl must be auto|pallas|dense, got {impl!r}")
     if sm_scale is None:
         sm_scale = 1.0 / float(np.sqrt(Dh))
     qs = (q * sm_scale).astype(q.dtype)

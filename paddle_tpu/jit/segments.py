@@ -48,6 +48,9 @@ import jax.numpy as jnp
 _EXEC_CACHE_MAX = 256
 
 
+_FREEZE_PRIMITIVES = (int, float, complex, bool, str, bytes, type(None))
+
+
 def _freeze_cell(v, depth: int = 0):
     """A hashable stand-in for one closure-cell value.
 
@@ -55,19 +58,24 @@ def _freeze_cell(v, depth: int = 0):
     each call); Tensors key by OBJECT identity — safe because the
     recorded fns ``_bind`` those exact objects and read their values
     from traced arrays, so two closures over the same Tensor objects
-    replay identically. Raw arrays (value-carrying, unbindable) raise,
-    forcing the id(fn) fallback."""
+    replay identically; callables key by identity (the registry's
+    _VJP_CACHE precedent). Everything ELSE raises, forcing the id(fn)
+    fallback: an arbitrary object frozen by identity would replay a
+    cached trace after the object's attributes MUTATE (stale
+    constant-baking) — the exact silent-wrongness class a cache must
+    never introduce."""
     if depth > 3:
         raise TypeError("closure too deep")
+    if isinstance(v, _FREEZE_PRIMITIVES):
+        return v
     if isinstance(v, (list, tuple)):
         return tuple(_freeze_cell(x, depth + 1) for x in v)
     from ..core.tensor import Tensor
     if isinstance(v, Tensor):
         return ("__tensor__", id(v))
-    if hasattr(v, "shape") and hasattr(v, "dtype"):
-        raise TypeError("raw array in closure")
-    hash(v)
-    return v
+    if callable(v) and not hasattr(v, "shape"):
+        return ("__fn__", id(v))
+    raise TypeError(f"unfreezable closure cell: {type(v).__name__}")
 
 
 def _fn_cache_key(fn):
@@ -224,10 +232,12 @@ class SegmentRecorder:
         def to_template(x):
             if isinstance(x, Tensor):
                 ref = self._slot(x._data)
-                if (self.tape_aware and ref.kind == "in"
+                if (need_grad and self.tape_aware and ref.kind == "in"
                         and (not x.stop_gradient or x._node is not None)):
                     # this concrete input needs gradient: it becomes one
-                    # of the flushed segment's GradNode inputs
+                    # of the flushed segment's GradNode inputs. Gated on
+                    # the OP's need_grad so no_grad() inference keeps the
+                    # cheap plain-runner flush path
                     self._diff_pos[ref.i] = x
                 return ref
             if hasattr(x, "shape") and hasattr(x, "dtype") and \

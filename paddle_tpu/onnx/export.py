@@ -471,9 +471,14 @@ class _Emitter:
                      "scaled")
         cur = sc
         if attn_mask is not None:
-            if getattr(getattr(attn_mask, "data", attn_mask), "dtype",
-                       None) == np.bool_:
-                return None  # boolean mask (where-select): fall back
+            from ..core.tensor import Tensor
+            raw = (attn_mask.data if isinstance(attn_mask, Tensor)
+                   else attn_mask)
+            if np.asarray(raw).dtype == np.bool_:
+                # boolean mask is a where-select (-inf), NOT an additive
+                # bias — exporting it as 0/1 Add would silently attend
+                # masked positions; fall back
+                return None
             mn = self.in_name(attn_mask, out_t)
             if mn is None:
                 return None
