@@ -172,6 +172,8 @@ class TCPStore:
             # wait() blocks until the key EXISTS, which can legitimately
             # take much longer (rendezvous skew) — honor the caller's
             # timeout (None = indefinite) for this one request
+            if self._sock is None:
+                _py_req(None, 3, key)  # raises the poisoned error
             old = self._sock.gettimeout()
             self._sock.settimeout(timeout)
             try:
@@ -182,14 +184,14 @@ class TCPStore:
                 # than let the next request read the stale reply as its
                 # own length header
                 self._sock.close()
+                self._sock = None
                 raise TimeoutError(
                     f"TCPStore wait({key!r}) timed out after {timeout}s; "
-                    "connection closed (reconnect to continue)")
+                    "connection poisoned — construct a new TCPStore to "
+                    "continue")
             finally:
-                try:
+                if self._sock is not None:
                     self._sock.settimeout(old)
-                except OSError:
-                    pass  # socket closed by the timeout path
 
     # -- conveniences -------------------------------------------------------
     def barrier(self, name: str = "barrier") -> None:
@@ -244,6 +246,10 @@ def _recv_exact(sock, n: int) -> bytes:
 
 def _py_req(sock, op: int, key: str, payload: bytes = b"",
             raw_reply: int = 0) -> bytes:
+    if sock is None:
+        raise ConnectionError(
+            "TCPStore connection poisoned (a wait() timed out); "
+            "construct a new TCPStore to continue")
     msg = bytes([op]) + struct.pack("<I", len(key)) + key.encode()
     if op in (0, 2):
         msg += struct.pack("<I", len(payload)) + payload

@@ -77,7 +77,6 @@ class StaticFunction:
         # surfaces the trace error instead.
         self._full_graph = full_graph
         self._bound_tensors: List = []
-        self._captured_cache: Optional[List] = None
         self._fell_back = False
         self._segmented = False
         self._seg_recorder = None
@@ -111,20 +110,17 @@ class StaticFunction:
         must become an operand, not a constant baked at trace time
         (VERDICT r4 Weak #1's to_static face).
 
-        The closure walk runs ONCE (first call) and is cached: it is
-        Python-heavy and would otherwise sit on the hot path of exactly
-        the functions to_static exists to make cheap. Free-variable
-        tensors created AFTER the first call are not lifted (they would
-        also not retrace the cached executable)."""
+        The walk runs per call: caching it would silently feed STALE
+        values after a user reassigns a free-variable tensor (the new
+        object would never be lifted; jax.jit would not retrace). The
+        cost is bounded by the names the function actually references
+        (inspect.getclosurevars), which is small next to dispatch."""
+        from ..static.nn import _captured_tensors
         params = (self._layer.parameters()
                   if self._layer is not None else [])
-        if self._captured_cache is None:
-            from ..static.nn import _captured_tensors
-            seen = {id(p) for p in params}
-            self._captured_cache = [
-                t for t in _captured_tensors([self._fn])
-                if id(t) not in seen]
-        return params + self._captured_cache
+        seen = {id(p) for p in params}
+        return params + [t for t in _captured_tensors([self._fn])
+                         if id(t) not in seen]
 
     def _eager(self, *args, **kwargs):
         if self._layer is not None:

@@ -74,7 +74,8 @@ def _freeze_cell(v, depth: int = 0):
     if isinstance(v, Tensor):
         return ("__tensor__", id(v))
     if callable(v) and not hasattr(v, "shape"):
-        return ("__fn__", id(v))
+        return v  # identity-hashed AND pinned by the key (a bare id()
+        #           could be reused by a new callable after GC)
     raise TypeError(f"unfreezable closure cell: {type(v).__name__}")
 
 

@@ -474,7 +474,13 @@ class _Emitter:
             from ..core.tensor import Tensor
             raw = (attn_mask.data if isinstance(attn_mask, Tensor)
                    else attn_mask)
-            if np.asarray(raw).dtype == np.bool_:
+            try:
+                dt = getattr(raw, "dtype", None)
+                if dt is None:  # python sequence: cheap probe
+                    dt = np.asarray(raw).dtype
+            except Exception:
+                return None  # un-arrayable mask: StableHLO fallback
+            if dt == np.bool_:
                 # boolean mask is a where-select (-inf), NOT an additive
                 # bias — exporting it as 0/1 Add would silently attend
                 # masked positions; fall back

@@ -1,0 +1,140 @@
+"""ResNet-50 training-step MFU on one chip — north-star metric #2.
+
+BASELINE.md: "CINN-replacement (XLA) ResNet-50 MFU". The conv stack is
+the real user Layer (models/resnet.py resnet50) traced into ONE jitted
+XLA step via the same bind-params capture to_static/Engine use, with
+AMP O1 auto_cast putting the convs on the MXU in bf16 and an SGD
+momentum update fused into the step. (The Engine path compiles the
+identical program; its slot-materialising first step runs EAGERLY,
+which is minutes of per-op round trips over the tunneled TPU — the
+functional form here skips that, nothing else differs.)
+
+FLOP accounting: the compiled program's own XLA cost_analysis (no
+remat, so HFU == MFU); falls back to the 2*4.09 GMAC torchvision
+convention * 3 (fwd+bwd) if the backend hides cost analysis.
+
+Run (TPU): python tools/resnet_bench.py
+"""
+import contextlib
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_PEAK_BF16 = {"v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+              "v4": 275e12, "v6e": 918e12}
+
+
+def peak_flops() -> float:
+    kind = getattr(jax.devices()[0], "device_kind", "").lower()
+    for k, v in _PEAK_BF16.items():
+        if k in kind:
+            return v
+    return 197e12
+
+
+@contextlib.contextmanager
+def _bind(tensors, arrays):
+    saved = [t._data for t in tensors]
+    for t, a in zip(tensors, arrays):
+        t._data = a
+    try:
+        yield
+    finally:
+        for t, s in zip(tensors, saved):
+            t._data = s
+
+
+def main():
+    import optax
+    import paddle_tpu as pt
+    from paddle_tpu.autograd import tape as _tape
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.models.resnet import resnet50
+
+    B = int(os.environ.get("RESNET_BENCH_B", "128"))
+    pt.seed(0)
+    model = resnet50(num_classes=1000)
+    params = model.parameters()
+    bufs = list(model.buffers())            # BN running stats
+
+    def loss_arrays(parrs, barrs, x, y):
+        with _bind(params, parrs), _bind(bufs, barrs), _tape.no_grad(), \
+                pt.amp.auto_cast(True):
+            out = model(Tensor(x))
+            l = pt.nn.functional.cross_entropy(
+                out.astype("float32"), Tensor(y)).mean()
+            new_b = [b._data for b in bufs]
+        return l.data, new_b
+
+    opt = optax.sgd(0.1, momentum=0.9)
+
+    def step(parrs, barrs, opt_state, x, y):
+        (loss, new_b), grads = jax.value_and_grad(
+            loss_arrays, has_aux=True)(parrs, barrs, x, y)
+        updates, opt_state = opt.update(grads, opt_state, parrs)
+        parrs = optax.apply_updates(parrs, updates)
+        return parrs, new_b, opt_state, loss
+
+    parrs = [p._data for p in params]
+    barrs = [b._data for b in bufs]
+    opt_state = opt.init(parrs)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, 3, 224, 224).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 1000, (B,)).astype(np.int32))
+    # compile ONCE ahead-of-time; the same executable serves warmup,
+    # timing, and cost_analysis (calling the jit-wrapped fn AND
+    # lower().compile() would build the program twice)
+    comp = jax.jit(step, donate_argnums=(0, 1, 2)).lower(
+        parrs, barrs, opt_state, x, y).compile()
+    jstep = comp
+
+    def run_n(n, parrs, barrs, opt_state):
+        loss = None
+        for _ in range(n):
+            parrs, barrs, opt_state, loss = jstep(parrs, barrs,
+                                                  opt_state, x, y)
+        return parrs, barrs, opt_state, float(loss)  # one host sync
+
+    parrs, barrs, opt_state, _ = run_n(2, parrs, barrs, opt_state)
+    n0, n1 = 2, 10
+    t = {}
+    for n in (n0, n1):
+        best = float("inf")
+        for _ in range(2):
+            t0 = time.perf_counter()
+            parrs, barrs, opt_state, loss = run_n(n, parrs, barrs,
+                                                  opt_state)
+            best = min(best, time.perf_counter() - t0)
+        t[n] = best
+    dt = (t[n1] - t[n0]) / (n1 - n0)
+
+    try:
+        flops = float(comp.cost_analysis()["flops"])
+        source = "xla_cost_analysis"
+    except Exception:
+        flops = 3 * 2 * 4.089e9 * B
+        source = "analytic_4.09GMAC"
+    mfu = flops / dt / peak_flops()
+    print(json.dumps({
+        "metric": "resnet50_train_mfu_1chip",
+        "value": round(mfu, 4),
+        "unit": "fraction_of_peak_bf16",
+        "batch": B,
+        "step_ms": round(dt * 1e3, 2),
+        "images_per_sec": round(B / dt, 1),
+        "flops_per_step": flops,
+        "flop_source": source,
+        "loss": loss,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()
