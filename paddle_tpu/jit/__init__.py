@@ -56,6 +56,9 @@ def _bind_params(params: List[Parameter], arrays):
             p._data = s
 
 
+_CAP_UNSET = object()  # sentinel: closure walk not yet run
+
+
 class StaticFunction:
     """The compiled callable ``to_static`` returns (api.py
     StaticFunction equivalent). Collects the owning Layer's parameters as
@@ -77,6 +80,8 @@ class StaticFunction:
         # surfaces the trace error instead.
         self._full_graph = full_graph
         self._bound_tensors: List = []
+        self._cap_fp: Any = _CAP_UNSET  # closure-walk fingerprint
+        self._captured_cache: List = []
         self._fell_back = False
         self._segmented = False
         self._seg_recorder = None
@@ -110,17 +115,31 @@ class StaticFunction:
         must become an operand, not a constant baked at trace time
         (VERDICT r4 Weak #1's to_static face).
 
-        The walk runs per call: caching it would silently feed STALE
-        values after a user reassigns a free-variable tensor (the new
-        object would never be lifted; jax.jit would not retrace). The
-        cost is bounded by the names the function actually references
-        (inspect.getclosurevars), which is small next to dispatch."""
+        The deep walk is cached behind a per-call FINGERPRINT of the
+        referenced closure/global values (their ids): reassigning a
+        free-variable tensor changes the fingerprint and re-walks (so
+        no stale lifting), while steady-state calls pay only the cheap
+        getclosurevars + id scan, not the 100k-node traversal.
+        Mutation NESTED inside an unchanged container is not detected —
+        pass such tensors as arguments."""
+        import inspect
         from ..static.nn import _captured_tensors
         params = (self._layer.parameters()
                   if self._layer is not None else [])
-        seen = {id(p) for p in params}
-        return params + [t for t in _captured_tensors([self._fn])
-                         if id(t) not in seen]
+        try:
+            cv = inspect.getclosurevars(self._fn)
+            fp = tuple((name, id(v))
+                       for scope in (cv.nonlocals, cv.globals)
+                       for name, v in sorted(scope.items()))
+        except TypeError:
+            fp = _CAP_UNSET  # unfingerprintable: re-walk every call
+        if fp is _CAP_UNSET or fp != self._cap_fp:
+            seen = {id(p) for p in params}
+            self._cap_fp = fp
+            self._captured_cache = [
+                t for t in _captured_tensors([self._fn])
+                if id(t) not in seen]
+        return params + self._captured_cache
 
     def _eager(self, *args, **kwargs):
         if self._layer is not None:

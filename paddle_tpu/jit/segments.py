@@ -74,8 +74,11 @@ def _freeze_cell(v, depth: int = 0):
     if isinstance(v, Tensor):
         return ("__tensor__", id(v))
     if callable(v) and not hasattr(v, "shape"):
-        return v  # identity-hashed AND pinned by the key (a bare id()
-        #           could be reused by a new callable after GC)
+        hash(v)  # unhashable callables force the id(fn) fallback NOW,
+        #          not later at the cache lookup
+        # id distinguishes eq-equal-but-distinct callables; keeping v in
+        # the key pins it so the id cannot be recycled after GC
+        return ("__fn__", id(v), v)
     raise TypeError(f"unfreezable closure cell: {type(v).__name__}")
 
 

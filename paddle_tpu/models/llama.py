@@ -754,19 +754,19 @@ def sample_logits(logits, key, temperature: float = 1.0,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
-             *, temperature: float = 0.0, top_p: float = 1.0,
-             top_k: int = 0, key=None, eos_token_id: Optional[int] = None):
-    """Autoregressive decode with a KV cache.
-
-    prompt: int32 [B, T0]. Returns [B, T0 + max_new_tokens] (prompt +
-    continuation; positions after EOS repeat EOS when eos_token_id set).
-    """
+def _decode_loop(fwd_cache_fn, init_cache_fn, params, prompt,
+                 max_new_tokens: int, temperature, top_p, top_k, key,
+                 eos_token_id):
+    """Shared autoregressive decode driver (llama + qwen2_moe): prefill
+    via ``fwd_cache_fn(params, tokens, cache, pos0)``, then a scan of
+    single-token steps with EOS latching. Returns prompt+continuation."""
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, "
+                         f"got {max_new_tokens}")
     B, T0 = prompt.shape
     key = key if key is not None else jax.random.PRNGKey(0)
-    max_len = T0 + max_new_tokens
-    cache = init_kv_cache(cfg, B, max_len)
-    logits, cache = forward_with_cache(params, prompt, cache, 0, cfg)
+    cache = init_cache_fn(B, T0 + max_new_tokens)
+    logits, cache = fwd_cache_fn(params, prompt, cache, 0)
     key, sub = jax.random.split(key)
     tok = sample_logits(logits, sub, temperature, top_p, top_k)
     done = (jnp.zeros((B,), bool) if eos_token_id is None
@@ -774,8 +774,7 @@ def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
 
     def step(carry, _):
         tok, cache, pos, key, done = carry
-        logits, cache = forward_with_cache(
-            params, tok[:, None], cache, pos, cfg)
+        logits, cache = fwd_cache_fn(params, tok[:, None], cache, pos)
         key, sub = jax.random.split(key)
         nxt = sample_logits(logits, sub, temperature, top_p, top_k)
         if eos_token_id is not None:
@@ -786,9 +785,23 @@ def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
     (last, _, _, _, _), toks = lax.scan(
         step, (tok, cache, jnp.int32(T0), key, done),
         None, length=max_new_tokens - 1)
-    out = jnp.concatenate(
+    return jnp.concatenate(
         [prompt, jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)
-    return out
+
+
+def generate(params, prompt, cfg: LlamaConfig, max_new_tokens: int,
+             *, temperature: float = 0.0, top_p: float = 1.0,
+             top_k: int = 0, key=None, eos_token_id: Optional[int] = None):
+    """Autoregressive decode with a KV cache.
+
+    prompt: int32 [B, T0]. Returns [B, T0 + max_new_tokens] (prompt +
+    continuation; positions after EOS repeat EOS when eos_token_id set).
+    """
+    return _decode_loop(
+        lambda p, t, c, pos: forward_with_cache(p, t, c, pos, cfg),
+        lambda B, L: init_kv_cache(cfg, B, L),
+        params, prompt, max_new_tokens, temperature, top_p, top_k, key,
+        eos_token_id)
 
 
 # ---------------------------------------------------------------------------

@@ -260,6 +260,94 @@ def make_train_step(cfg: Qwen2MoeConfig, mesh: Mesh, optimizer=None):
     return step_fn, init_fn
 
 
+# ---------------------------------------------------------------------------
+# decode: KV cache + generate
+# ---------------------------------------------------------------------------
+# Reference capability: MoE decode serving (the fused cutlass MoE kernels
+# run at inference too). Same cache design as models/llama.py: [L, B, S,
+# Hkv, Dh] pytree updated with dynamic_update_slice inside one jitted
+# step; the MoE FFN (einsum routing) runs unchanged on T=1 tokens.
+
+
+def init_kv_cache(cfg: Qwen2MoeConfig, batch_size: int, max_len: int):
+    L, Hkv, Dh = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    shape = (L, batch_size, max_len, Hkv, Dh)
+    return {"k": jnp.zeros(shape, cfg.dtype),
+            "v": jnp.zeros(shape, cfg.dtype)}
+
+
+def forward_with_cache(params, tokens, cache, pos0, cfg: Qwen2MoeConfig):
+    """tokens [B, T] at positions pos0.. -> (last-position logits
+    [B, V], updated cache). T = prompt length for prefill (pos0 = 0),
+    T = 1 for decode steps."""
+    from .llama import _cached_attention
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    B, T = tokens.shape
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    h = params["embed"].astype(cfg.dtype)[tokens]
+    positions = pos0 + jnp.broadcast_to(jnp.arange(T), (B, T))
+    is_prefill = isinstance(pos0, int) and pos0 == 0
+
+    def body(h, xs):
+        lp, ck, cv = xs
+        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+        q = (x @ lp["wq"]).reshape(B, T, H, Dh)
+        k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
+        v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
+        q, k = rope(q, k, positions, cfg.rope_theta, Dh)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                      (0, pos0, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                      (0, pos0, 0, 0))
+        if is_prefill:
+            o = _fa(q, k, v, causal=True,
+                    impl="auto" if cfg.use_flash_attention else "dense")
+        else:
+            o = _cached_attention(q, ck, cv, pos0, cfg)
+        h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
+
+        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+        # decode routes DROP-FREE: capacity cf = E/top_k makes expert
+        # capacity == cohort size, so no token is ever dropped. Training
+        # capacity drops are a throughput regularizer; at inference a
+        # dropped token silently loses its FFN contribution — and the
+        # drop pattern depends on cohort size, which would make cached
+        # decode diverge from a full forward
+        nodrop_cf = cfg.num_experts / cfg.num_experts_per_tok
+        routed, _ = moe_ffn(
+            x, lp["router"], lp["experts"]["w_gate"],
+            lp["experts"]["w_up"], lp["experts"]["w_down"],
+            top_k=cfg.num_experts_per_tok,
+            capacity_factor=nodrop_cf, ep_axis=None)
+        sh = lp["shared"]
+        shared = (jax.nn.silu(x @ sh["w_gate"])
+                  * (x @ sh["w_up"])) @ sh["w_down"]
+        shared = jax.nn.sigmoid(x @ sh["gate"]) * shared
+        return h + routed + shared, (ck, cv)
+
+    h, (ck_new, cv_new) = lax.scan(
+        body, h, (params["layers"], cache["k"], cache["v"]))
+    h = rms_norm(h[:, -1], params["final_norm"], cfg.rms_norm_eps)
+    logits = h @ params["lm_head"]
+    return logits.astype(jnp.float32), {"k": ck_new, "v": cv_new}
+
+
+def generate(params, prompt, cfg: Qwen2MoeConfig, max_new_tokens: int,
+             *, temperature: float = 0.0, top_p: float = 1.0,
+             top_k: int = 0, key=None, eos_token_id: Optional[int] = None):
+    """Autoregressive MoE decode with a KV cache (same contract as
+    models/llama.py generate: returns prompt + continuation). Routing
+    is DROP-FREE at decode (see forward_with_cache)."""
+    from .llama import _decode_loop
+    return _decode_loop(
+        lambda p, t, c, pos: forward_with_cache(p, t, c, pos, cfg),
+        lambda B, L: init_kv_cache(cfg, B, L),
+        params, prompt, max_new_tokens, temperature, top_p, top_k, key,
+        eos_token_id)
+
+
 def make_batch(cfg: Qwen2MoeConfig, batch_size: int, seq_len: int,
                mesh: Mesh, key=None):
     from .llama import make_batch as _llama_make_batch

@@ -140,3 +140,56 @@ def test_qwen2_moe_dropless_impl_trains():
             losses.append(float(loss))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# r5: MoE decode (KV cache + generate)
+# ---------------------------------------------------------------------------
+
+def test_moe_generate_matches_stepwise_full_forward():
+    """Greedy cached decode must equal re-running the FULL forward on
+    the growing sequence each step. Precondition: the config routes
+    without capacity drops (tiny's cf*top_k/E == 1 guarantees it) —
+    decode always routes drop-free, while a TRAINING forward with a
+    drop-inducing capacity_factor intentionally differs (drops are a
+    training regularizer; see forward_with_cache)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models import qwen2_moe as Q
+
+    cfg = Q.Qwen2MoeConfig.tiny(dtype=jnp.float32, remat=False,
+                                use_flash_attention=False)
+    params = Q.init_params(cfg, jax.random.PRNGKey(0))
+    B, T0, N = 2, 9, 6
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (B, T0), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    out = Q.generate(params, prompt, cfg, N, temperature=0.0)
+    assert out.shape == (B, T0 + N)
+
+    seq = prompt
+    for _ in range(N):
+        logits, _ = Q.forward(params, seq, cfg)
+        nxt = jnp.argmax(logits[:, -1].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_moe_generate_eos_latches():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.models import qwen2_moe as Q
+
+    cfg = Q.Qwen2MoeConfig.tiny(dtype=jnp.float32, remat=False,
+                                use_flash_attention=False)
+    params = Q.init_params(cfg, jax.random.PRNGKey(0))
+    prompt = jax.random.randint(jax.random.PRNGKey(2), (2, 5), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+    out = np.asarray(Q.generate(params, prompt, cfg, 8,
+                                temperature=0.0, eos_token_id=7))
+    for row in out:
+        hits = np.where(row[5:] == 7)[0]
+        if hits.size:
+            assert np.all(row[5 + hits[0]:] == 7), row

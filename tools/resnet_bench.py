@@ -15,7 +15,6 @@ convention * 3 (fwd+bwd) if the backend hides cost analysis.
 
 Run (TPU): python tools/resnet_bench.py
 """
-import contextlib
 import json
 import os
 import sys
@@ -39,24 +38,13 @@ def peak_flops() -> float:
     return 197e12
 
 
-@contextlib.contextmanager
-def _bind(tensors, arrays):
-    saved = [t._data for t in tensors]
-    for t, a in zip(tensors, arrays):
-        t._data = a
-    try:
-        yield
-    finally:
-        for t, s in zip(tensors, saved):
-            t._data = s
-
-
 def main():
     import optax
     import paddle_tpu as pt
     from paddle_tpu.autograd import tape as _tape
     from paddle_tpu.core.tensor import Tensor
     from paddle_tpu.models.resnet import resnet50
+    from paddle_tpu.static.nn import _bind
 
     B = int(os.environ.get("RESNET_BENCH_B", "128"))
     pt.seed(0)
