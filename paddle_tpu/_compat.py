@@ -1,0 +1,26 @@
+"""Version compatibility shims for the jax API surface we ride.
+
+One place adapts the repo to the installed jax:
+
+  - ``shard_map``: top-level ``jax.shard_map`` (new) vs
+    ``jax.experimental.shard_map.shard_map`` (<= 0.4.x), whose
+    replication-check kwarg is ``check_vma`` vs ``check_rep``. Callers
+    use the NEW spelling; this wrapper translates downward.
+"""
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map_impl
+    _KWARG = "check_vma"
+except ImportError:  # pre-0.5 jax: experimental module, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _KWARG = "check_rep"
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    if check_vma is not None:
+        kw[_KWARG] = check_vma
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kw)

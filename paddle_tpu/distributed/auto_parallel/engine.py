@@ -111,6 +111,7 @@ class Engine:
         self._jit_step = None
         self._jit_fwd = None
         self._pp_blocks: Optional[List] = None
+        self._pp_verified = False
 
     # ------------------------------------------------------------- plan ----
     def _build_mesh(self) -> Mesh:
@@ -259,9 +260,91 @@ class Engine:
         end = best_start + best_len
         return units[:start], units[start:end], units[end:]
 
-    def prepare(self):
-        """Plan + shard all parameters (idempotent)."""
+    def _verify_pp_forward_order(self, x) -> None:
+        """Guard the pp contract against definition-order vs
+        forward-order divergence (ADVICE r5 medium): the pipeline
+        executes ``_flat_units`` in __init__ DEFINITION order, so a
+        model whose forward calls them in another order, reuses one, or
+        does math BETWEEN units (extra residual, functional glue) would
+        silently train different math under pp_degree > 1. One traced
+        forward (eval + no_grad, so no RNG is consumed and no buffers
+        move) must show: every unit called exactly once, in definition
+        order, each unit's output fed VERBATIM as the next unit's input,
+        and the last unit's output returned as the model output.
+
+        Known limit: a forward_pre_hook that REPLACES a unit's input
+        (e.g. shard_layer's input_fn) breaks the identity chain and is
+        rejected here even though the stage loop would reproduce it —
+        pre-hook input rewriting is unsupported under Engine pp."""
+        from ...autograd import tape as _tape
+        pre, blocks, post = self._pp_blocks
+        units = [*pre, *blocks, *post]
+        events: List = []
+        hooks = []
+
+        def post_hook(layer, inputs, output):
+            src = inputs[0] if isinstance(inputs, tuple) else inputs
+            events.append((layer, src, output))
+
+        for u in units:
+            hooks.append(u.register_forward_post_hook(post_hook))
+        # snapshot per-sublayer training flags: a blanket train() after
+        # eval() would clobber deliberately-frozen submodules (a user's
+        # model.backbone.eval() before fit)
+        modes = [(l, l.training)
+                 for l in self.model.sublayers(include_self=True)]
+        self.model.eval()
+        try:
+            with _tape.no_grad():
+                y = self.model(Tensor(x, stop_gradient=True))
+        finally:
+            for l, flag in modes:
+                l.training = flag
+            for h in hooks:
+                h.remove()
+
+        def name(u):
+            return type(u).__name__
+
+        called = [e[0] for e in events]
+        if called != units:
+            raise ValueError(
+                "Engine pipeline parallelism requires the model's forward "
+                "to call its top-level units exactly once each, in "
+                "definition order; traced call sequence "
+                f"{[name(u) for u in called]} != unit list "
+                f"{[name(u) for u in units]}. Reorder the sublayer "
+                "definitions to match the forward (or use the dp/mp path)")
+        for (u_a, _, out_a), (u_b, in_b, _) in zip(events, events[1:]):
+            if out_a is not in_b:
+                raise ValueError(
+                    f"Engine pipeline parallelism: the output of "
+                    f"{name(u_a)} is not (identically) the input of "
+                    f"{name(u_b)} — the forward does extra math between "
+                    "units (residual/functional glue), which the stage "
+                    "loop cannot reproduce; fold it into a unit or use "
+                    "the dp/mp path")
+        if events and y is not events[-1][2]:
+            raise ValueError(
+                "Engine pipeline parallelism: the model output is not "
+                f"(identically) the last unit's ({name(events[-1][0])}) "
+                "output — the forward post-processes it outside the unit "
+                "list; fold that into a unit or use the dp/mp path")
+        self._pp_verified = True
+
+    def prepare(self, sample_input=None):
+        """Plan + shard all parameters (idempotent). With
+        ``sample_input`` and pp_degree > 1, additionally trace one
+        forward to verify the pipeline's definition-order contract
+        (otherwise that check runs on the first fit() batch)."""
         if self._planned:
+            # idempotent for the plan, but an explicitly-supplied
+            # sample must still verify (a prior bare prepare() — e.g.
+            # via distributed_plan() — must not swallow the check)
+            if (sample_input is not None and self.strategy.pp_degree > 1
+                    and not self._pp_verified):
+                self._verify_pp_forward_order(
+                    self._shard_arr(sample_input))
             return self
         self._mesh = self._build_mesh()
         if self.strategy.pp_degree > 1:
@@ -284,6 +367,8 @@ class Engine:
             p.data = jax.device_put(p.data, NamedSharding(self._mesh,
                                                           spec))
         self._planned = True
+        if self.strategy.pp_degree > 1 and sample_input is not None:
+            self._verify_pp_forward_order(self._shard_arr(sample_input))
         return self
 
     # --------------------------------------------------------- compiled ----
@@ -511,6 +596,8 @@ class Engine:
                                                     batch_size)):
                 x = self._shard_arr(batch[0])
                 y = self._shard_arr(batch[1])
+                if self.strategy.pp_degree > 1 and not self._pp_verified:
+                    self._verify_pp_forward_order(x)
                 if not self.strategy.jit:
                     loss = self._eager_step(x, y)
                 elif self._jit_step is None:

@@ -22,14 +22,14 @@ the jitted decode step only ever sees the pool arrays + tables.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 __all__ = ["PagePool", "paged_attention", "write_prompt_pages",
-           "write_token_pages"]
+           "write_token_pages", "apply_defrag"]
 
 
 def _on_tpu() -> bool:
@@ -71,13 +71,68 @@ class PagePool:
 
     def alloc_for_len(self, length: int) -> List[int]:
         """Pages covering ``length`` tokens."""
-        return self.alloc(max(1, -(-int(length) // self.page_size)))
+        return self.alloc(self.pages_for_len(length))
 
     def free(self, pages) -> None:
         for p in pages:
             p = int(p)
             if p != self.TRASH:
                 self._free.append(p)
+
+    # ------------------------------------------------- serving helpers ----
+    @property
+    def used_pages(self) -> int:
+        """Pages currently handed out (trash page excluded)."""
+        return self.total_pages - 1 - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        """Fraction of allocatable pages currently in use."""
+        return self.used_pages / max(self.total_pages - 1, 1)
+
+    def can_alloc(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def pages_for_len(self, length: int) -> int:
+        """How many pages ``length`` tokens need (>= 1)."""
+        return max(1, -(-int(length) // self.page_size))
+
+    def defrag_plan(self) -> Dict[int, int]:
+        """Compaction plan ``{old_page: new_page}`` moving every USED page
+        down to the lowest free indices (1..used). Empty dict when already
+        compact. The pool's free list is NOT mutated here — call
+        ``commit_defrag`` after the pool arrays/tables have been rewritten
+        (``apply_defrag``), so a failed rewrite cannot desync the
+        allocator from the arrays."""
+        used = sorted(set(range(1, self.total_pages)) - set(self._free))
+        plan = {old: new for new, old in enumerate(used, start=1)
+                if old != new}
+        return plan
+
+    def commit_defrag(self, plan: Dict[int, int]) -> None:
+        """Point the free list at the pages vacated by ``plan``.
+
+        Derived from the plan against the CURRENT used set (not a blind
+        "first n pages are used" rewrite), and raises if the pool
+        changed incompatibly between ``defrag_plan()`` and here — an
+        interleaved alloc/free would otherwise silently alias two
+        sequences onto one page. Callers serialize the
+        plan -> apply_defrag -> commit_defrag window (the serving
+        engine holds its tick lock across it)."""
+        if not plan:
+            return
+        used_now = set(range(1, self.total_pages)) - set(self._free)
+        if not set(plan).issubset(used_now):
+            raise RuntimeError(
+                "commit_defrag: plan references pages freed since "
+                "defrag_plan() — recompute the plan")
+        if set(plan.values()) & (used_now - set(plan)):
+            raise RuntimeError(
+                "commit_defrag: plan destinations were allocated since "
+                "defrag_plan() — recompute the plan")
+        used_after = (used_now - set(plan)) | set(plan.values())
+        self._free = sorted(set(range(1, self.total_pages)) - used_after,
+                            reverse=True)
 
 
 def _ref_paged_attention(q, k_pages, v_pages, lengths, page_indices,
@@ -368,3 +423,27 @@ def write_prompt_pages(k_pages, v_pages, k, v, lengths, page_indices):
     k_pages = k_pages.at[:, page, off].set(k.transpose(2, 0, 1, 3))
     v_pages = v_pages.at[:, page, off].set(v.transpose(2, 0, 1, 3))
     return k_pages, v_pages
+
+
+def apply_defrag(plan: Dict[int, int], k_pages, v_pages, tables,
+                 page_axis: int = -3):
+    """Rewrite pool arrays + tables per a ``PagePool.defrag_plan()``.
+
+    k_pages/v_pages carry the page dim at ``page_axis`` (default -3:
+    ``[..., P, ps, Dh]`` — works for per-layer ``[Hkv, P, ps, Dh]`` and
+    layer-stacked ``[L, Hkv, P, ps, Dh]`` pools alike). ``tables`` is any
+    int array of page indices. Returns ``(k_pages, v_pages, tables)``;
+    callers then ``commit_defrag(plan)`` on the pool."""
+    if not plan:
+        return k_pages, v_pages, tables
+    P_total = k_pages.shape[page_axis]
+    src = np.arange(P_total, dtype=np.int32)
+    dst_map = np.arange(P_total, dtype=np.int32)
+    for old, new in plan.items():
+        src[new] = old          # gather: new slot <- old page's contents
+        dst_map[old] = new      # remap: table entries old -> new
+    gather = jnp.asarray(src)
+    k_pages = jnp.take(k_pages, gather, axis=page_axis)
+    v_pages = jnp.take(v_pages, gather, axis=page_axis)
+    tables = jnp.asarray(dst_map)[jnp.asarray(tables)]
+    return k_pages, v_pages, tables

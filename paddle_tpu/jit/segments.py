@@ -83,23 +83,31 @@ def _freeze_cell(v, depth: int = 0):
 
 
 def _fn_cache_key(fn):
-    """Key a recorded op's fn by its code object + frozen closure cells:
-    APIs that build a fresh closure per call (static/nn.py cond/case/
-    while close over a fresh ``captured`` list of stable Tensors + the
-    user's stable branch callables) would never hit an ``id(fn)`` key —
-    every flush would re-jit and permanently pin the dead closure
-    (ADVICE r4). Falls back to identity when a cell defies freezing."""
+    """Key a recorded op's fn by its code object + frozen closure cells
+    + frozen default args: APIs that build a fresh closure per call
+    (static/nn.py cond/case/while close over a fresh ``captured`` list
+    of stable Tensors + the user's stable branch callables) would never
+    hit an ``id(fn)`` key — every flush would re-jit and permanently pin
+    the dead closure (ADVICE r4). Defaults matter too (ADVICE r5):
+    factory-made fns that capture via default args (``def f(x, y=s)``)
+    share the code object with EMPTY closures — keying only on cells
+    would collide them and replay another fn's baked constant. Falls
+    back to identity when any cell/default defies freezing."""
     code = getattr(fn, "__code__", None)
     if code is None:
         return id(fn)
-    cells = ()
-    if getattr(fn, "__closure__", None):
-        try:
-            cells = tuple(_freeze_cell(c.cell_contents)
-                          for c in fn.__closure__)
-        except Exception:
-            return id(fn)
-    return (code, cells)
+    try:
+        cells = tuple(_freeze_cell(c.cell_contents)
+                      for c in (getattr(fn, "__closure__", None) or ()))
+        dflts = tuple(_freeze_cell(v)
+                      for v in (getattr(fn, "__defaults__", None) or ()))
+        kwdflts = tuple(
+            (k, _freeze_cell(v))
+            for k, v in sorted((getattr(fn, "__kwdefaults__", None)
+                                or {}).items()))
+    except Exception:
+        return id(fn)
+    return (code, cells, dflts, kwdflts)
 
 def current() -> Optional["SegmentRecorder"]:
     from ..ops import registry as _registry

@@ -366,7 +366,7 @@ def _train_attn_fn(cfg: LlamaConfig, mesh):
     fa = cfg.use_flash_attention
     impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
     if _tp_heads_shardable(cfg, mesh):
-        from jax import shard_map
+        from .._compat import shard_map
         dp_ax = "dp" if "dp" in mesh.shape else None
         spec = P(dp_ax, None, "tp", None)
         body = lambda ql, kl, vl: _fa(ql, kl, vl, causal=True, impl=impl)
@@ -947,6 +947,143 @@ def generate_paged(params, prompt, lengths, cfg: LlamaConfig,
                                      None, length=max_new_tokens - 1)
     return jnp.concatenate([jnp.moveaxis(toks, 0, 1), last[:, None]],
                            axis=1)
+
+
+# ---------------------------------------------------------------------------
+# serving: single-step prefill/decode over a SHARED page pool
+# ---------------------------------------------------------------------------
+# The continuous-batching engine (paddle_tpu/serving/) needs step
+# functions it can call once per tick against a persistent per-layer
+# page pool — unlike generate_paged, whose cache is built fresh per
+# batch and whose decode loop is fused into one scan. Pages here are
+# allocated per REQUEST by the host-side PagePool (serving/scheduler.py)
+# and freed the moment a sequence retires, so a long generation never
+# holds cache capacity hostage for the whole batch. The block math is
+# _block — the same single source of truth the training and fused-scan
+# decode paths use.
+
+
+def init_serving_pages(cfg, total_pages: int, page_size: int):
+    """Layer-stacked page pools ``[L, Hkv, P, ps, Dh]`` (page 0 = trash)."""
+    L, Hkv, Dh = (cfg.num_hidden_layers, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    shape = (L, Hkv, total_pages, page_size, Dh)
+    return {"k_pages": jnp.zeros(shape, cfg.dtype),
+            "v_pages": jnp.zeros(shape, cfg.dtype)}
+
+
+def serving_prefill(params, tokens, length, table, k_pages, v_pages, cfg,
+                    attn_impl: str = "auto", _block_fn=None):
+    """Prefill ONE request into its allocated pages.
+
+    tokens ``[1, Tb]`` right-padded to a compile bucket; length scalar
+    i32 (valid tokens); table ``[pps]`` i32 — the slot's page-table row
+    (trailing entries may be TRASH). k_pages/v_pages: the layer-stacked
+    pools. Returns ``(logits [V] f32 at the last valid position,
+    k_pages', v_pages')``. Padding positions write to the trash page and
+    never influence valid logits (causal attention).
+    """
+    from ..inference.paged_kv import write_prompt_pages
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    block_fn = _block_fn if _block_fn is not None else _block
+    B, T0 = tokens.shape
+    lengths = jnp.reshape(length, (1,)).astype(jnp.int32)
+    tables = jnp.reshape(table, (1, -1)).astype(jnp.int32)
+    h = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.broadcast_to(jnp.arange(T0), (B, T0))
+    if attn_impl != "auto":
+        impl = attn_impl
+    else:
+        fa = cfg.use_flash_attention
+        impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            kp2, vp2 = write_prompt_pages(
+                kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), lengths,
+                tables)
+            cell["kp"], cell["vp"] = kp2, vp2
+            return _fa(q, k, v, causal=True, impl=impl)
+
+        h = block_fn(lp, h, positions, cfg, attn_fn)
+        return h, (cell["kp"], cell["vp"])
+
+    h, (kp_new, vp_new) = lax.scan(body, h, (params["layers"], k_pages,
+                                             v_pages))
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    idx = jnp.maximum(lengths - 1, 0)[:, None, None]
+    h_last = jnp.take_along_axis(h, idx, axis=1)[:, 0]
+    logits = h_last @ params["lm_head"]
+    return logits[0].astype(jnp.float32), kp_new, vp_new
+
+
+def serving_decode_step(params, tok, lengths, tables, k_pages, v_pages,
+                        cfg, attn_impl: str = "auto", _block_fn=None):
+    """One decode tick for ALL slots of the serving batch.
+
+    tok ``[S]`` i32 — each slot's current token; lengths ``[S]`` i32 —
+    tokens already in that slot's cache (0 for dead slots, whose table
+    rows are all-TRASH: they write to and read from the trash page and
+    their logits are discarded by the host); tables ``[S, pps]``.
+    Returns ``(logits [S, V] f32, k_pages', v_pages')``. The token's KV
+    lands at position ``lengths[s]``; attention then covers
+    ``lengths + 1`` positions — the paged counterpart of
+    forward_with_cache's decode step.
+    """
+    from ..inference.paged_kv import paged_attention, write_token_pages
+    block_fn = _block_fn if _block_fn is not None else _block
+    h = params["embed"].astype(cfg.dtype)[tok[:, None]]      # [S, 1, D]
+    positions = lengths[:, None]
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            kp2, vp2 = write_token_pages(
+                kp, vp, k[:, 0].astype(kp.dtype), v[:, 0].astype(vp.dtype),
+                lengths, tables)
+            cell["kp"], cell["vp"] = kp2, vp2
+            o = paged_attention(q[:, 0], kp2, vp2, lengths + 1, tables,
+                                impl=attn_impl)
+            return o[:, None].astype(q.dtype)
+
+        h = block_fn(lp, h, positions, cfg, attn_fn)
+        return h, (cell["kp"], cell["vp"])
+
+    h, (kp_new, vp_new) = lax.scan(body, h, (params["layers"], k_pages,
+                                             v_pages))
+    h = rms_norm(h[:, 0], params["final_norm"], cfg.rms_norm_eps)
+    logits = h @ params["lm_head"]
+    return logits.astype(jnp.float32), kp_new, vp_new
+
+
+def serving_decode_block(params, tok, lengths, tables, k_pages, v_pages,
+                         cfg, num_steps: int, attn_impl: str = "auto",
+                         _block_fn=None):
+    """``num_steps`` fused GREEDY decode ticks in one program (the
+    multi-step scheduling lever: per-call dispatch + host bookkeeping
+    amortize over the block). Sampling is in-graph argmax over the f32
+    logits — bit-identical to sample_logits(temperature=0), so tokens
+    still match single-step decode exactly. Returns
+    ``(toks [S, num_steps] i32, k_pages', v_pages')``; the host
+    truncates a sequence's tokens at EOS/max_new_tokens (positions a
+    retiring sequence wrote past its budget land on the trash page via
+    the table-width guard, so neighbours never see them)."""
+
+    def step(carry, _):
+        tok, lens, kp, vp = carry
+        logits, kp, vp = serving_decode_step(
+            params, tok, lens, tables, kp, vp, cfg, attn_impl, _block_fn)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, lens + 1, kp, vp), nxt
+
+    (_, _, kp_new, vp_new), toks = lax.scan(
+        step, (tok, lengths, k_pages, v_pages), None, length=num_steps)
+    return jnp.moveaxis(toks, 0, 1), kp_new, vp_new
 
 
 def make_batch(cfg: LlamaConfig, batch_size: int, seq_len: int, mesh: Mesh,

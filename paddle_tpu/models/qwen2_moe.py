@@ -277,6 +277,42 @@ def init_kv_cache(cfg: Qwen2MoeConfig, batch_size: int, max_len: int):
             "v": jnp.zeros(shape, cfg.dtype)}
 
 
+def _decode_block(lp, h, positions, cfg: Qwen2MoeConfig, attn_fn):
+    """Qwen block math shared by every cached-decode consumer (dense
+    cache forward_with_cache AND the serving engine's paged step fns):
+    norm -> QKV -> rope -> attn_fn -> o-proj+residual -> norm -> MoE FFN
+    (DROP-FREE: capacity cf = E/top_k makes expert capacity == cohort
+    size, so no token is ever dropped. Training capacity drops are a
+    throughput regularizer; at inference a dropped token silently loses
+    its FFN contribution — and the drop pattern depends on cohort size,
+    which would make cached decode diverge from a full forward) + shared
+    expert + residual. Same signature as models/llama.py _block, so the
+    serving step drivers take either."""
+    B, T, D = h.shape
+    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
+                  cfg.head_dim)
+    x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
+    q = (x @ lp["wq"]).reshape(B, T, H, Dh)
+    k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
+    v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
+    q, k = rope(q, k, positions, cfg.rope_theta, Dh)
+    o = attn_fn(q, k, v)
+    h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
+
+    x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
+    nodrop_cf = cfg.num_experts / cfg.num_experts_per_tok
+    routed, _ = moe_ffn(
+        x, lp["router"], lp["experts"]["w_gate"],
+        lp["experts"]["w_up"], lp["experts"]["w_down"],
+        top_k=cfg.num_experts_per_tok,
+        capacity_factor=nodrop_cf, ep_axis=None)
+    sh = lp["shared"]
+    shared = (jax.nn.silu(x @ sh["w_gate"])
+              * (x @ sh["w_up"])) @ sh["w_down"]
+    shared = jax.nn.sigmoid(x @ sh["gate"]) * shared
+    return h + routed + shared
+
+
 def forward_with_cache(params, tokens, cache, pos0, cfg: Qwen2MoeConfig):
     """tokens [B, T] at positions pos0.. -> (last-position logits
     [B, V], updated cache). T = prompt length for prefill (pos0 = 0),
@@ -284,48 +320,28 @@ def forward_with_cache(params, tokens, cache, pos0, cfg: Qwen2MoeConfig):
     from .llama import _cached_attention
     from ..ops.pallas.flash_attention import flash_attention as _fa
     B, T = tokens.shape
-    H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
-                  cfg.head_dim)
     h = params["embed"].astype(cfg.dtype)[tokens]
     positions = pos0 + jnp.broadcast_to(jnp.arange(T), (B, T))
     is_prefill = isinstance(pos0, int) and pos0 == 0
 
     def body(h, xs):
         lp, ck, cv = xs
-        x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-        q = (x @ lp["wq"]).reshape(B, T, H, Dh)
-        k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
-        v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
-        q, k = rope(q, k, positions, cfg.rope_theta, Dh)
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
-                                      (0, pos0, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
-                                      (0, pos0, 0, 0))
-        if is_prefill:
-            o = _fa(q, k, v, causal=True,
-                    impl="auto" if cfg.use_flash_attention else "dense")
-        else:
-            o = _cached_attention(q, ck, cv, pos0, cfg)
-        h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
+        cell = {}
 
-        x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
-        # decode routes DROP-FREE: capacity cf = E/top_k makes expert
-        # capacity == cohort size, so no token is ever dropped. Training
-        # capacity drops are a throughput regularizer; at inference a
-        # dropped token silently loses its FFN contribution — and the
-        # drop pattern depends on cohort size, which would make cached
-        # decode diverge from a full forward
-        nodrop_cf = cfg.num_experts / cfg.num_experts_per_tok
-        routed, _ = moe_ffn(
-            x, lp["router"], lp["experts"]["w_gate"],
-            lp["experts"]["w_up"], lp["experts"]["w_down"],
-            top_k=cfg.num_experts_per_tok,
-            capacity_factor=nodrop_cf, ep_axis=None)
-        sh = lp["shared"]
-        shared = (jax.nn.silu(x @ sh["w_gate"])
-                  * (x @ sh["w_up"])) @ sh["w_down"]
-        shared = jax.nn.sigmoid(x @ sh["gate"]) * shared
-        return h + routed + shared, (ck, cv)
+        def attn_fn(q, k, v):
+            ck2 = lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                           (0, pos0, 0, 0))
+            cv2 = lax.dynamic_update_slice(cv, v.astype(cv.dtype),
+                                           (0, pos0, 0, 0))
+            cell["ck"], cell["cv"] = ck2, cv2
+            if is_prefill:
+                return _fa(q, k, v, causal=True,
+                           impl="auto" if cfg.use_flash_attention
+                           else "dense")
+            return _cached_attention(q, ck2, cv2, pos0, cfg)
+
+        h = _decode_block(lp, h, positions, cfg, attn_fn)
+        return h, (cell["ck"], cell["cv"])
 
     h, (ck_new, cv_new) = lax.scan(
         body, h, (params["layers"], cache["k"], cache["v"]))
@@ -352,3 +368,39 @@ def make_batch(cfg: Qwen2MoeConfig, batch_size: int, seq_len: int,
                mesh: Mesh, key=None):
     from .llama import make_batch as _llama_make_batch
     return _llama_make_batch(cfg, batch_size, seq_len, mesh, key=key)
+
+
+# ---------------------------------------------------------------------------
+# serving: single-step prefill/decode over a shared page pool
+# ---------------------------------------------------------------------------
+# Same contracts as models/llama.py's serving fns — the drivers are
+# shared; only the block math (here: _decode_block with the drop-free
+# MoE FFN) differs. The continuous-batching engine (paddle_tpu/serving/)
+# dispatches on the config type.
+
+
+def init_serving_pages(cfg: Qwen2MoeConfig, total_pages: int,
+                       page_size: int):
+    from .llama import init_serving_pages as _impl
+    return _impl(cfg, total_pages, page_size)
+
+
+def serving_prefill(params, tokens, length, table, k_pages, v_pages, cfg,
+                    attn_impl: str = "auto"):
+    from .llama import serving_prefill as _impl
+    return _impl(params, tokens, length, table, k_pages, v_pages, cfg,
+                 attn_impl=attn_impl, _block_fn=_decode_block)
+
+
+def serving_decode_step(params, tok, lengths, tables, k_pages, v_pages,
+                        cfg, attn_impl: str = "auto"):
+    from .llama import serving_decode_step as _impl
+    return _impl(params, tok, lengths, tables, k_pages, v_pages, cfg,
+                 attn_impl=attn_impl, _block_fn=_decode_block)
+
+
+def serving_decode_block(params, tok, lengths, tables, k_pages, v_pages,
+                         cfg, num_steps: int, attn_impl: str = "auto"):
+    from .llama import serving_decode_block as _impl
+    return _impl(params, tok, lengths, tables, k_pages, v_pages, cfg,
+                 num_steps, attn_impl=attn_impl, _block_fn=_decode_block)

@@ -399,7 +399,9 @@ def multiplex(inputs, index, name=None):
     stacked = jnp.stack(inputs, axis=0)  # (n, batch, ...)
     idx = index.reshape(-1)
     return jnp.take_along_axis(
-        stacked, idx[None, :, *([None] * (stacked.ndim - 2))], axis=0)[0]
+        stacked,
+        idx[(None, slice(None)) + (None,) * (stacked.ndim - 2)],
+        axis=0)[0]
 
 
 @register_op()

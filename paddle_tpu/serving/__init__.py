@@ -1,0 +1,27 @@
+"""paddle_tpu.serving — continuous-batching generation serving.
+
+Reference capability: the inference product's high-throughput serving
+stack (AnalysisPredictor frontends + fused generation kernels coalescing
+many concurrent streams per device). Where ``inference.DynamicBatcher``
+batches WHOLE requests, this subsystem batches per decode STEP over the
+paged KV cache (inference/paged_kv.py): requests join mid-flight, retire
+at EOS, and free their cache pages immediately — the vLLM-style
+continuous batching "Ragged Paged Attention" names as the TPU serving
+shape (PAPERS.md).
+
+    ServingEngine   — the step-loop engine (serving/engine.py)
+    Scheduler       — slot + page-budget admission (serving/scheduler.py)
+    RequestHandle   — per-request token stream / blocking result
+    ServingMetrics  — counters + latency histograms (serving/metrics.py)
+
+See docs/SERVING.md for architecture, knobs, and metrics.
+"""
+from .engine import ServingEngine  # noqa: F401
+from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .scheduler import (Request, RequestHandle, Scheduler,  # noqa: F401
+                        CANCELLED, COMPLETED, QUEUED, REJECTED, RUNNING,
+                        TIMED_OUT)
+
+__all__ = ["ServingEngine", "Scheduler", "Request", "RequestHandle",
+           "ServingMetrics", "Histogram", "QUEUED", "RUNNING", "COMPLETED",
+           "CANCELLED", "TIMED_OUT", "REJECTED"]

@@ -1,0 +1,462 @@
+"""Continuous-batching generation engine over the paged KV cache.
+
+Reference capability: the inference product's serving stack —
+AnalysisPredictor wrapped by frontends that coalesce MANY concurrent
+generation streams per device over block_multihead_attention's paged
+cache. ``inference.DynamicBatcher`` batches whole requests (a long
+generation holds its batch slot until EOS while short requests queue
+behind it); this engine batches per STEP:
+
+  - requests are admitted mid-flight into free slots of a fixed
+    ``max_batch``-wide decode batch (admission is page-budget-aware —
+    see serving/scheduler.py);
+  - an admitted request is prefilled immediately (one jitted prefill
+    per prompt-length bucket, batch 1) writing its prompt KV into its
+    own pages of a SHARED per-layer page pool;
+  - every engine tick runs ONE jitted decode step for all slots —
+    live or dead — so the decode program has a single stable shape and
+    XLA compiles it exactly once;
+  - sequences retire at EOS / max_new_tokens / deadline / cancel and
+    their pages return to the pool the same tick, so the next queued
+    request starts without waiting for the rest of the batch.
+
+Correctness bar (tests/test_serving.py): with greedy sampling every
+request's tokens equal a standalone ``generate()`` run token-for-token,
+regardless of what else shares the batch — slots are mathematically
+independent (row-wise model math + per-slot page tables).
+
+Tokens stream to callers through per-request iterators
+(``RequestHandle``); ``close()`` drains gracefully. Counters and
+latency histograms live in serving/metrics.py; prefill/decode spans are
+``profiler.RecordEvent``-annotated so they land in device traces.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from functools import partial
+from typing import Optional
+
+import numpy as np
+
+from ..inference.paged_kv import PagePool, apply_defrag
+from ..profiler import RecordEvent
+from .metrics import ServingMetrics
+from .scheduler import (CANCELLED, COMPLETED, REJECTED, TIMED_OUT,
+                        Request, RequestHandle, Scheduler)
+
+__all__ = ["ServingEngine"]
+
+
+def _resolve_model(model, cfg):
+    if model is not None and not isinstance(model, str):
+        return model  # module-like: init_serving_pages/prefill/decode
+    name = model or type(cfg).__name__
+    if "llama" in name.lower():
+        from ..models import llama
+        return llama
+    if "qwen2moe" in name.lower().replace("_", ""):
+        from ..models import qwen2_moe
+        return qwen2_moe
+    raise ValueError(
+        f"cannot infer serving model from {name!r}; pass model='llama', "
+        "'qwen2_moe', or a module exposing init_serving_pages/"
+        "serving_prefill/serving_decode_step")
+
+
+from collections import OrderedDict
+
+# LRU-bounded: each entry pins a config + three jitted fns (and their
+# XLA executables); a per-tenant-config service must not grow this
+# forever. 8 distinct live (model, config, impl) triples is plenty for
+# blue/green reuse.
+_JIT_CACHE: "OrderedDict" = OrderedDict()
+_JIT_CACHE_MAX = 8
+
+
+def _jit_step_fns(mod, cfg, attn_impl: str):
+    """Shared jitted prefill/decode per (model, config, impl): several
+    engines over one config (tests, blue/green restarts) reuse the same
+    jit objects, so XLA's executable cache carries across instances."""
+    import jax
+    key = (mod.__name__, id(cfg), attn_impl)
+    hit = _JIT_CACHE.get(key)
+    if hit is not None and hit[0] is cfg:  # id() safe: cfg ref held
+        _JIT_CACHE.move_to_end(key)
+        return hit[1], hit[2], hit[3]
+    # donate the pool arrays (args 4/5 of both step fns): the engine
+    # rebinds the returned pools immediately, and without donation every
+    # tick pays a full pool copy — measured 2-3x the whole step time on
+    # the CPU mesh at bench shapes
+    pre = jax.jit(partial(mod.serving_prefill, cfg=cfg,
+                          attn_impl=attn_impl), donate_argnums=(4, 5))
+    dec = jax.jit(partial(mod.serving_decode_step, cfg=cfg,
+                          attn_impl=attn_impl), donate_argnums=(4, 5))
+    blk = jax.jit(partial(mod.serving_decode_block, cfg=cfg,
+                          attn_impl=attn_impl), donate_argnums=(4, 5),
+                  static_argnames=("num_steps",))
+    _JIT_CACHE[key] = (cfg, pre, dec, blk)
+    if len(_JIT_CACHE) > _JIT_CACHE_MAX:
+        _JIT_CACHE.popitem(last=False)
+    return pre, dec, blk
+
+
+def _default_buckets(max_prompt_len: int):
+    buckets, b = [], 8
+    while b < max_prompt_len:
+        buckets.append(b)
+        b *= 2
+    buckets.append(max_prompt_len)
+    return sorted(set(buckets))
+
+
+class ServingEngine:
+    """Continuous-batching serving engine.
+
+        eng = ServingEngine(params, cfg, max_batch=8, page_size=8,
+                            max_prompt_len=32, max_new_tokens_cap=32)
+        h = eng.submit([1, 2, 3], max_new_tokens=16, eos_token_id=7)
+        for tok in h:          # streams as decoded
+            ...
+        toks = h.result()      # or block for the full continuation
+        eng.close()            # graceful drain
+
+    params/cfg: a Llama- or Qwen2Moe-family params pytree + config
+    (model resolved from the config type; pass ``model=`` to override).
+    max_batch: decode slots (the one compiled decode shape).
+    page_size/total_pages: the shared KV pool geometry. The default
+    total_pages funds every slot's worst case; pass something smaller to
+    get real admission backpressure.
+    max_prompt_len / prompt_buckets: prompts are right-padded to the
+    smallest bucket (one prefill compile per bucket).
+    max_new_tokens_cap: per-request max_new_tokens ceiling (sizes the
+    fixed page-table width).
+    """
+
+    def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
+                 page_size: int = 16, total_pages: Optional[int] = None,
+                 max_prompt_len: int = 64, max_new_tokens_cap: int = 64,
+                 prompt_buckets=None, attn_impl: str = "auto",
+                 max_queue: Optional[int] = None,
+                 tick_interval_s: float = 0.0,
+                 decode_block_size: int = 1):
+        if max_batch < 1:
+            raise ValueError("max_batch must be >= 1")
+        # optional pacing between decode ticks (tests / co-tenant CPU
+        # politeness); 0 = run ticks back to back
+        self._tick_interval = float(tick_interval_s)
+        # >1: fuse this many GREEDY decode steps per tick (multi-step
+        # scheduling — per-tick dispatch/host work amortizes over the
+        # block at the cost of admission/retirement granularity; ticks
+        # fall back to single steps whenever a live request samples)
+        if decode_block_size < 1:
+            raise ValueError("decode_block_size must be >= 1")
+        self._decode_block = int(decode_block_size)
+        self._params = params
+        self._cfg = cfg
+        self._mod = _resolve_model(model, cfg)
+        self._attn_impl = attn_impl
+        self._max_new_cap = int(max_new_tokens_cap)
+        self._buckets = sorted(set(int(b) for b in (
+            prompt_buckets or _default_buckets(max_prompt_len))))
+        max_bucket = self._buckets[-1]
+        pages_per_slot = -(-(max_bucket + self._max_new_cap - 1)
+                           // page_size)
+        if total_pages is None:
+            total_pages = max_batch * pages_per_slot + 1
+        self.pool = PagePool(total_pages=total_pages, page_size=page_size)
+        self.scheduler = Scheduler(
+            max_batch=max_batch, pages_per_slot=pages_per_slot,
+            pool=self.pool, max_queue=max_queue,
+            max_prompt_len=max_bucket)
+        self.metrics = ServingMetrics()
+
+        pools = self._mod.init_serving_pages(cfg, total_pages, page_size)
+        self._kp, self._vp = pools["k_pages"], pools["v_pages"]
+        import jax
+        self._jnp = jax.numpy
+        self._prefill_jit, self._decode_jit, self._block_jit = \
+            _jit_step_fns(self._mod, cfg, attn_impl)
+        self._jax = jax
+
+        self._cur_tok = np.zeros((max_batch,), np.int32)
+        self._produced = np.zeros((max_batch,), np.int64)
+        self._keys = [None] * max_batch  # per-slot PRNG key (sampling)
+
+        self._cond = threading.Condition()
+        self._tick_lock = threading.Lock()
+        self._closing = False
+        self._drain = True
+        self._dead: Optional[BaseException] = None
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="serving-engine")
+        self._worker.start()
+
+    # --------------------------------------------------------------- API ----
+    def submit(self, prompt, max_new_tokens: int, *,
+               eos_token_id: Optional[int] = None,
+               timeout: Optional[float] = None,
+               temperature: float = 0.0, seed: int = 0) -> RequestHandle:
+        """Queue one request; returns a streaming handle. Raises
+        RuntimeError when the request is REJECTED (queue full, or its
+        prompt/page budget can never fit this engine)."""
+        if self._dead is not None:
+            raise RuntimeError("engine worker died") from self._dead
+        deadline = None if timeout is None else time.monotonic() + timeout
+        req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
+                      deadline_s=deadline, temperature=temperature,
+                      seed=seed)
+        self.metrics.inc("submitted")
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("ServingEngine is closed")
+            ok = self.scheduler.submit(req)
+            if ok:
+                self._cond.notify_all()
+        if ok and self._dead is not None and not req.done.is_set():
+            # the worker died between our liveness check and the
+            # enqueue: _fail_all may have drained the queue already, so
+            # nothing would ever resolve this handle — fail it here.
+            # (done.is_set() guards the other interleaving: the worker
+            # served this request COMPLETELY and died later — that
+            # success must not be clobbered to CANCELLED)
+            req.error = self._dead
+            req.finish(CANCELLED)
+            raise RuntimeError("engine worker died") from self._dead
+        if not ok:
+            req.state = REJECTED
+            self.metrics.inc("rejected")
+            raise RuntimeError(
+                f"request rejected: prompt {req.prompt.size} tokens + "
+                f"{req.max_new_tokens} new needs "
+                f"{self.scheduler.pages_needed(req)} pages "
+                f"(slot budget {self.scheduler.pages_per_slot}, max "
+                f"prompt {self.scheduler.max_prompt_len}) or queue full")
+        return RequestHandle(req)
+
+    def generate(self, prompt, max_new_tokens: int, **kw) -> np.ndarray:
+        """Blocking convenience: submit + wait; returns the generated
+        tokens (no prompt prefix, same contract as generate_paged)."""
+        return self.submit(prompt, max_new_tokens, **kw).result()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop admission and shut down. drain=True finishes every
+        queued + running request first; drain=False cancels them."""
+        with self._cond:
+            if self._dead is not None and not self._worker.is_alive():
+                return
+            self._closing = True
+            self._drain = drain
+            self._cond.notify_all()
+        self._worker.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def stats(self) -> dict:
+        """Plain-dict metrics snapshot (+ live pool/queue gauges)."""
+        snap = self.metrics.snapshot()
+        snap["gauges"] = {
+            "queued": self.scheduler.queued(),
+            "occupancy": self.scheduler.occupancy,
+            "page_utilization": self.pool.utilization,
+            "free_pages": self.pool.free_pages,
+        }
+        return snap
+
+    def defragment(self) -> int:
+        """Compact live pages to the pool's low indices (the paged-KV
+        defrag hook): rewrites the pool arrays + every live slot's table
+        row, then commits the plan to the allocator. Returns the number
+        of pages moved. Safe mid-generation (serialized against ticks)."""
+        with self._tick_lock:
+            plan = self.pool.defrag_plan()
+            if not plan:
+                return 0
+            self._kp, self._vp, tables = apply_defrag(
+                plan, self._kp, self._vp, self.scheduler.tables)
+            # np.array (not asarray): the jnp result is a zero-copy
+            # READ-ONLY view, and retire()/admit() write tables in place
+            self.scheduler.tables = np.array(tables, np.int32)
+            self.scheduler.remap_pages(plan)  # per-request page LISTS
+            self.pool.commit_defrag(plan)
+            return len(plan)
+
+    # ------------------------------------------------------------ worker ----
+    def _sample(self, slot: int, req: Request, logits_row: np.ndarray) -> int:
+        if req.temperature == 0.0:
+            return int(np.argmax(logits_row))
+        from ..models.llama import sample_logits
+        if self._keys[slot] is None:
+            self._keys[slot] = self._jax.random.PRNGKey(req.seed)
+        self._keys[slot], sub = self._jax.random.split(self._keys[slot])
+        tok = sample_logits(self._jnp.asarray(logits_row)[None], sub,
+                            req.temperature)
+        return int(tok[0])
+
+    def _emit(self, slot: int, req: Request, tok: int) -> bool:
+        """Stream one token; returns True when the request just
+        finished (EOS or max_new_tokens)."""
+        now = time.monotonic()
+        if req.first_token_t is None:
+            req.first_token_t = now
+            self.metrics.observe("ttft_s", now - req.submit_t)
+        req.tokens.append(tok)
+        req.stream.put(tok)
+        self._produced[slot] += 1
+        self.metrics.inc("tokens_out")
+        done = (self._produced[slot] >= req.max_new_tokens
+                or (req.eos_token_id is not None
+                    and tok == req.eos_token_id))
+        return bool(done)
+
+    def _retire(self, slot: int, state: str) -> None:
+        self.scheduler.retire(slot, state)
+        self._cur_tok[slot] = 0
+        self._produced[slot] = 0
+        self._keys[slot] = None
+        self.metrics.inc({COMPLETED: "completed", CANCELLED: "cancelled",
+                          TIMED_OUT: "timed_out"}[state])
+
+    def _bucket(self, n: int) -> int:
+        for b in self._buckets:
+            if n <= b:
+                return b
+        raise AssertionError("submit() enforces the max bucket")
+
+    def _prefill(self, slot: int, req: Request) -> None:
+        n = req.prompt.size
+        tb = self._bucket(n)
+        padded = np.zeros((1, tb), np.int32)
+        padded[0, :n] = req.prompt
+        jnp = self._jnp
+        with RecordEvent("serving.prefill"):
+            logits, self._kp, self._vp = self._prefill_jit(
+                self._params, jnp.asarray(padded), jnp.int32(n),
+                jnp.asarray(self.scheduler.tables[slot]), self._kp,
+                self._vp)
+            logits = np.asarray(logits)
+        self.metrics.inc("prefills")
+        self.scheduler.lengths[slot] = n
+        tok = self._sample(slot, req, logits)
+        self._cur_tok[slot] = tok
+        if self._emit(slot, req, tok):
+            self._retire(slot, COMPLETED)
+
+    def _block_steps(self, live) -> int:
+        """Fused steps for this tick: the full block size whenever every
+        live request is greedy (the block path samples in-graph argmax),
+        else 1. Always the FULL block — capping at the remaining tokens
+        would compile one program per distinct cap; at worst K-1 cheap
+        steps run past the last retirement and their tokens are
+        discarded (budget overruns land on the trash page)."""
+        if self._decode_block <= 1:
+            return 1
+        if any(r.temperature != 0.0 for _, r in live):
+            return 1
+        return self._decode_block
+
+    def _decode_tick(self) -> None:
+        jnp = self._jnp
+        live = self.scheduler.live()
+        k = self._block_steps(live)
+        t0 = time.perf_counter()
+        with RecordEvent("serving.decode_step"):
+            if k == 1:
+                logits, self._kp, self._vp = self._decode_jit(
+                    self._params, jnp.asarray(self._cur_tok),
+                    jnp.asarray(self.scheduler.lengths),
+                    jnp.asarray(self.scheduler.tables), self._kp,
+                    self._vp)
+                toks = np.asarray(logits)  # [S, V]: sampled below
+            else:
+                toks, self._kp, self._vp = self._block_jit(
+                    self._params, jnp.asarray(self._cur_tok),
+                    jnp.asarray(self.scheduler.lengths),
+                    jnp.asarray(self.scheduler.tables), self._kp,
+                    self._vp, num_steps=k)
+                toks = np.asarray(toks)    # [S, k] greedy tokens
+        self.metrics.inc("decode_steps", k)
+        self.metrics.observe("decode_step_s",
+                             (time.perf_counter() - t0) / k)
+        for slot, req in live:
+            self.scheduler.lengths[slot] += k  # block's KV just landed
+            for j in range(k):
+                tok = (self._sample(slot, req, toks[slot]) if k == 1
+                       else int(toks[slot, j]))
+                self._cur_tok[slot] = tok
+                if self._emit(slot, req, tok):
+                    self._retire(slot, COMPLETED)
+                    break
+
+    def _sweep(self, now: float) -> None:
+        """Apply cancellations + deadlines to queued and live requests."""
+        for r in self.scheduler.drop_queued(
+                lambda r: r.cancel_flag or r.expired(now)):
+            state = CANCELLED if r.cancel_flag else TIMED_OUT
+            r.finish(state)
+            self.metrics.inc("cancelled" if r.cancel_flag else "timed_out")
+        for slot, req in self.scheduler.live():
+            if req.cancel_flag:
+                self._retire(slot, CANCELLED)
+            elif req.expired(now):
+                self._retire(slot, TIMED_OUT)
+
+    def _loop(self) -> None:
+        try:
+            while True:
+                with self._tick_lock:
+                    now = time.monotonic()
+                    self._sweep(now)
+                    if self._closing and not self._drain:
+                        break
+                    with RecordEvent("serving.admit"):
+                        admitted = self.scheduler.admit()
+                    for slot, req in admitted:
+                        self.metrics.inc("admitted")
+                        self.metrics.observe("queue_wait_s",
+                                             req.admit_t - req.submit_t)
+                        self._prefill(slot, req)
+                    live = self.scheduler.live()
+                    self.metrics.observe("batch_occupancy",
+                                         self.scheduler.occupancy)
+                    self.metrics.observe("page_utilization",
+                                         self.pool.utilization)
+                    ticked = bool(live)
+                    if live:
+                        self._decode_tick()
+                if ticked:
+                    # pace OUTSIDE the tick lock: sleeping inside it
+                    # starves defragment() (python locks are unfair)
+                    if self._tick_interval:
+                        time.sleep(self._tick_interval)
+                    continue
+                # idle: nothing live — wait for work or shutdown
+                with self._cond:
+                    if self.scheduler.queued():
+                        continue
+                    if self._closing:
+                        break
+                    self._cond.wait(timeout=0.05)
+        except BaseException as e:  # fail every caller, then surface
+            self._dead = e
+            self._fail_all(e)
+            raise
+        finally:
+            # post-drain (or cancel-close): flush whatever remains
+            for r in self.scheduler.drop_queued(lambda r: True):
+                r.finish(CANCELLED)
+                self.metrics.inc("cancelled")
+            for slot, req in self.scheduler.live():
+                self._retire(slot, CANCELLED)
+
+    def _fail_all(self, e: BaseException) -> None:
+        for r in self.scheduler.drop_queued(lambda r: True):
+            r.error = e
+            r.finish(CANCELLED)
+        for slot, req in self.scheduler.live():
+            req.error = e
+            self.scheduler.retire(slot, CANCELLED)

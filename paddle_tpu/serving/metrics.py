@@ -1,0 +1,88 @@
+"""Serving-engine metrics: counters + histograms as plain dicts.
+
+Reference capability: the inference product's serving monitors
+(request/batch counters the AnalysisPredictor frontends export). The
+engine records every observation here; ``snapshot()`` returns a plain
+dict so any exporter (logging, JSON endpoint, test assertion) can
+consume it without a metrics dependency. Host spans additionally ride
+``profiler.RecordEvent`` (engine.py), so prefill/decode ticks show up
+in device traces and ``profiler.host_statistics()``.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import numpy as np
+
+__all__ = ["Histogram", "ServingMetrics"]
+
+
+class Histogram:
+    """Bounded-reservoir histogram: exact percentiles over the last
+    ``cap`` observations (serving runs are minutes, not months — a
+    65k-deep window is exact in practice and keeps summary() trivial).
+    The window is a deque(maxlen): O(1) per observation on the decode
+    hot path, not an O(cap) list memmove once the window fills."""
+
+    def __init__(self, cap: int = 65536):
+        from collections import deque
+        self._vals: "deque" = deque(maxlen=int(cap))
+        self._count = 0
+        self._sum = 0.0
+
+    def observe(self, v: float) -> None:
+        v = float(v)
+        self._count += 1
+        self._sum += v
+        self._vals.append(v)
+
+    def summary(self) -> Dict[str, float]:
+        if not self._vals:
+            return {"count": 0, "mean": 0.0, "p50": 0.0, "p99": 0.0,
+                    "max": 0.0}
+        a = np.asarray(self._vals)
+        return {"count": self._count,
+                "mean": self._sum / self._count,
+                "p50": float(np.percentile(a, 50)),
+                "p99": float(np.percentile(a, 99)),
+                "max": float(a.max())}
+
+
+class ServingMetrics:
+    """Counters + histograms for the continuous-batching engine.
+
+    Counters: request lifecycle (submitted/admitted/completed/cancelled/
+    timed_out/rejected), work units (prefills, decode_steps, tokens_out).
+    Histograms: queue_wait_s (submit -> admission), ttft_s (submit ->
+    first token), decode_step_s (one engine tick), batch_occupancy (live
+    slots / max_batch per tick), page_utilization (used / allocatable
+    pages, sampled per tick).
+    """
+
+    COUNTERS = ("submitted", "admitted", "completed", "cancelled",
+                "timed_out", "rejected", "prefills", "decode_steps",
+                "tokens_out")
+    HISTOGRAMS = ("queue_wait_s", "ttft_s", "decode_step_s",
+                  "batch_occupancy", "page_utilization")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters = {k: 0 for k in self.COUNTERS}
+        self.histograms = {k: Histogram() for k in self.HISTOGRAMS}
+
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def observe(self, name: str, v: float) -> None:
+        with self._lock:
+            self.histograms[name].observe(v)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Plain-dict export: {'counters': {...}, 'histograms':
+        {name: {count, mean, p50, p99, max}}}."""
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "histograms": {k: h.summary()
+                                   for k, h in self.histograms.items()}}

@@ -1,0 +1,270 @@
+"""Continuous-batching scheduler: admission queue + slot/page budgets.
+
+Reference capability: the serving layer's block manager + request
+scheduler behind block_multihead_attention (requests admitted as blocks
+free up, retired sequences release their blocks immediately). Redesigned
+host-side: the decode batch is a FIXED array of ``max_batch`` slots (so
+the jitted decode step compiles once), pages come from the paged-KV
+``PagePool`` free list, and admission is page-budget-aware — a request
+is admitted only when a slot AND all pages its full generation can touch
+(prompt + max_new_tokens) are available, so a running sequence can never
+hit pool exhaustion mid-flight. The queue is strict FIFO: when the head
+does not fit, nothing overtakes it (no starvation of big requests).
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..inference.paged_kv import PagePool
+
+__all__ = ["Request", "RequestHandle", "Scheduler",
+           "QUEUED", "RUNNING", "COMPLETED", "CANCELLED", "TIMED_OUT",
+           "REJECTED"]
+
+QUEUED = "queued"
+RUNNING = "running"
+COMPLETED = "completed"
+CANCELLED = "cancelled"
+TIMED_OUT = "timed_out"
+REJECTED = "rejected"
+
+_END = object()  # stream sentinel
+_ids = itertools.count()
+
+
+class Request:
+    """One generation request's full lifecycle state (engine-internal;
+    callers hold the RequestHandle)."""
+
+    __slots__ = ("id", "prompt", "max_new_tokens", "eos_token_id",
+                 "deadline_s", "temperature", "seed", "state", "tokens",
+                 "submit_t", "admit_t", "first_token_t", "finish_t",
+                 "slot", "pages", "cancel_flag", "stream", "done",
+                 "error")
+
+    def __init__(self, prompt, max_new_tokens: int,
+                 eos_token_id: Optional[int] = None,
+                 deadline_s: Optional[float] = None,
+                 temperature: float = 0.0, seed: int = 0):
+        self.id = next(_ids)
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self.prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(f"max_new_tokens must be >= 1, "
+                             f"got {max_new_tokens}")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_token_id = eos_token_id
+        # absolute monotonic completion deadline (None = never)
+        self.deadline_s = deadline_s
+        self.temperature = float(temperature)
+        self.seed = int(seed)
+        self.state = QUEUED
+        self.tokens: List[int] = []
+        self.submit_t = time.monotonic()
+        self.admit_t: Optional[float] = None
+        self.first_token_t: Optional[float] = None
+        self.finish_t: Optional[float] = None
+        self.slot: Optional[int] = None
+        self.pages: List[int] = []
+        self.cancel_flag = False
+        self.stream: "queue.Queue" = queue.Queue()
+        self.done = threading.Event()
+        self.error: Optional[BaseException] = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_s is not None and now > self.deadline_s
+
+    def finish(self, state: str) -> None:
+        self.state = state
+        self.finish_t = time.monotonic()
+        self.stream.put(_END)
+        self.done.set()
+
+
+class RequestHandle:
+    """Caller-side view: a token stream + a blocking result.
+
+    Iterating yields tokens as the engine produces them; ``result()``
+    blocks until the request retires and returns the full continuation
+    (possibly shorter than max_new_tokens on EOS/cancel/timeout).
+    """
+
+    def __init__(self, req: Request):
+        self._req = req
+
+    @property
+    def id(self) -> int:
+        return self._req.id
+
+    @property
+    def status(self) -> str:
+        return self._req.state
+
+    @property
+    def tokens_so_far(self) -> List[int]:
+        return list(self._req.tokens)
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """submit -> first streamed token, seconds (None before then)."""
+        if self._req.first_token_t is None:
+            return None
+        return self._req.first_token_t - self._req.submit_t
+
+    def __iter__(self):
+        while True:
+            t = self._req.stream.get()
+            if t is _END:
+                # re-arm the sentinel: a second iteration (or a late
+                # iterator started after completion) must terminate
+                # instead of blocking on the drained queue forever
+                self._req.stream.put(_END)
+                return
+            yield t
+
+    def cancel(self) -> None:
+        """Request cancellation; the engine retires the slot (freeing its
+        pages) at the next tick. Idempotent; no-op once finished."""
+        self._req.cancel_flag = True
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block until retirement; returns the generated tokens
+        (int32 1-D). Raises on engine-side errors."""
+        if not self._req.done.wait(timeout):
+            raise TimeoutError(
+                f"request {self._req.id} not finished after {timeout}s")
+        if self._req.error is not None:
+            raise self._req.error
+        return np.asarray(self._req.tokens, np.int32)
+
+
+class Scheduler:
+    """Slot + page bookkeeping for the engine's fixed decode batch.
+
+    Not thread-safe by itself — the engine serializes all calls on its
+    worker thread (submit() is the one cross-thread entry and only
+    touches the locked queue).
+    """
+
+    def __init__(self, *, max_batch: int, pages_per_slot: int,
+                 pool: PagePool, max_queue: Optional[int] = None,
+                 max_prompt_len: Optional[int] = None):
+        self.max_batch = int(max_batch)
+        self.pages_per_slot = int(pages_per_slot)
+        self.pool = pool
+        self.max_queue = max_queue
+        self.max_prompt_len = max_prompt_len
+        self._lock = threading.Lock()
+        self._queue: "deque[Request]" = deque()
+        self.slots: List[Optional[Request]] = [None] * self.max_batch
+        # host-side mirrors of the jitted step's table/length operands
+        self.tables = np.zeros((self.max_batch, self.pages_per_slot),
+                               np.int32)
+        self.lengths = np.zeros((self.max_batch,), np.int32)
+
+    # ------------------------------------------------------------ queue ----
+    def pages_needed(self, req: Request) -> int:
+        # every position a full generation can write: prompt plus
+        # max_new_tokens - 1 generated tokens land in the cache (the last
+        # sampled token is never written)
+        need = req.prompt.size + req.max_new_tokens - 1
+        return self.pool.pages_for_len(need)
+
+    def submit(self, req: Request) -> bool:
+        """Enqueue; False = rejected (queue full or request can never
+        fit this engine's budgets)."""
+        # can NEVER be admitted: bigger than a slot's table or than the
+        # whole pool (accepting it would wedge the strict-FIFO queue)
+        if self.pages_needed(req) > min(self.pages_per_slot,
+                                        self.pool.total_pages - 1):
+            return False
+        if (self.max_prompt_len is not None
+                and req.prompt.size > self.max_prompt_len):
+            return False
+        with self._lock:
+            if self.max_queue is not None and len(self._queue) >= \
+                    self.max_queue:
+                return False
+            self._queue.append(req)
+        return True
+
+    def queued(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def drop_queued(self, pred) -> List[Request]:
+        """Remove queued requests matching ``pred`` (cancel/timeout
+        sweeps); returns them."""
+        with self._lock:
+            keep, dropped = deque(), []
+            for r in self._queue:
+                (dropped if pred(r) else keep).append(r)
+            self._queue = keep
+        return dropped
+
+    # ------------------------------------------------------------ slots ----
+    def live(self) -> List[Tuple[int, Request]]:
+        return [(i, r) for i, r in enumerate(self.slots) if r is not None]
+
+    @property
+    def occupancy(self) -> float:
+        return sum(r is not None for r in self.slots) / self.max_batch
+
+    def admit(self) -> List[Tuple[int, Request]]:
+        """Admit queue-head requests while a free slot AND their full
+        page budget are available (strict FIFO — a head that does not
+        fit blocks the queue rather than being overtaken forever)."""
+        admitted = []
+        while True:
+            free = [i for i, r in enumerate(self.slots) if r is None]
+            if not free:
+                break
+            with self._lock:
+                if not self._queue:
+                    break
+                head = self._queue[0]
+                if not self.pool.can_alloc(self.pages_needed(head)):
+                    break
+                self._queue.popleft()
+            slot = free[0]
+            head.pages = self.pool.alloc(self.pages_needed(head))
+            head.slot = slot
+            head.admit_t = time.monotonic()
+            head.state = RUNNING
+            self.slots[slot] = head
+            self.tables[slot, :] = PagePool.TRASH
+            self.tables[slot, :len(head.pages)] = head.pages
+            self.lengths[slot] = 0  # set to prompt len after prefill
+            admitted.append((slot, head))
+        return admitted
+
+    def retire(self, slot: int, state: str) -> Request:
+        """Free the slot + its pages immediately; mark the request."""
+        req = self.slots[slot]
+        assert req is not None
+        self.pool.free(req.pages)
+        req.pages = []
+        self.slots[slot] = None
+        self.tables[slot, :] = PagePool.TRASH
+        self.lengths[slot] = 0
+        req.finish(state)
+        return req
+
+    def remap_pages(self, mapping: Dict[int, int]) -> None:
+        """Apply a defrag plan to every live request's page LIST. The
+        table rows must NOT be remapped here: ``apply_defrag`` already
+        rewrote them alongside the pool arrays, and remapping twice
+        corrupts chained plans (e.g. {2:1, 5:2} would send a row entry
+        5 -> 2 -> 1 while its KV moved to slot 2)."""
+        if not mapping:
+            return
+        for _, req in self.live():
+            req.pages = [mapping.get(p, p) for p in req.pages]

@@ -21,6 +21,12 @@ import jax
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running tier-2 benchmarks (tier-1 runs -m 'not slow')")
+
+
 @pytest.fixture(autouse=True)
 def _seed_all():
     import paddle_tpu as pt

@@ -1,0 +1,152 @@
+"""Round-5 advisor findings, pinned.
+
+- jit/segments._fn_cache_key must key default args (a factory's
+  ``def f(x, y=s)`` capture) like closure cells — ADVICE r5 low.
+- auto_parallel Engine pp must refuse models whose forward diverges
+  from the definition-order unit list — ADVICE r5 medium.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as pt
+from paddle_tpu.jit.segments import _fn_cache_key
+
+
+# ---------------------------------------------------------------------------
+# _fn_cache_key: default-arg capture
+# ---------------------------------------------------------------------------
+
+def _factory(s):
+    def f(x, y=s):
+        return x + y
+    return f
+
+
+def _kw_factory(s):
+    def f(x, *, y=s):
+        return x + y
+    return f
+
+
+def test_fn_cache_key_distinguishes_default_arg_capture():
+    f1, f2 = _factory(1.0), _factory(2.0)
+    assert f1.__code__ is f2.__code__ and not f1.__closure__
+    assert _fn_cache_key(f1) != _fn_cache_key(f2)
+    # equal captures still share a key (the whole point of the cache)
+    assert _fn_cache_key(_factory(3.0)) == _fn_cache_key(_factory(3.0))
+
+
+def test_fn_cache_key_distinguishes_kwonly_default_capture():
+    f1, f2 = _kw_factory(1.0), _kw_factory(2.0)
+    assert f1.__code__ is f2.__code__
+    assert _fn_cache_key(f1) != _fn_cache_key(f2)
+    assert _fn_cache_key(_kw_factory(3.0)) == _fn_cache_key(_kw_factory(3.0))
+
+
+def test_fn_cache_key_unfreezable_default_falls_back_to_identity():
+    class Mutable:
+        pass
+
+    f1 = _factory(Mutable())  # arbitrary object: must NOT key by value
+    f2 = _factory(Mutable())
+    assert _fn_cache_key(f1) == id(f1)
+    assert _fn_cache_key(f1) != _fn_cache_key(f2)
+
+
+def test_fn_cache_key_closures_still_keyed():
+    def make(v):
+        def g(x):
+            return x * v
+        return g
+
+    assert _fn_cache_key(make(2.0)) == _fn_cache_key(make(2.0))
+    assert _fn_cache_key(make(2.0)) != _fn_cache_key(make(3.0))
+
+
+# ---------------------------------------------------------------------------
+# Engine pp: definition-order vs forward-order guard
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.distributed.auto_parallel import Engine, Strategy  # noqa
+
+
+class _Block(pt.nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = pt.nn.Linear(16, 16)
+
+    def forward(self, x):
+        return pt.nn.functional.relu(self.fc(x))
+
+
+def _mse(pred, y):
+    return ((pred - y) ** 2).mean()
+
+
+def _x(bs=4):
+    return np.random.RandomState(0).randn(bs, 16).astype(np.float32)
+
+
+def _fit_one(model):
+    opt = pt.optimizer.SGD(learning_rate=1e-2,
+                           parameters=model.parameters())
+    eng = Engine(model, loss=_mse, optimizer=opt,
+                 strategy=Strategy(pp_degree=2, num_microbatches=2))
+    return eng.fit([(_x(), np.zeros((4, 16), np.float32))], epochs=1)
+
+
+def test_pp_guard_rejects_reversed_forward_order():
+    class Reversed(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = pt.nn.Sequential(*[_Block() for _ in range(4)])
+
+        def forward(self, x):
+            for b in reversed(list(self.blocks)):
+                x = b(x)
+            return x
+
+    with pytest.raises(ValueError, match="definition order"):
+        _fit_one(Reversed())
+
+
+def test_pp_guard_rejects_extra_math_between_units():
+    class Residual(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = pt.nn.Sequential(*[_Block() for _ in range(4)])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x) + x  # glue the stage loop cannot reproduce
+            return x
+
+    with pytest.raises(ValueError, match="extra math between units"):
+        _fit_one(Residual())
+
+
+def test_pp_guard_rejects_postprocessed_output():
+    class Post(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = pt.nn.Sequential(*[_Block() for _ in range(4)])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return x * 2.0  # outside the unit list
+
+    with pytest.raises(ValueError, match="model output"):
+        _fit_one(Post())
+
+
+def test_pp_guard_accepts_plain_chain_and_prepare_sample():
+    model = pt.nn.Sequential(*[_Block() for _ in range(4)])
+    opt = pt.optimizer.SGD(learning_rate=1e-2,
+                           parameters=model.parameters())
+    eng = Engine(model, loss=_mse, optimizer=opt,
+                 strategy=Strategy(pp_degree=2, num_microbatches=2))
+    eng.prepare(sample_input=_x())  # verification at prepare() time
+    assert eng._pp_verified
+    hist = eng.fit([(_x(), np.zeros((4, 16), np.float32))], epochs=1)
+    assert np.isfinite(hist).all()

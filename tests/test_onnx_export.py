@@ -302,19 +302,31 @@ def test_export_ernie_encoder_real_onnx(tmp_path):
 
 def test_export_int_scalar_const_dtype(tmp_path):
     """ADVICE r4 (low): an integer elementwise constant must emit with
-    the tensor's dtype, not float32."""
+    the tensor's dtype, not float32. ADVICE r5 (low): the r4 version of
+    this test passed VACUOUSLY — a leaf AddOne layer hid the add inside
+    an opaque layer event, the export fell back to StableHLO, and the
+    ``if .onnx`` guard skipped every assertion. The Identity sublayer
+    makes the add a TOP-LEVEL functional op (the thing the int-const
+    fix is about), and a fallback now FAILS instead of skipping."""
     class AddOne(pt.nn.Layer):
-        def forward(self, x):
-            return x + 1
+        def __init__(self):
+            super().__init__()
+            # a sublayer so AddOne is not itself a leaf: the add then
+            # records as depth-0 functional glue instead of vanishing
+            # into an un-mappable opaque layer
+            self.out = pt.nn.Identity()
 
-    m = pt.nn.Sequential()
-    net = AddOne()
-    out = pt.onnx.export(net, str(tmp_path / "addone"),
+        def forward(self, x):
+            return self.out(x + 1)
+
+    out = pt.onnx.export(AddOne(), str(tmp_path / "addone"),
                          input_spec=[InputSpec([2, 3], dtype="int32")])
-    if out.endswith(".onnx"):
-        blob = open(out, "rb").read()
-        graph = P.fields(blob, 7)[0]
-        env = _load_inits(graph)
-        assert all(v.dtype != np.float32 for v in env.values()), env
-        got = _run_onnx(blob, np.ones((2, 3), np.int32))
-        np.testing.assert_array_equal(got, 2 * np.ones((2, 3)))
+    assert out.endswith(".onnx"), "fell back to StableHLO"
+    blob = open(out, "rb").read()
+    assert _op_types(blob)[0] == "Add"
+    graph = P.fields(blob, 7)[0]
+    env = _load_inits(graph)
+    assert env, "the scalar const must be an initializer"
+    assert all(v.dtype != np.float32 for v in env.values()), env
+    got = _run_onnx(blob, np.ones((2, 3), np.int32))
+    np.testing.assert_array_equal(got, 2 * np.ones((2, 3)))

@@ -1,0 +1,261 @@
+"""Traffic-replay serving benchmark: sequential vs DynamicBatcher vs
+the continuous-batching ServingEngine.
+
+Replays one synthetic mixed-length request trace (Poisson arrivals,
+mixed prompt lengths, mixed max_new_tokens) through three serving
+strategies over the SAME model params:
+
+  (a) sequential    — one `generate_paged` per request, in arrival
+                      order (no batching at all);
+  (b) batcher       — `inference.DynamicBatcher` whole-request ragged
+                      batching: mixed-length prompts coalesce into one
+                      paged decode, but every batch runs the GLOBAL
+                      max_new_tokens and a request's tokens only
+                      surface when the whole batch finishes;
+  (c) engine        — `serving.ServingEngine` continuous batching:
+                      per-step admission/retirement over the shared
+                      page pool, tokens streamed as decoded.
+
+Reported per mode: wall_s, useful tok/s (only each request's OWN
+requested tokens count), time-to-first-token p50/p99 (ms), and mean
+batch occupancy where defined. Acceptance (ISSUE r6): (c) beats (b) on
+aggregate tok/s AND p99 TTFT on the CPU mesh.
+
+    JAX_PLATFORMS=cpu python tools/serving_bench.py --requests 32
+"""
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from functools import partial
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def build_trace(n, rate, max_prompt, mnt_choices, seed):
+    """[(arrival_s, prompt int32[?], max_new_tokens)] sorted by arrival.
+    mnt_choices is a SMALL set so every mode compiles a bounded number
+    of programs."""
+    rng = np.random.RandomState(seed)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+    trace = []
+    for t in arrivals:
+        plen = int(rng.randint(2, max_prompt + 1))
+        prompt = rng.randint(0, 256, (plen,)).astype(np.int32)
+        trace.append((float(t), prompt, int(rng.choice(mnt_choices))))
+    return trace
+
+
+def _bucket(n, buckets):
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def _pctl(xs, q):
+    return float(np.percentile(np.asarray(xs), q)) if xs else float("nan")
+
+
+def _report(name, wall, useful, ttfts, occupancy=None):
+    out = {"mode": name, "wall_s": round(wall, 3),
+           "useful_tokens": int(useful),
+           "tok_s": round(useful / wall, 1),
+           "ttft_p50_ms": round(_pctl(ttfts, 50) * 1e3, 1),
+           "ttft_p99_ms": round(_pctl(ttfts, 99) * 1e3, 1)}
+    if occupancy is not None:
+        out["occupancy_mean"] = round(occupancy, 3)
+    return out
+
+
+class Bench:
+    def __init__(self, args):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.models import llama as L
+        self.jnp = jnp
+        self.L = L
+        self.args = args
+        self.cfg = L.LlamaConfig(
+            vocab_size=256, hidden_size=args.hidden,
+            intermediate_size=2 * args.hidden,
+            num_hidden_layers=args.layers,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=args.max_prompt + max(args.mnt_choices),
+            dtype=jnp.float32, use_flash_attention=False, remat=False)
+        self.params = L.init_params(self.cfg, jax.random.PRNGKey(0))
+        # the ENGINE's bucket policy, so every mode pads to the same
+        # shapes as the engine under test (no silent drift)
+        from paddle_tpu.serving.engine import _default_buckets
+        self.buckets = _default_buckets(args.max_prompt)
+        self.mnt_cap = max(args.mnt_choices)
+        # one jitted ragged generate per (B, Tb, mnt): shared by (a)/(b)
+        self._gen = jax.jit(
+            partial(L.generate_paged, cfg=self.cfg, page_size=args.page_size),
+            static_argnames=("max_new_tokens",))
+
+    def _pad(self, prompts):
+        lens = [len(p) for p in prompts]
+        tb = _bucket(max(lens), self.buckets)
+        out = np.zeros((len(prompts), tb), np.int32)
+        for i, p in enumerate(prompts):
+            out[i, :len(p)] = p
+        return out, np.asarray(lens, np.int32)
+
+    # ------------------------------------------------------------ modes ----
+    def run_sequential(self, trace):
+        jnp = self.jnp
+        t0 = time.perf_counter()
+        useful, ttfts = 0, []
+        for arrival, prompt, mnt in trace:
+            now = time.perf_counter() - t0
+            if now < arrival:
+                time.sleep(arrival - now)
+            padded, lens = self._pad([prompt])
+            out = self._gen(self.params, jnp.asarray(padded),
+                            jnp.asarray(lens), max_new_tokens=mnt)
+            np.asarray(out)  # block
+            ttfts.append(time.perf_counter() - t0 - arrival)
+            useful += mnt
+        return _report("sequential", time.perf_counter() - t0, useful,
+                       ttfts)
+
+    def run_batcher(self, trace):
+        """Whole-request ragged batching: the r5 serving shape. Every
+        batch decodes the GLOBAL mnt cap (the batcher cannot retire rows
+        early), and a request's TTFT is its whole batch's completion."""
+        from paddle_tpu.inference import DynamicBatcher
+        jnp = self.jnp
+        cap = self.mnt_cap
+
+        def fn(batch, lengths):
+            out = self._gen(self.params, jnp.asarray(batch),
+                            jnp.asarray(lengths), max_new_tokens=cap)
+            return np.asarray(out)
+
+        bat = DynamicBatcher(fn, max_batch_size=self.args.max_batch,
+                             max_delay_ms=self.args.batch_delay_ms,
+                             seq_buckets=self.buckets)
+        t0 = time.perf_counter()
+        done_t, lock = {}, threading.Lock()
+        futs = []
+        for i, (arrival, prompt, mnt) in enumerate(trace):
+            now = time.perf_counter() - t0
+            if now < arrival:
+                time.sleep(arrival - now)
+            fut = bat.submit(prompt)
+
+            def _mark(f, i=i):
+                with lock:
+                    done_t[i] = time.perf_counter()
+            fut.add_done_callback(_mark)
+            futs.append(fut)
+        for f in futs:
+            f.result()
+        wall = time.perf_counter() - t0
+        bat.close()
+        useful = sum(mnt for _, _, mnt in trace)
+        ttfts = [done_t[i] - t0 - trace[i][0] for i in range(len(trace))]
+        return _report("batcher", wall, useful, ttfts)
+
+    def run_engine(self, trace):
+        from paddle_tpu.serving import ServingEngine
+        a = self.args
+        eng = ServingEngine(
+            self.params, self.cfg, max_batch=a.max_batch,
+            page_size=a.page_size, max_prompt_len=a.max_prompt,
+            max_new_tokens_cap=self.mnt_cap,
+            prompt_buckets=self.buckets,
+            decode_block_size=a.decode_block)
+        t0 = time.perf_counter()
+        handles = []
+        for arrival, prompt, mnt in trace:
+            now = time.perf_counter() - t0
+            if now < arrival:
+                time.sleep(arrival - now)
+            handles.append(eng.submit(prompt, mnt))
+        outs = [h.result(timeout=600) for h in handles]
+        wall = time.perf_counter() - t0
+        snap = eng.stats()
+        eng.close()
+        useful = sum(len(o) for o in outs)
+        ttfts = [h.ttft_s for h in handles]
+        occ = snap["histograms"]["batch_occupancy"]["mean"]
+        return _report("engine", wall, useful, ttfts, occupancy=occ)
+
+    def warmup(self, modes):
+        """Compile the selected modes' program shapes outside the timed
+        runs (only theirs — the full grid is seconds of XLA compiles)."""
+        warm = [(0.0, np.arange(1, 1 + ln, dtype=np.int32) % 200, mnt)
+                for ln in self.buckets for mnt in self.args.mnt_choices]
+        if "sequential" in modes:
+            self.run_sequential(warm)
+        if "batcher" in modes:
+            # warm the (batch-bucket, seq-bucket) grid at the cap
+            jnp = self.jnp
+            bb = 1
+            while True:
+                for tb in self.buckets:
+                    padded = np.ones((bb, tb), np.int32)
+                    lens = np.full((bb,), tb, np.int32)
+                    np.asarray(self._gen(self.params, jnp.asarray(padded),
+                                         jnp.asarray(lens),
+                                         max_new_tokens=self.mnt_cap))
+                if bb >= self.args.max_batch:
+                    break
+                bb = min(bb * 2, self.args.max_batch)
+        if "engine" in modes:
+            # one prefill per prompt bucket + the decode step
+            self.run_engine([(0.0, np.ones((b,), np.int32), 2)
+                             for b in self.buckets])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--requests", type=int, default=96)
+    ap.add_argument("--rate", type=float, default=500.0,
+                    help="arrival rate, requests/sec (keep the system "
+                         "LOADED: an underloaded trace measures the "
+                         "arrival window, not serving capacity)")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--max-batch", type=int, default=8)
+    ap.add_argument("--page-size", type=int, default=8)
+    ap.add_argument("--max-prompt", type=int, default=32)
+    ap.add_argument("--mnt-choices", type=int, nargs="+",
+                    default=[4, 8, 16, 48])
+    ap.add_argument("--batch-delay-ms", type=float, default=4.0)
+    ap.add_argument("--decode-block", type=int, default=4,
+                    help="fused greedy decode steps per engine tick")
+    ap.add_argument("--layers", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--modes", nargs="+",
+                    default=["sequential", "batcher", "engine"])
+    args = ap.parse_args(argv)
+
+    bench = Bench(args)
+    trace = build_trace(args.requests, args.rate, args.max_prompt,
+                        args.mnt_choices, args.seed)
+    bench.warmup(args.modes)
+    results = {}
+    for mode in args.modes:
+        results[mode] = getattr(bench, f"run_{mode}")(list(trace))
+        print(json.dumps(results[mode]), flush=True)
+    if "engine" in results and "batcher" in results:
+        verdict = {
+            "engine_beats_batcher_tok_s":
+                results["engine"]["tok_s"] > results["batcher"]["tok_s"],
+            "engine_beats_batcher_ttft_p99":
+                results["engine"]["ttft_p99_ms"]
+                < results["batcher"]["ttft_p99_ms"],
+        }
+        print(json.dumps(verdict), flush=True)
+        results["verdict"] = verdict
+    return results
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])
