@@ -114,8 +114,8 @@ def main():
         B, T, iters = 4, 64, 4
         deep_cfg, deep_B, deep_iters = None, 0, 0
 
-    decode_tok_s = None
-    paged_tok_s = dense_batch_tok_s = None
+    decode_tok_s = decode_int8_tok_s = None
+    paged_tok_s = dense_batch_tok_s = paged_int8_tok_s = None
     deep = {}
     hm = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
     with hm.mesh:
@@ -136,6 +136,19 @@ def main():
             out = gen(state["params"], prompt)
             int(out[0, -1])  # host sync
             decode_tok_s = gen_new / (time.perf_counter() - t0)
+
+            # weight-only int8 decode (quantization/decode.py): same
+            # model, projections+lm_head stored int8 + per-channel f32
+            # scales — decode is weight-bandwidth-bound, so this halves
+            # the dominant byte stream (docs/PERF.md decode section)
+            from paddle_tpu.quantization.decode import quantize_for_decode
+            qparams = quantize_for_decode(state["params"], cfg)
+            out = gen(qparams, prompt)
+            int(out[0, -1])
+            t0 = time.perf_counter()
+            out = gen(qparams, prompt)
+            int(out[0, -1])
+            decode_int8_tok_s = gen_new / (time.perf_counter() - t0)
 
             # batched MIXED-LENGTH decode: paged KV (block tables, pallas
             # paged_attention) vs the dense cache padded to max length.
@@ -180,8 +193,20 @@ def main():
             paged_tok_s = rate2(paged_for)
             dense_batch_tok_s = rate2(dense_for)
 
+            def paged_int8_for(n):
+                fn = jax.jit(partial(L.generate_paged, cfg=cfg,
+                                     max_new_tokens=n, page_size=32,
+                                     attn_impl="pallas"))
+                return lambda: fn(qparams, pad_prompt, lens_arr)
+
+            paged_int8_tok_s = rate2(paged_int8_for)
+
         if deep_cfg is not None:
             del state  # free the flagship's HBM before the deep compile
+            if on_tpu:
+                # the int8 flagship copy (~1.7 GB) must not stay
+                # resident through the deep model's compile/steps either
+                del qparams, paged_int8_for
             d_dt, d_loss, d_state = measure_step(
                 deep_cfg, deep_B, T, deep_iters, hm.mesh, L)
             del d_state
@@ -201,8 +226,12 @@ def main():
         "tokens_per_sec": round(B * T / dt, 1),
         "decode_tokens_per_sec": (round(decode_tok_s, 1)
                                   if decode_tok_s else None),
+        "decode_int8_tokens_per_sec": (round(decode_int8_tok_s, 1)
+                                       if decode_int8_tok_s else None),
         "paged_decode_tokens_per_sec": (round(paged_tok_s, 1)
                                         if paged_tok_s else None),
+        "paged_decode_int8_tokens_per_sec": (
+            round(paged_int8_tok_s, 1) if paged_int8_tok_s else None),
         "dense_batch_decode_tokens_per_sec": (
             round(dense_batch_tok_s, 1) if dense_batch_tok_s else None),
         "step_ms": round(dt * 1e3, 2),

@@ -161,32 +161,90 @@ def restart_attempt() -> int:
     return int(os.environ.get("PADDLE_RESTART_ATTEMPT", "0"))
 
 
+# dropped into the checkpoint root by process 0; a non-zero process that
+# can SEE it is looking at the same (shared) filesystem as process 0
+_SHARED_ROOT_MARKER = ".ckpt_root_written_by_process0"
+
+
+def _root_is_shared(root: str) -> bool:
+    """Whether this process's view of ``root`` is process 0's storage.
+    Process 0's answer is trivially True; other processes answer by
+    visibility of the marker process 0 drops before every save."""
+    if jax.process_index() == 0:
+        return True
+    return os.path.exists(os.path.join(os.path.abspath(root),
+                                       _SHARED_ROOT_MARKER))
+
+
+def _prune_old_steps(root: str, step: int, keep: int) -> None:
+    import shutil
+    # only steps strictly OLDER than the current save are candidates:
+    # with async_save the current step may not be committed yet (so
+    # checkpoint_steps misses it), and racing its tmp-dir commit
+    # would corrupt the newest checkpoint
+    older = sorted(s_p for s_p in checkpoint_steps(root)
+                   if s_p[0] < int(step))
+    n_keep_older = keep - 1  # the current step occupies one keep slot
+    doomed = older[:-n_keep_older] if n_keep_older > 0 else older
+    for s, p in doomed:
+        shutil.rmtree(p, ignore_errors=True)
+
+
 def save_checkpoint(state_dict: Dict[str, Any], root: str, step: int,
-                    keep: Optional[int] = None, async_save: bool = False):
+                    keep: Optional[int] = None, async_save: bool = False,
+                    shared_root: Optional[bool] = None):
     """Save ``state_dict`` under ``root/step_<step>``; with ``keep``,
     prune all but the newest ``keep`` completed steps.
 
-    Pruning runs on process 0 only (every process rmtree-ing the shared
-    directory concurrently races), counts the just-scheduled step even
-    when an async save has not committed it yet, and never touches steps
-    >= the current one (an in-flight async commit must survive)."""
+    Pruning never touches steps >= the current one (an in-flight async
+    commit must survive) and counts the just-scheduled step even when an
+    async save has not committed it yet. WHO prunes depends on the
+    storage layout:
+
+      * shared root (one filesystem all hosts see — GCS/NFS): process 0
+        only; every process rmtree-ing the same directory concurrently
+        races.
+      * per-host private roots (node-local SSD): every process prunes
+        its own root — otherwise non-zero hosts' local dirs grow
+        without bound.
+
+    ``shared_root``: True/False forces a layout; None (default)
+    auto-detects per process — process 0 drops a marker file in the
+    root before the save (``save_state_dict`` returns on a non-zero
+    process only after the cross-process save completes, so by then a
+    shared root shows the marker), and a non-zero process that cannot
+    see the marker concludes its root is private and prunes it.
+    Detection worst case (marker-visibility lag on NFS-style attribute
+    caching, or a marker-write failure, on a genuinely shared root):
+    several processes prune CONCURRENTLY — but they compute the same
+    strictly-older doomed set, kept steps are never in it, and a
+    half-removed doomed dir is re-pruned on the next save, so the
+    damage is bounded at transient remnants of already-condemned
+    steps. Hosts where that is unacceptable should pass
+    ``shared_root=True`` explicitly."""
     if keep is not None and keep < 1:
         raise ValueError(f"keep must be >= 1, got {keep} "
                          "(keep=0 would prune nothing, silently)")
-    path = os.path.join(os.path.abspath(root), f"step_{int(step)}")
-    out = save_state_dict(state_dict, path, async_save=async_save)
+    root_abs = os.path.abspath(root)
+    path = os.path.join(root_abs, f"step_{int(step)}")
     if keep is not None and jax.process_index() == 0:
-        import shutil
-        # only steps strictly OLDER than the current save are candidates:
-        # with async_save the current step may not be committed yet (so
-        # checkpoint_steps misses it), and racing its tmp-dir commit
-        # would corrupt the newest checkpoint
-        older = sorted(s_p for s_p in checkpoint_steps(root)
-                       if s_p[0] < int(step))
-        n_keep_older = keep - 1  # the current step occupies one keep slot
-        doomed = older[:-n_keep_older] if n_keep_older > 0 else older
-        for s, p in doomed:
-            shutil.rmtree(p, ignore_errors=True)
+        try:
+            os.makedirs(root_abs, exist_ok=True)
+            with open(os.path.join(root_abs, _SHARED_ROOT_MARKER),
+                      "w") as f:
+                f.write("presence of this file on another host means "
+                        "the checkpoint root is shared storage\n")
+        except OSError:
+            # best-effort: an unwritable root means non-zero processes
+            # see no marker and prune as if private — bounded to a
+            # concurrent delete of the same doomed set (docstring)
+            pass
+    out = save_state_dict(state_dict, path, async_save=async_save)
+    if keep is not None:
+        shared = _root_is_shared(root) if shared_root is None else \
+            bool(shared_root)
+        if jax.process_index() == 0 or not shared:
+            _prune_old_steps(root, step, keep)
     return out
 
 

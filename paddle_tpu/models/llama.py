@@ -174,6 +174,16 @@ def shard_params(params, cfg: LlamaConfig, mesh: Mesh):
 # model math
 # ---------------------------------------------------------------------------
 
+def _mm(x, w):
+    """``x @ w`` for a dense weight or an ``Int8Weight`` (the weight-only
+    int8 decode path, quantization/decode.py): the per-channel dequant is
+    fused into the matmul (ops/fused/int8_matmul). Dense weights — the
+    training path — take the plain-``@`` branch, so nothing changes for
+    them."""
+    dm = getattr(w, "dequant_matmul", None)
+    return x @ w if dm is None else dm(x)
+
+
 def rms_norm(x, weight, eps):
     dt = x.dtype
     x = x.astype(jnp.float32)
@@ -328,23 +338,24 @@ def _block(lp, h, positions, cfg: LlamaConfig, attn_fn, sp_spec=None,
     else:
         rope_fn = lambda q, k: rope(q, k, positions, cfg.rope_theta, Dh)
     x = norm(h, lp["attn_norm"])
-    q = (x @ lp["wq"]).reshape(B, T, H, Dh)
-    k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
-    v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
+    q = _mm(x, lp["wq"]).reshape(B, T, H, Dh)
+    k = _mm(x, lp["wk"]).reshape(B, T, Hkv, Dh)
+    v = _mm(x, lp["wv"]).reshape(B, T, Hkv, Dh)
     q, k = rope_fn(q, k)
     o = attn_fn(q, k, v)
     # tag for remat policies: lets a save_only_these_names policy keep the
     # kernel output so backward recompute skips the flash forward (the
     # default bench path uses plain per-layer remat, measured faster)
     o = checkpoint_name(o, "attn_out")
-    h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
+    h = h + _mm(o.reshape(B, T, H * Dh), lp["wo"])
     if sp_spec is not None:
         # sequence-parallel residual stream: reduce-scatter the row-parallel
         # output over tp along the seq dim (sequence_parallel_utils.py:427)
         h = lax.with_sharding_constraint(h, sp_spec)
 
     x = norm(h, lp["mlp_norm"])
-    h = h + (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"])) @ lp["w_down"]
+    h = h + _mm(jax.nn.silu(_mm(x, lp["w_gate"])) * _mm(x, lp["w_up"]),
+                lp["w_down"])
     if sp_spec is not None:
         h = lax.with_sharding_constraint(h, sp_spec)
     return h
@@ -449,7 +460,7 @@ def forward(params, tokens, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
     else:
         fused_fin = _fused_nr_on(cfg, mesh)
     h = _norm_fn(cfg, mesh, fused_fin, fin_spec)(h, params["final_norm"])
-    return h @ params["lm_head"]
+    return _mm(h, params["lm_head"])
 
 
 def _split_stages(layer_params, cfg: LlamaConfig):
@@ -490,7 +501,7 @@ def forward_pipelined(params, tokens, cfg: LlamaConfig, mesh: Mesh):
                       num_stages=cfg.pp_stages, remat=False)
     h = h.reshape((-1,) + h.shape[2:])                     # [B, T, D]
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
-    return h @ params["lm_head"]
+    return _mm(h, params["lm_head"])
 
 
 # ---------------------------------------------------------------------------
@@ -725,7 +736,7 @@ def forward_with_cache(params, tokens, cache, pos0, cfg: LlamaConfig):
     h, (ck_new, cv_new) = lax.scan(
         body, h, (params["layers"], cache["k"], cache["v"]))
     h = rms_norm(h[:, -1], params["final_norm"], cfg.rms_norm_eps)
-    logits = h @ params["lm_head"]
+    logits = _mm(h, params["lm_head"])
     return logits.astype(jnp.float32), {"k": ck_new, "v": cv_new}
 
 
@@ -857,7 +868,7 @@ def prefill_paged(params, tokens, lengths, cfg: LlamaConfig,
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     idx = jnp.maximum(lengths - 1, 0)[:, None, None]
     h_last = jnp.take_along_axis(h, idx, axis=1)[:, 0]     # [B, D]
-    logits = h_last @ params["lm_head"]
+    logits = _mm(h_last, params["lm_head"])
     L = cfg.num_hidden_layers
     nt = max(max_new_tokens, 1)
     cache = {"k_pages": k_pages, "v_pages": v_pages,
@@ -904,7 +915,7 @@ def _decode_paged_step(params, tok, cache, cfg: LlamaConfig,
         body, h, (params["layers"], cache["k_pages"], cache["v_pages"],
                   cache["k_tail"], cache["v_tail"]))
     h = rms_norm(h[:, 0], params["final_norm"], cfg.rms_norm_eps)
-    logits = h @ params["lm_head"]
+    logits = _mm(h, params["lm_head"])
     cache = dict(cache, k_tail=kt_new, v_tail=vt_new, n_tail=n + 1)
     return logits.astype(jnp.float32), cache
 
@@ -1016,7 +1027,7 @@ def serving_prefill(params, tokens, length, table, k_pages, v_pages, cfg,
     h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
     idx = jnp.maximum(lengths - 1, 0)[:, None, None]
     h_last = jnp.take_along_axis(h, idx, axis=1)[:, 0]
-    logits = h_last @ params["lm_head"]
+    logits = _mm(h_last, params["lm_head"])
     return logits[0].astype(jnp.float32), kp_new, vp_new
 
 
@@ -1057,7 +1068,7 @@ def serving_decode_step(params, tok, lengths, tables, k_pages, v_pages,
     h, (kp_new, vp_new) = lax.scan(body, h, (params["layers"], k_pages,
                                              v_pages))
     h = rms_norm(h[:, 0], params["final_norm"], cfg.rms_norm_eps)
-    logits = h @ params["lm_head"]
+    logits = _mm(h, params["lm_head"])
     return logits.astype(jnp.float32), kp_new, vp_new
 
 

@@ -23,7 +23,16 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..incubate.moe.functional import moe_ffn
-from .llama import rms_norm, rope
+from .llama import _mm, rms_norm, rope
+
+
+def _dense_w(w, dtype):
+    """Dense view of a weight that may be an Int8Weight: the einsum-
+    dispatched MoE FFN consumes full expert tensors, so quantized
+    experts are dequantized here and XLA fuses the int8→dtype cast +
+    per-channel scale into the dispatch einsums (the HBM read — the
+    thing int8 halves — is still of the int8 buffer)."""
+    return w.dequant(dtype) if hasattr(w, "dequant") else w
 
 
 @dataclasses.dataclass
@@ -292,23 +301,24 @@ def _decode_block(lp, h, positions, cfg: Qwen2MoeConfig, attn_fn):
     H, Hkv, Dh = (cfg.num_attention_heads, cfg.num_key_value_heads,
                   cfg.head_dim)
     x = rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps)
-    q = (x @ lp["wq"]).reshape(B, T, H, Dh)
-    k = (x @ lp["wk"]).reshape(B, T, Hkv, Dh)
-    v = (x @ lp["wv"]).reshape(B, T, Hkv, Dh)
+    q = _mm(x, lp["wq"]).reshape(B, T, H, Dh)
+    k = _mm(x, lp["wk"]).reshape(B, T, Hkv, Dh)
+    v = _mm(x, lp["wv"]).reshape(B, T, Hkv, Dh)
     q, k = rope(q, k, positions, cfg.rope_theta, Dh)
     o = attn_fn(q, k, v)
-    h = h + o.reshape(B, T, H * Dh) @ lp["wo"]
+    h = h + _mm(o.reshape(B, T, H * Dh), lp["wo"])
 
     x = rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps)
     nodrop_cf = cfg.num_experts / cfg.num_experts_per_tok
     routed, _ = moe_ffn(
-        x, lp["router"], lp["experts"]["w_gate"],
-        lp["experts"]["w_up"], lp["experts"]["w_down"],
+        x, lp["router"], _dense_w(lp["experts"]["w_gate"], cfg.dtype),
+        _dense_w(lp["experts"]["w_up"], cfg.dtype),
+        _dense_w(lp["experts"]["w_down"], cfg.dtype),
         top_k=cfg.num_experts_per_tok,
         capacity_factor=nodrop_cf, ep_axis=None)
     sh = lp["shared"]
-    shared = (jax.nn.silu(x @ sh["w_gate"])
-              * (x @ sh["w_up"])) @ sh["w_down"]
+    shared = _mm(jax.nn.silu(_mm(x, sh["w_gate"]))
+                 * _mm(x, sh["w_up"]), sh["w_down"])
     shared = jax.nn.sigmoid(x @ sh["gate"]) * shared
     return h + routed + shared
 
@@ -346,7 +356,7 @@ def forward_with_cache(params, tokens, cache, pos0, cfg: Qwen2MoeConfig):
     h, (ck_new, cv_new) = lax.scan(
         body, h, (params["layers"], cache["k"], cache["v"]))
     h = rms_norm(h[:, -1], params["final_norm"], cfg.rms_norm_eps)
-    logits = h @ params["lm_head"]
+    logits = _mm(h, params["lm_head"])
     return logits.astype(jnp.float32), {"k": ck_new, "v": cv_new}
 
 

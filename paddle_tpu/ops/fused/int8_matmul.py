@@ -1,0 +1,119 @@
+"""Weight-only int8 matmul: the decode-path dequant-in-matmul primitive.
+
+Reference capability: the PTQ-deploy path (python/paddle/quantization/ +
+the cutlass int8 weight-only GEMMs behind paddle.incubate's
+weight_only_linear). Decode on TPU is weight-bandwidth-bound — a ~1.7B
+bf16 model streams ~3.4 GB of weights per token against v5e's ~819 GB/s
+HBM, a ~240 steps/s ceiling — so storing the projection weights as int8
+(+ one f32 scale per output channel) halves the dominant byte stream.
+Activations stay in the model dtype for the MXU; the dequant
+(``q.astype(dtype) * scale``) is fused by XLA into the matmul operand,
+never materialised at weight size in the jnp path.
+
+``Int8Weight`` is a registered pytree, so quantized params flow through
+``jax.jit``, ``lax.scan`` over stacked layer weights (both leaves carry
+the leading L axis), and donation exactly like dense weights.
+
+Two matmul implementations:
+  * jnp (default): ``(x @ q.astype(x.dtype)) * scale`` — int8 values up
+    to ±127 are exact in bf16, and applying the per-output-channel scale
+    AFTER the matmul is O(out) instead of O(in·out).
+  * pallas: the authored int8×bf16 kernel (ops/pallas/int8_matmul.py),
+    opt-in via ``impl="pallas"`` / ``PADDLE_TPU_INT8_IMPL=pallas`` —
+    ``"auto"`` stays on the jnp path until an on-chip A/B shows XLA's
+    fusion leaving throughput on the table (docs/PERF.md decode notes).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["Int8Weight", "quantize_weight_per_channel",
+           "int8_weight_matmul"]
+
+
+def _default_impl() -> str:
+    return os.environ.get("PADDLE_TPU_INT8_IMPL", "auto")
+
+
+def quantize_weight_per_channel(w):
+    """Symmetric per-output-channel int8 quantization of a ``[..., in,
+    out]`` weight (stacked leading axes — layer, expert — quantize
+    independently per (leading..., out) channel, matching the
+    reference's channel_wise_abs_max weight observer).
+
+    Returns ``(q int8 [..., in, out], scale f32 [..., out])`` with
+    ``w ≈ q * scale`` (scale = absmax/127, so dequant is one multiply).
+    """
+    wf = jnp.asarray(w).astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(wf), axis=-2), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale[..., None, :]), -127, 127)
+    return q.astype(jnp.int8), scale.astype(jnp.float32)
+
+
+def int8_weight_matmul(x, q, scale, impl: str = "auto"):
+    """``x [..., in] @ dequant(q [in, out], scale [out]) -> [..., out]``
+    in ``x.dtype``. ``impl``: "auto"/"jnp" (XLA fuses the dequant into
+    the matmul operand) or "pallas" (authored kernel; interpret mode
+    off-TPU)."""
+    if impl == "pallas" or (impl == "auto" and _default_impl() == "pallas"):
+        from ..pallas.int8_matmul import int8_matmul_pallas
+        return int8_matmul_pallas(x, q, scale)
+    out = jnp.matmul(x, q.astype(x.dtype)) * scale.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+@jax.tree_util.register_pytree_node_class
+class Int8Weight:
+    """A weight-only-quantized matmul operand: ``q`` int8 ``[..., in,
+    out]`` + ``scale`` f32 ``[..., out]``. Model code calls
+    ``w.dequant_matmul(x)`` (or ``w.dequant()`` where a dense tensor is
+    unavoidable, e.g. einsum-dispatched MoE experts — XLA fuses the cast
+    there too); everything else (scan unstacking, jit, device_put) treats
+    it as a plain two-leaf pytree."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    # -- pytree protocol --
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    # -- array-ish surface --
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+    @property
+    def ndim(self):
+        return self.q.ndim
+
+    def __repr__(self):
+        return (f"Int8Weight(q={getattr(self.q, 'shape', None)}, "
+                f"scale={getattr(self.scale, 'shape', None)})")
+
+    # -- ops --
+    @classmethod
+    def quantize(cls, w) -> "Int8Weight":
+        return cls(*quantize_weight_per_channel(w))
+
+    def dequant(self, dtype=jnp.bfloat16):
+        """Dense ``[..., in, out]`` approximation in ``dtype``."""
+        return (self.q.astype(jnp.float32)
+                * self.scale[..., None, :]).astype(dtype)
+
+    def dequant_matmul(self, x, impl: str = "auto"):
+        return int8_weight_matmul(x, self.q, self.scale, impl=impl)

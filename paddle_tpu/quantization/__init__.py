@@ -236,6 +236,16 @@ class PTQ(QAT):
         return model
 
 
+# functional-pytree PTQ for the decode stacks (llama / qwen2_moe):
+# weight-only int8 deploy, the TPU counterpart of ptq.py convert +
+# cutlass weight-only GEMMs
+from .decode import (  # noqa: E402
+    decode_weight_bytes,
+    dequantize_for_decode,
+    is_quantized_params,
+    quantize_for_decode,
+)
+
 BaseObserver = BaseQuanter  # reference factory.py: observers are quanters
 
 

@@ -131,6 +131,13 @@ class ServingEngine:
     smallest bucket (one prefill compile per bucket).
     max_new_tokens_cap: per-request max_new_tokens ceiling (sizes the
     fixed page-table width).
+    quantization: None/"none" (serve the params as given) or "int8" —
+    weight-only int8 PTQ applied at engine construction
+    (quantization/decode.py quantize_for_decode: per-channel int8
+    projections + f32 scales, halving decode's weight stream) with NO
+    caller-side changes; already-quantized params pass through. Greedy
+    tokens then match ``generate()`` run on the SAME quantized params
+    (weight-only quant is a params transform, not a decode-path fork).
     """
 
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
@@ -139,9 +146,18 @@ class ServingEngine:
                  prompt_buckets=None, attn_impl: str = "auto",
                  max_queue: Optional[int] = None,
                  tick_interval_s: float = 0.0,
-                 decode_block_size: int = 1):
+                 decode_block_size: int = 1,
+                 quantization: Optional[str] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if quantization not in (None, "none", "int8"):
+            raise ValueError(f"quantization must be None/'none'/'int8', "
+                             f"got {quantization!r}")
+        if quantization == "int8":
+            from ..quantization.decode import (is_quantized_params,
+                                               quantize_for_decode)
+            if not is_quantized_params(params):
+                params = quantize_for_decode(params, cfg)
         # optional pacing between decode ticks (tests / co-tenant CPU
         # politeness); 0 = run ticks back to back
         self._tick_interval = float(tick_interval_s)
@@ -346,47 +362,46 @@ class ServingEngine:
         if self._emit(slot, req, tok):
             self._retire(slot, COMPLETED)
 
-    def _block_steps(self, live) -> int:
-        """Fused steps for this tick: the full block size whenever every
-        live request is greedy (the block path samples in-graph argmax),
-        else 1. Always the FULL block — capping at the remaining tokens
-        would compile one program per distinct cap; at worst K-1 cheap
-        steps run past the last retirement and their tokens are
-        discarded (budget overruns land on the trash page)."""
-        if self._decode_block <= 1:
-            return 1
-        if any(r.temperature != 0.0 for _, r in live):
-            return 1
-        return self._decode_block
-
     def _decode_tick(self) -> None:
         jnp = self._jnp
         live = self.scheduler.live()
-        k = self._block_steps(live)
+        # step-tail fusion (docs/PERF.md decode notes): all-greedy ticks
+        # run the block program even at k=1 — sampling is in-graph
+        # argmax, so the device→host pull is [S, k] i32 tokens instead
+        # of [S, V] f32 logits (V·4 bytes/slot/step through the
+        # tunnelled runtime). Tokens are bit-identical (same f32 logits,
+        # same argmax); only a live sampling request forces the
+        # logits-to-host path. Fused ticks always run the FULL block —
+        # capping at the remaining tokens would compile one program per
+        # distinct cap; at worst K-1 cheap steps run past the last
+        # retirement and their tokens are discarded (budget overruns
+        # land on the trash page).
+        fused = all(r.temperature == 0.0 for _, r in live)
+        k = self._decode_block if fused else 1
         t0 = time.perf_counter()
         with RecordEvent("serving.decode_step"):
-            if k == 1:
-                logits, self._kp, self._vp = self._decode_jit(
-                    self._params, jnp.asarray(self._cur_tok),
-                    jnp.asarray(self.scheduler.lengths),
-                    jnp.asarray(self.scheduler.tables), self._kp,
-                    self._vp)
-                toks = np.asarray(logits)  # [S, V]: sampled below
-            else:
+            if fused:
                 toks, self._kp, self._vp = self._block_jit(
                     self._params, jnp.asarray(self._cur_tok),
                     jnp.asarray(self.scheduler.lengths),
                     jnp.asarray(self.scheduler.tables), self._kp,
                     self._vp, num_steps=k)
                 toks = np.asarray(toks)    # [S, k] greedy tokens
+            else:
+                logits, self._kp, self._vp = self._decode_jit(
+                    self._params, jnp.asarray(self._cur_tok),
+                    jnp.asarray(self.scheduler.lengths),
+                    jnp.asarray(self.scheduler.tables), self._kp,
+                    self._vp)
+                toks = np.asarray(logits)  # [S, V]: sampled below
         self.metrics.inc("decode_steps", k)
         self.metrics.observe("decode_step_s",
                              (time.perf_counter() - t0) / k)
         for slot, req in live:
             self.scheduler.lengths[slot] += k  # block's KV just landed
             for j in range(k):
-                tok = (self._sample(slot, req, toks[slot]) if k == 1
-                       else int(toks[slot, j]))
+                tok = (int(toks[slot, j]) if fused
+                       else self._sample(slot, req, toks[slot]))
                 self._cur_tok[slot] = tok
                 if self._emit(slot, req, tok):
                     self._retire(slot, COMPLETED)

@@ -1,0 +1,247 @@
+"""Per-op profile of the single-stream decode step + int8 A/B.
+
+Decode is weight-bandwidth-bound: one greedy step must stream every
+projection weight once, so the hard ceiling is
+
+    steps/s <= HBM_bandwidth / bytes_per_step
+
+(bytes_per_step = quantization.decode.decode_weight_bytes + the KV
+cache read + the activation noise). This tool measures where the step's
+time actually goes — the PERF.md decode counterpart of the train-side
+device-op breakdown:
+
+  * whole-step rate by slope timing (chained generate of N0 vs N1
+    tokens, prefill and sync cancel in the difference);
+  * the step TAIL in isolation — final_norm + lm_head + argmax sample
+    on a captured hidden state (jitted alone);
+  * embed lookup in isolation;
+  * layer body = step − tail − embed (the scan over blocks, including
+    the per-layer KV append + cached attention);
+  * compiled-program cost_analysis (XLA's own flops / bytes-accessed
+    estimate) for the f32-accounting cross-check;
+  * the analytic bytes/step + ceiling at a given HBM bandwidth, and the
+    fraction of that ceiling the measured rate achieves.
+
+Runs the bf16/f32 params and (``int8`` flag) the weight-only-quantized
+params through the SAME harness, printing both and the uplift.
+
+Usage:
+  python tools/decode_profile.py [flagship|deep|mid|tiny] [int8] [json]
+      [bw=819e9] [steps=64]
+
+``flagship`` is the 1.72B bench model (TPU-sized; expect minutes per
+chain on CPU); ``mid`` (0.17B) profiles the same shape story at
+CPU-friendly cost. Default: mid off-TPU, flagship on TPU.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.quantization.decode import (decode_weight_bytes,
+                                            quantize_for_decode)
+
+PRESETS = {
+    # bench.py flagship: the 1.72B decode whose 176.7 tok/s (BENCH_r05)
+    # this tool exists to explain
+    "flagship": dict(vocab_size=32000, hidden_size=4096,
+                     intermediate_size=16384, num_hidden_layers=6,
+                     num_attention_heads=32, num_key_value_heads=8),
+    "deep": dict(vocab_size=32000, hidden_size=2560,
+                 intermediate_size=10240, num_hidden_layers=16,
+                 num_attention_heads=20, num_key_value_heads=4),
+    "mid": dict(vocab_size=8192, hidden_size=1024,
+                intermediate_size=4096, num_hidden_layers=8,
+                num_attention_heads=8, num_key_value_heads=4),
+    "tiny": dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+                 num_hidden_layers=4, num_attention_heads=4,
+                 num_key_value_heads=2),
+}
+
+
+def slope(run_n, n0, n1, repeats=2):
+    """Per-iteration seconds: min-per-chain, then difference (the bench.py
+    convention — min of the difference would pair a slowed short chain
+    with a fast long one and understate dt)."""
+    run_n(2)  # compile + warmup
+    t_short = t_long = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        run_n(n0)
+        t_short = min(t_short, time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        run_n(n1)
+        t_long = min(t_long, time.perf_counter() - t0)
+    return (t_long - t_short) / (n1 - n0)
+
+
+def kv_bytes_per_step(cfg, seq_len, dtype_bytes=None):
+    """K+V read traffic of one cached-attention step at cache length
+    ``seq_len`` (the write is one token — noise)."""
+    if dtype_bytes is None:
+        dtype_bytes = jnp.dtype(cfg.dtype).itemsize
+    return (2 * cfg.num_hidden_layers * seq_len * cfg.num_key_value_heads
+            * cfg.head_dim * dtype_bytes)
+
+
+def profile(params, cfg, steps, prompt_len=32):
+    """Measured seconds per decode step, split step/tail/embed."""
+    prompt = jax.random.randint(jax.random.PRNGKey(1), (1, prompt_len), 0,
+                                cfg.vocab_size, dtype=jnp.int32)
+
+    n0 = max(steps // 4, 2)
+    n1 = max(steps, n0 + 4)  # slope needs n1 > n0 (steps<=2 otherwise
+    #                          divides by zero in the difference)
+    gens = {n: jax.jit(lambda p, t, n=n: L.generate(p, t, cfg,
+                                                    max_new_tokens=n))
+            for n in (2, n0, n1)}
+
+    def run_gen(n):
+        out = gens[n](params, prompt)
+        int(out[0, -1])  # host read: the only reliable sync everywhere
+
+    step_s = slope(run_gen, n0, n1)
+
+    # tail: final_norm + lm_head + greedy sample, jitted alone on a
+    # captured hidden state (chained via a data dependency so the chain
+    # cannot be executed in parallel)
+    h = jnp.zeros((1, cfg.hidden_size), cfg.dtype) + 0.1
+
+    def tail_n(p, h, n):
+        def body(carry, _):
+            hh = L.rms_norm(carry, p["final_norm"], cfg.rms_norm_eps)
+            logits = L._mm(hh, p["lm_head"]).astype(jnp.float32)
+            tok = jnp.argmax(logits, axis=-1)
+            # feed the token back so steps serialize
+            return carry + tok.astype(carry.dtype)[:, None] * 1e-9, tok
+        _, toks = jax.lax.scan(body, h, None, length=n)
+        return toks
+
+    # scan length must be static: one jit per chain length
+    tails = {n: jax.jit(lambda p, h, n=n: tail_n(p, h, n))
+             for n in (2, n0, n1)}
+
+    def run_tail(n):
+        int(np.asarray(tails[n](params, h))[-1, 0])
+
+    tail_s = slope(run_tail, n0, n1)
+
+    # embed lookup in isolation (chained through an index dependency)
+    def embed_n(p, n):
+        def body(tok, _):
+            row = p["embed"][tok]
+            nxt = (tok + jnp.int32(1) +
+                   (row.sum() * 0).astype(jnp.int32)) % cfg.vocab_size
+            return nxt, row.sum()
+        _, s = jax.lax.scan(body, jnp.int32(0), None, length=n)
+        return s
+
+    embeds = {n: jax.jit(lambda p, n=n: embed_n(p, n))
+              for n in (2, n0, n1)}
+
+    def run_embed(n):
+        float(np.asarray(embeds[n](params))[-1])
+
+    embed_s = slope(run_embed, n0, n1)
+
+    # XLA's own accounting of ONE decode step (prefilled cache, T=1)
+    cost = {}
+    try:
+        cache = L.init_kv_cache(cfg, 1, prompt_len + steps)
+        _, cache = jax.jit(
+            lambda p, t, c: L.forward_with_cache(p, t, c, 0, cfg)
+        )(params, prompt, cache)
+        tok = jnp.zeros((1, 1), jnp.int32)
+        lowered = jax.jit(
+            lambda p, t, c: L.forward_with_cache(p, t, c,
+                                                 jnp.int32(prompt_len),
+                                                 cfg)
+        ).lower(params, tok, cache)
+        ca = lowered.compile().cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+        if ca:
+            cost = {"xla_flops": float(ca.get("flops", -1)),
+                    "xla_bytes_accessed": float(ca.get("bytes accessed",
+                                                       -1))}
+    except Exception as e:  # cost_analysis is best-effort per backend
+        cost = {"xla_cost_error": str(e)[:120]}
+
+    return {
+        "step_ms": step_s * 1e3,
+        "tail_ms": tail_s * 1e3,          # final_norm + lm_head + sample
+        "embed_ms": embed_s * 1e3,
+        "layers_ms": max(step_s - tail_s - embed_s, 0.0) * 1e3,
+        "tok_per_s": 1.0 / step_s,
+        **cost,
+    }
+
+
+def main():
+    flags = set(sys.argv[1:])
+    preset = next((f for f in flags if f in PRESETS), None)
+    if preset is None:
+        preset = "flagship" if jax.default_backend() == "tpu" else "mid"
+    bw = next((float(f.split("=")[1]) for f in flags
+               if f.startswith("bw=")), 819e9)  # v5e HBM
+    steps = next((int(f.split("=")[1]) for f in flags
+                  if f.startswith("steps=")), 64)
+    on_tpu = jax.default_backend() == "tpu"
+    cfg = L.LlamaConfig(
+        max_position_embeddings=4096,
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        remat=False, use_flash_attention="pallas" if on_tpu else False,
+        **PRESETS[preset])
+
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    variants = [("fp", params)]
+    if "noint8" not in flags:
+        variants.append(("int8", quantize_for_decode(params, cfg)))
+
+    out = {"preset": preset, "backend": jax.default_backend(),
+           "hbm_bw_gbs": bw / 1e9, "steps": steps}
+    seq = 32 + steps // 2  # mean cache length over the run
+    for tag, p in variants:
+        prof = profile(p, cfg, steps)
+        wbytes = decode_weight_bytes(p)
+        tbytes = wbytes + kv_bytes_per_step(cfg, seq)
+        ceiling = bw / tbytes
+        prof.update({
+            "weight_bytes_per_step": wbytes,
+            "kv_bytes_per_step": kv_bytes_per_step(cfg, seq),
+            "bw_ceiling_tok_per_s": ceiling,
+            "ceiling_fraction": prof["tok_per_s"] / ceiling,
+        })
+        out[tag] = {k: (round(v, 4) if isinstance(v, float) else v)
+                    for k, v in prof.items()}
+    if "fp" in out and "int8" in out:
+        out["int8_speedup"] = round(
+            out["int8"]["tok_per_s"] / out["fp"]["tok_per_s"], 4)
+
+    if "json" in flags:
+        print(json.dumps(out))
+        return
+    print(f"# decode profile — {preset} ({out['backend']}), "
+          f"bw={bw/1e9:.0f} GB/s")
+    hdr = ("variant | step ms | layers | tail(norm+head+sample) | embed "
+           "| tok/s | bytes/step | ceiling tok/s | achieved")
+    print(hdr)
+    for tag, _ in variants:
+        r = out[tag]
+        print(f"{tag:5s} | {r['step_ms']:8.3f} | {r['layers_ms']:7.3f} | "
+              f"{r['tail_ms']:7.3f} | {r['embed_ms']:6.3f} | "
+              f"{r['tok_per_s']:8.1f} | {r['weight_bytes_per_step']:>11,} |"
+              f" {r['bw_ceiling_tok_per_s']:8.1f} | "
+              f"{r['ceiling_fraction']:.3f}")
+    if "int8_speedup" in out:
+        print(f"int8 speedup: {out['int8_speedup']}x")
+
+
+if __name__ == "__main__":
+    main()
