@@ -57,6 +57,12 @@ class PagePool:
         self.page_size = int(page_size)
         self.total_pages = int(total_pages)
         self._free: List[int] = list(range(total_pages - 1, 0, -1))
+        # membership mirror of the free list: free() validates against it
+        # so a double-free or out-of-range id raises instead of silently
+        # aliasing two sequences onto one page later (the refcounting
+        # prefix cache makes that failure mode reachable from more call
+        # sites than the pre-r8 retire path)
+        self._free_set = set(self._free)
 
     @property
     def free_pages(self) -> int:
@@ -67,17 +73,36 @@ class PagePool:
             raise RuntimeError(
                 f"KV page pool exhausted: need {n}, have {len(self._free)} "
                 f"of {self.total_pages}")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        self._free_set.difference_update(out)
+        return out
 
     def alloc_for_len(self, length: int) -> List[int]:
         """Pages covering ``length`` tokens."""
         return self.alloc(self.pages_for_len(length))
 
     def free(self, pages) -> None:
-        for p in pages:
-            p = int(p)
-            if p != self.TRASH:
-                self._free.append(p)
+        """Return pages to the free list. Rejects out-of-range ids,
+        pages that are already free, and duplicates within one call —
+        all-or-nothing: a rejected call frees NOTHING, so the pool state
+        stays consistent for the error handler."""
+        ids = [int(p) for p in pages]
+        ids = [p for p in ids if p != self.TRASH]
+        for p in ids:
+            if not 0 < p < self.total_pages:
+                raise ValueError(
+                    f"free(): page id {p} out of range (valid ids are "
+                    f"1..{self.total_pages - 1}; 0 is the trash page)")
+            if p in self._free_set:
+                raise ValueError(
+                    f"free(): double free of page {p} (already on the "
+                    f"free list)")
+        if len(set(ids)) != len(ids):
+            dup = sorted(p for p in set(ids) if ids.count(p) > 1)
+            raise ValueError(f"free(): duplicate page ids in one call: "
+                             f"{dup}")
+        self._free.extend(ids)
+        self._free_set.update(ids)
 
     # ------------------------------------------------- serving helpers ----
     @property
@@ -133,6 +158,7 @@ class PagePool:
         used_after = (used_now - set(plan)) | set(plan.values())
         self._free = sorted(set(range(1, self.total_pages)) - used_after,
                             reverse=True)
+        self._free_set = set(self._free)
 
 
 def _ref_paged_attention(q, k_pages, v_pages, lengths, page_indices,
@@ -404,22 +430,28 @@ def write_token_pages(k_pages, v_pages, k_t, v_t, lengths, page_indices):
     return k_pages, v_pages
 
 
-def write_prompt_pages(k_pages, v_pages, k, v, lengths, page_indices):
+def write_prompt_pages(k_pages, v_pages, k, v, lengths, page_indices,
+                       offset: int = 0):
     """Write a whole (right-padded) prompt's KV: positions ``t >=
     lengths[b]`` land on the trash page.
 
     k/v: ``[B, T0, Hkv, Dh]``. Returns updated (k_pages, v_pages).
+    ``offset`` shifts every write by that many tokens — k[:, t] lands at
+    cache position ``offset + t`` (chunked prefill writes later chunks
+    of one prompt at their absolute offset; ``lengths`` then counts the
+    valid tokens of the CHUNK, not of the whole prompt).
     """
     B, T0 = k.shape[0], k.shape[1]
     ps = k_pages.shape[2]
     t = jnp.arange(T0)[None, :]                       # [1, T0]
     valid = t < lengths[:, None]                      # [B, T0]
+    t_abs = t + offset
     slot = jnp.broadcast_to(
-        jnp.minimum(t // ps, page_indices.shape[1] - 1), (B, T0))
+        jnp.minimum(t_abs // ps, page_indices.shape[1] - 1), (B, T0))
     page = jnp.take_along_axis(page_indices, slot.astype(jnp.int32),
                                axis=1)
     page = jnp.where(valid, page, PagePool.TRASH)     # [B, T0]
-    off = jnp.broadcast_to(t % ps, (B, T0))
+    off = jnp.broadcast_to(t_abs % ps, (B, T0))
     k_pages = k_pages.at[:, page, off].set(k.transpose(2, 0, 1, 3))
     v_pages = v_pages.at[:, page, off].set(v.transpose(2, 0, 1, 3))
     return k_pages, v_pages

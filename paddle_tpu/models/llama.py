@@ -1031,6 +1031,90 @@ def serving_prefill(params, tokens, length, table, k_pages, v_pages, cfg,
     return logits[0].astype(jnp.float32), kp_new, vp_new
 
 
+def serving_prefill_chunk(params, tokens, length, table, k_pages, v_pages,
+                          cfg, prefix_pages: int, attn_impl: str = "auto",
+                          _block_fn=None):
+    """Prefill ONE chunk of a request's prompt at a page-aligned offset.
+
+    tokens ``[1, Tc]`` right-padded chunk; length scalar i32 (valid
+    tokens IN the chunk); table ``[pps]`` i32 — the slot's full page-table
+    row. ``prefix_pages`` (STATIC — one compile per value) is the number
+    of pages already holding this request's earlier tokens: attached
+    prefix-cache pages plus previously prefilled chunks. The chunk's
+    first token sits at absolute position ``prefix_pages * page_size``
+    (chunk boundaries are page-aligned by the engine: the chunk length
+    and cache-attach granularity are both multiples of page_size).
+    Returns ``(logits [V] f32 at the chunk's last valid position,
+    k_pages', v_pages')``.
+
+    Exactness: causal attention makes a prefix's KV a function of the
+    prefix tokens alone, so the gathered pages hold exactly the bits a
+    whole-prompt prefill would have produced for those positions; the
+    chunk rows then see the same score rows (prefix gathered dense ++
+    in-graph chunk, bottom-right causal mask) as the full flash program,
+    and padding/width changes only add exact zeros to the reductions.
+    Chunked, suffix-only and whole-prompt prefill therefore produce
+    bitwise-identical KV and logits (tests/test_prefix_cache.py pins
+    greedy equality through the engine in every cache state).
+    """
+    from ..inference.paged_kv import write_prompt_pages
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    block_fn = _block_fn if _block_fn is not None else _block
+    prefix_pages = int(prefix_pages)
+    B, Tc = tokens.shape
+    Hkv, Dh = k_pages.shape[1], k_pages.shape[-1]
+    ps = k_pages.shape[-2]
+    off = prefix_pages * ps
+    lengths = jnp.reshape(length, (1,)).astype(jnp.int32)
+    tables = jnp.reshape(table, (1, -1)).astype(jnp.int32)
+    pref_ids = tables[0, :prefix_pages]               # static length
+    h = params["embed"].astype(cfg.dtype)[tokens]
+    positions = jnp.broadcast_to(off + jnp.arange(Tc), (B, Tc))
+    if attn_impl != "auto":
+        impl = attn_impl
+    else:
+        fa = cfg.use_flash_attention
+        impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
+
+    def gather_prefix(pages):
+        # [Hkv, n_pre, ps, Dh] -> [1, n_pre*ps, Hkv, Dh] (position-major)
+        pre = pages[:, pref_ids].reshape(Hkv, off, Dh)
+        return pre.transpose(1, 0, 2)[None]
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            kp2, vp2 = write_prompt_pages(
+                kp, vp, k.astype(kp.dtype), v.astype(vp.dtype), lengths,
+                tables, offset=off)
+            cell["kp"], cell["vp"] = kp2, vp2
+            if prefix_pages:
+                kc = jnp.concatenate(
+                    [gather_prefix(kp).astype(k.dtype), k], axis=1)
+                vc = jnp.concatenate(
+                    [gather_prefix(vp).astype(v.dtype), v], axis=1)
+            else:
+                kc, vc = k, v
+            # bottom-right-aligned causal (S = off + Tc > Tc = T): every
+            # chunk query attends the whole gathered prefix plus its own
+            # causal window — _dense_reference's tril(k=S-T) / splash's
+            # CausalMask(offset=S-T) implement exactly this
+            return _fa(q, kc, vc, causal=True, impl=impl)
+
+        h = block_fn(lp, h, positions, cfg, attn_fn)
+        return h, (cell["kp"], cell["vp"])
+
+    h, (kp_new, vp_new) = lax.scan(body, h, (params["layers"], k_pages,
+                                             v_pages))
+    h = rms_norm(h, params["final_norm"], cfg.rms_norm_eps)
+    idx = jnp.maximum(lengths - 1, 0)[:, None, None]
+    h_last = jnp.take_along_axis(h, idx, axis=1)[:, 0]
+    logits = _mm(h_last, params["lm_head"])
+    return logits[0].astype(jnp.float32), kp_new, vp_new
+
+
 def serving_decode_step(params, tok, lengths, tables, k_pages, v_pages,
                         cfg, attn_impl: str = "auto", _block_fn=None):
     """One decode tick for ALL slots of the serving batch.

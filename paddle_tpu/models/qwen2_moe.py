@@ -402,6 +402,14 @@ def serving_prefill(params, tokens, length, table, k_pages, v_pages, cfg,
                  attn_impl=attn_impl, _block_fn=_decode_block)
 
 
+def serving_prefill_chunk(params, tokens, length, table, k_pages, v_pages,
+                          cfg, prefix_pages: int, attn_impl: str = "auto"):
+    from .llama import serving_prefill_chunk as _impl
+    return _impl(params, tokens, length, table, k_pages, v_pages, cfg,
+                 prefix_pages, attn_impl=attn_impl,
+                 _block_fn=_decode_block)
+
+
 def serving_decode_step(params, tok, lengths, tables, k_pages, v_pages,
                         cfg, attn_impl: str = "auto"):
     from .llama import serving_decode_step as _impl

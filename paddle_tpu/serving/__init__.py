@@ -11,6 +11,8 @@ shape (PAPERS.md).
 
     ServingEngine   — the step-loop engine (serving/engine.py)
     Scheduler       — slot + page-budget admission (serving/scheduler.py)
+    PrefixCache     — refcounted cross-request KV page reuse
+                      (serving/prefix_cache.py)
     RequestHandle   — per-request token stream / blocking result
     ServingMetrics  — counters + latency histograms (serving/metrics.py)
 
@@ -18,10 +20,11 @@ See docs/SERVING.md for architecture, knobs, and metrics.
 """
 from .engine import ServingEngine  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401
+from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (Request, RequestHandle, Scheduler,  # noqa: F401
                         CANCELLED, COMPLETED, QUEUED, REJECTED, RUNNING,
                         TIMED_OUT)
 
-__all__ = ["ServingEngine", "Scheduler", "Request", "RequestHandle",
-           "ServingMetrics", "Histogram", "QUEUED", "RUNNING", "COMPLETED",
-           "CANCELLED", "TIMED_OUT", "REJECTED"]
+__all__ = ["ServingEngine", "Scheduler", "PrefixCache", "Request",
+           "RequestHandle", "ServingMetrics", "Histogram", "QUEUED",
+           "RUNNING", "COMPLETED", "CANCELLED", "TIMED_OUT", "REJECTED"]

@@ -10,9 +10,16 @@ behind it); this engine batches per STEP:
   - requests are admitted mid-flight into free slots of a fixed
     ``max_batch``-wide decode batch (admission is page-budget-aware —
     see serving/scheduler.py);
-  - an admitted request is prefilled immediately (one jitted prefill
-    per prompt-length bucket, batch 1) writing its prompt KV into its
-    own pages of a SHARED per-layer page pool;
+  - admission first attaches the longest PREFIX-CACHED page-aligned
+    span of the prompt (serving/prefix_cache.py — refcounted KV page
+    reuse across requests: system prompts and few-shot headers are
+    computed once) and prefills only the uncached suffix;
+  - the suffix is prefilled immediately (one jitted prefill per
+    prompt-length bucket, batch 1) writing its KV into the request's
+    own pages of a SHARED per-layer page pool — or, with
+    ``prefill_chunk=N``, in fixed-size page-aligned chunks interleaved
+    one-per-tick with decode, so a long prompt never stalls in-flight
+    streams for a whole prefill;
   - every engine tick runs ONE jitted decode step for all slots —
     live or dead — so the decode program has a single stable shape and
     XLA compiles it exactly once;
@@ -39,9 +46,12 @@ from typing import Optional
 
 import numpy as np
 
+from collections import deque
+
 from ..inference.paged_kv import PagePool, apply_defrag
 from ..profiler import RecordEvent
 from .metrics import ServingMetrics
+from .prefix_cache import PrefixCache
 from .scheduler import (CANCELLED, COMPLETED, REJECTED, TIMED_OUT,
                         Request, RequestHandle, Scheduler)
 
@@ -83,8 +93,8 @@ def _jit_step_fns(mod, cfg, attn_impl: str):
     hit = _JIT_CACHE.get(key)
     if hit is not None and hit[0] is cfg:  # id() safe: cfg ref held
         _JIT_CACHE.move_to_end(key)
-        return hit[1], hit[2], hit[3]
-    # donate the pool arrays (args 4/5 of both step fns): the engine
+        return hit[1:]
+    # donate the pool arrays (args 4/5 of every step fn): the engine
     # rebinds the returned pools immediately, and without donation every
     # tick pays a full pool copy — measured 2-3x the whole step time on
     # the CPU mesh at bench shapes
@@ -95,10 +105,16 @@ def _jit_step_fns(mod, cfg, attn_impl: str):
     blk = jax.jit(partial(mod.serving_decode_block, cfg=cfg,
                           attn_impl=attn_impl), donate_argnums=(4, 5),
                   static_argnames=("num_steps",))
-    _JIT_CACHE[key] = (cfg, pre, dec, blk)
+    # prefix_pages is STATIC: the gathered-prefix width is a shape (one
+    # compile per distinct already-written page count — page-aligned
+    # chunk boundaries keep the value set small)
+    chk = jax.jit(partial(mod.serving_prefill_chunk, cfg=cfg,
+                          attn_impl=attn_impl), donate_argnums=(4, 5),
+                  static_argnames=("prefix_pages",))
+    _JIT_CACHE[key] = (cfg, pre, dec, blk, chk)
     if len(_JIT_CACHE) > _JIT_CACHE_MAX:
         _JIT_CACHE.popitem(last=False)
-    return pre, dec, blk
+    return pre, dec, blk, chk
 
 
 def _default_buckets(max_prompt_len: int):
@@ -138,6 +154,18 @@ class ServingEngine:
     caller-side changes; already-quantized params pass through. Greedy
     tokens then match ``generate()`` run on the SAME quantized params
     (weight-only quant is a params transform, not a decode-path fork).
+    prefix_cache: True (default) keeps full prompt-KV pages registered
+    across requests (refcounted; LRU-evicted under page pressure) so a
+    shared prompt prefix is prefilled once — greedy outputs stay
+    byte-identical to ``generate()`` whether a prefix was cached,
+    partially cached, or cold (the chunk program's math is bitwise
+    equal to the whole-prompt program's; tests/test_prefix_cache.py).
+    prefill_chunk: None (default) prefills a whole suffix at admission;
+    N (a multiple of page_size) caps per-tick prefill work at one
+    N-token chunk, interleaved with decode ticks (bounded inter-token
+    stall for in-flight streams while long prompts are absorbed).
+    admission_window: 0 (default) = strict-FIFO admission; N lets up to
+    N queued requests overtake a head whose page budget does not fit.
     """
 
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
@@ -147,9 +175,18 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  tick_interval_s: float = 0.0,
                  decode_block_size: int = 1,
-                 quantization: Optional[str] = None):
+                 quantization: Optional[str] = None,
+                 prefix_cache: bool = True,
+                 prefill_chunk: Optional[int] = None,
+                 admission_window: int = 0):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
+        if prefill_chunk is not None:
+            prefill_chunk = int(prefill_chunk)
+            if prefill_chunk < page_size or prefill_chunk % page_size:
+                raise ValueError(
+                    f"prefill_chunk must be a positive multiple of "
+                    f"page_size ({page_size}), got {prefill_chunk}")
         if quantization not in (None, "none", "int8"):
             raise ValueError(f"quantization must be None/'none'/'int8', "
                              f"got {quantization!r}")
@@ -181,19 +218,34 @@ class ServingEngine:
         if total_pages is None:
             total_pages = max_batch * pages_per_slot + 1
         self.pool = PagePool(total_pages=total_pages, page_size=page_size)
+        # attach granularity: prefix_pages is a STATIC dim of the chunk
+        # program, so unrestricted attach counts would compile one
+        # program per distinct cached-prefix length; quantizing to
+        # multiples of ceil(pps/16) bounds the value set at <= 16 per
+        # engine while giving up at most quantum-1 pages of reuse
+        self.prefix_cache = PrefixCache(
+            self.pool,
+            attach_quantum=max(1, -(-pages_per_slot // 16))) \
+            if prefix_cache else None
+        self._chunk = prefill_chunk
         self.scheduler = Scheduler(
             max_batch=max_batch, pages_per_slot=pages_per_slot,
             pool=self.pool, max_queue=max_queue,
-            max_prompt_len=max_bucket)
+            max_prompt_len=max_bucket, prefix_cache=self.prefix_cache,
+            admission_window=admission_window)
         self.metrics = ServingMetrics()
 
         pools = self._mod.init_serving_pages(cfg, total_pages, page_size)
         self._kp, self._vp = pools["k_pages"], pools["v_pages"]
         import jax
         self._jnp = jax.numpy
-        self._prefill_jit, self._decode_jit, self._block_jit = \
-            _jit_step_fns(self._mod, cfg, attn_impl)
+        (self._prefill_jit, self._decode_jit, self._block_jit,
+         self._chunk_jit) = _jit_step_fns(self._mod, cfg, attn_impl)
         self._jax = jax
+        # requests parked mid chunked-prefill, FIFO: one chunk advances
+        # per tick so in-flight decode streams keep a bounded stall
+        self._prefill_q: "deque" = deque()
+        self._last_decode_t: Optional[float] = None
 
         self._cur_tok = np.zeros((max_batch,), np.int32)
         self._produced = np.zeros((max_batch,), np.int64)
@@ -281,6 +333,8 @@ class ServingEngine:
             "page_utilization": self.pool.utilization,
             "free_pages": self.pool.free_pages,
         }
+        if self.prefix_cache is not None:
+            snap["gauges"]["prefix_cache"] = self.prefix_cache.stats()
         return snap
 
     def defragment(self) -> int:
@@ -298,6 +352,8 @@ class ServingEngine:
             # READ-ONLY view, and retire()/admit() write tables in place
             self.scheduler.tables = np.array(tables, np.int32)
             self.scheduler.remap_pages(plan)  # per-request page LISTS
+            if self.prefix_cache is not None:
+                self.prefix_cache.remap(plan)  # cached-node page ids
             self.pool.commit_defrag(plan)
             return len(plan)
 
@@ -343,6 +399,100 @@ class ServingEngine:
                 return b
         raise AssertionError("submit() enforces the max bucket")
 
+    # ----------------------------------------------------------- prefill ----
+    def _start_prefill(self, slot: int, req: Request) -> None:
+        """Admission-time dispatch: whole-prompt prefill, single
+        suffix-only chunk (prefix-cache hit), or park the slot and feed
+        the suffix through per-tick chunks."""
+        if req.cached_len:
+            self.metrics.inc("prefix_hits")
+            self.metrics.inc("prefix_hit_tokens", req.cached_len)
+            self.metrics.inc("prefix_pages_saved", len(req.prefix_nodes))
+        elif self.prefix_cache is not None:
+            self.metrics.inc("prefix_misses")
+        suffix = req.prompt.size - req.cached_len
+        if self._chunk is None and not req.cached_len:
+            self._prefill(slot, req)  # pre-r8 whole-prompt program
+        elif self._chunk is None or suffix <= self._chunk:
+            logits = self._run_chunk(slot, req)
+            self._finish_prefill(slot, req, logits)
+        else:
+            req.prefilling = True
+            req.chunk_done = 0
+            # park as a DEAD slot for the shared decode program: the
+            # real row moves onto the request and the scheduler row goes
+            # all-TRASH (length stays 0), so per-tick decode writes AND
+            # reads hit only the trash page — the proven dead-slot path.
+            # (A past-the-table length sentinel would bound the write
+            # side but the TPU pallas kernel's page loop walks
+            # ceil(length/block) table entries with no clamp, reading
+            # past the row.)
+            req.table_row = self.scheduler.tables[slot].copy()
+            self.scheduler.tables[slot, :] = PagePool.TRASH
+            self._prefill_q.append((slot, req))
+
+    def _run_chunk(self, slot: int, req: Request) -> np.ndarray:
+        """One serving_prefill_chunk call for the next uncached span;
+        returns the chunk's last-valid-position logits (meaningful only
+        when this was the final chunk)."""
+        n = req.prompt.size
+        start = req.cached_len + req.chunk_done  # page-aligned
+        tb = self._chunk if self._chunk is not None \
+            else self._bucket(n - start)
+        take = min(n - start, tb)
+        padded = np.zeros((1, tb), np.int32)
+        padded[0, :take] = req.prompt[start:start + take]
+        row = req.table_row if req.table_row is not None \
+            else self.scheduler.tables[slot]
+        jnp = self._jnp
+        with RecordEvent("serving.prefill_chunk"):
+            logits, self._kp, self._vp = self._chunk_jit(
+                self._params, jnp.asarray(padded), jnp.int32(take),
+                jnp.asarray(row), self._kp, self._vp,
+                prefix_pages=start // self.pool.page_size)
+            logits = np.asarray(logits)
+        req.chunk_done += take
+        self.metrics.inc("prefill_chunks")
+        return logits
+
+    def _finish_prefill(self, slot: int, req: Request,
+                        logits: np.ndarray) -> None:
+        """Common prefill tail: register the prompt's full pages in the
+        prefix cache, join the decode batch, sample the first token."""
+        n = req.prompt.size
+        self.metrics.inc("prefills")
+        req.prefilling = False
+        if req.table_row is not None:    # was parked: re-install the row
+            self.scheduler.tables[slot, :] = req.table_row
+            req.table_row = None
+        if self.prefix_cache is not None:
+            new_full = n // self.pool.page_size - len(req.prefix_nodes)
+            if new_full > 0:
+                adopted, dup = self.prefix_cache.insert(
+                    req.prompt, req.prefix_nodes, req.pages[:new_full])
+                req.prefix_nodes = req.prefix_nodes + adopted
+                req.pages = dup + req.pages[new_full:]
+        self.scheduler.lengths[slot] = n
+        tok = self._sample(slot, req, logits)
+        self._cur_tok[slot] = tok
+        if self._emit(slot, req, tok):
+            self._retire(slot, COMPLETED)
+
+    def _prefill_tick(self) -> bool:
+        """Advance the oldest parked request by ONE chunk (the bounded
+        per-tick prefill budget). True when any prefill work ran."""
+        while self._prefill_q:
+            slot, req = self._prefill_q[0]
+            if self.scheduler.slots[slot] is not req or not req.prefilling:
+                self._prefill_q.popleft()  # retired by a sweep
+                continue
+            logits = self._run_chunk(slot, req)
+            if req.cached_len + req.chunk_done >= req.prompt.size:
+                self._prefill_q.popleft()
+                self._finish_prefill(slot, req, logits)
+            return True
+        return False
+
     def _prefill(self, slot: int, req: Request) -> None:
         n = req.prompt.size
         tb = self._bucket(n)
@@ -355,12 +505,7 @@ class ServingEngine:
                 jnp.asarray(self.scheduler.tables[slot]), self._kp,
                 self._vp)
             logits = np.asarray(logits)
-        self.metrics.inc("prefills")
-        self.scheduler.lengths[slot] = n
-        tok = self._sample(slot, req, logits)
-        self._cur_tok[slot] = tok
-        if self._emit(slot, req, tok):
-            self._retire(slot, COMPLETED)
+        self._finish_prefill(slot, req, logits)
 
     def _decode_tick(self) -> None:
         jnp = self._jnp
@@ -408,13 +553,14 @@ class ServingEngine:
                     break
 
     def _sweep(self, now: float) -> None:
-        """Apply cancellations + deadlines to queued and live requests."""
+        """Apply cancellations + deadlines to queued and occupied
+        (decoding OR mid-prefill) requests."""
         for r in self.scheduler.drop_queued(
                 lambda r: r.cancel_flag or r.expired(now)):
             state = CANCELLED if r.cancel_flag else TIMED_OUT
             r.finish(state)
             self.metrics.inc("cancelled" if r.cancel_flag else "timed_out")
-        for slot, req in self.scheduler.live():
+        for slot, req in self.scheduler.occupied():
             if req.cancel_flag:
                 self._retire(slot, CANCELLED)
             elif req.expired(now):
@@ -434,15 +580,30 @@ class ServingEngine:
                         self.metrics.inc("admitted")
                         self.metrics.observe("queue_wait_s",
                                              req.admit_t - req.submit_t)
-                        self._prefill(slot, req)
+                        self._start_prefill(slot, req)
+                    chunked = self._prefill_tick()
                     live = self.scheduler.live()
                     self.metrics.observe("batch_occupancy",
                                          self.scheduler.occupancy)
                     self.metrics.observe("page_utilization",
                                          self.pool.utilization)
-                    ticked = bool(live)
+                    self.metrics.observe("chunk_queue_depth",
+                                         len(self._prefill_q))
+                    ticked = bool(live) or chunked or bool(admitted)
                     if live:
+                        # inter-decode-tick stall: everything since the
+                        # last tick ended (admission prefills, chunks,
+                        # host work) shows up as this gap — the latency
+                        # in-flight streams actually feel
+                        t = time.perf_counter()
+                        if self._last_decode_t is not None:
+                            self.metrics.observe(
+                                "decode_stall_s",
+                                t - self._last_decode_t)
                         self._decode_tick()
+                        self._last_decode_t = time.perf_counter()
+                    else:
+                        self._last_decode_t = None
                 if ticked:
                     # pace OUTSIDE the tick lock: sleeping inside it
                     # starves defragment() (python locks are unfair)
@@ -465,13 +626,19 @@ class ServingEngine:
             for r in self.scheduler.drop_queued(lambda r: True):
                 r.finish(CANCELLED)
                 self.metrics.inc("cancelled")
-            for slot, req in self.scheduler.live():
+            for slot, req in self.scheduler.occupied():
                 self._retire(slot, CANCELLED)
+            self._prefill_q.clear()
+            if self.prefix_cache is not None:
+                # teardown hygiene: every request is retired, so all
+                # cached pages are refcount-0 — return them so the pool
+                # ends balanced (used_pages == 0 after close)
+                self.prefix_cache.evict(self.prefix_cache.cached_pages)
 
     def _fail_all(self, e: BaseException) -> None:
         for r in self.scheduler.drop_queued(lambda r: True):
             r.error = e
             r.finish(CANCELLED)
-        for slot, req in self.scheduler.live():
+        for slot, req in self.scheduler.occupied():
             req.error = e
             self.scheduler.retire(slot, CANCELLED)

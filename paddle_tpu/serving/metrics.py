@@ -53,18 +53,29 @@ class ServingMetrics:
     """Counters + histograms for the continuous-batching engine.
 
     Counters: request lifecycle (submitted/admitted/completed/cancelled/
-    timed_out/rejected), work units (prefills, decode_steps, tokens_out).
+    timed_out/rejected), work units (prefills, prefill_chunks,
+    decode_steps, tokens_out), prefix-cache effectiveness (prefix_hits /
+    prefix_misses per admission, prefix_hit_tokens — prompt tokens NOT
+    recomputed, prefix_pages_saved — pages attached instead of
+    allocated).
     Histograms: queue_wait_s (submit -> admission), ttft_s (submit ->
-    first token), decode_step_s (one engine tick), batch_occupancy (live
+    first token), decode_step_s (one engine tick), decode_stall_s (gap
+    between consecutive decode ticks while streams are live — the
+    chunked-prefill acceptance metric: an unchunked long-prompt
+    admission shows up here as one huge stall), batch_occupancy (live
     slots / max_batch per tick), page_utilization (used / allocatable
-    pages, sampled per tick).
+    pages, sampled per tick), chunk_queue_depth (requests mid
+    chunked-prefill, sampled per tick).
     """
 
     COUNTERS = ("submitted", "admitted", "completed", "cancelled",
-                "timed_out", "rejected", "prefills", "decode_steps",
-                "tokens_out")
+                "timed_out", "rejected", "prefills", "prefill_chunks",
+                "decode_steps", "tokens_out", "prefix_hits",
+                "prefix_misses", "prefix_hit_tokens",
+                "prefix_pages_saved")
     HISTOGRAMS = ("queue_wait_s", "ttft_s", "decode_step_s",
-                  "batch_occupancy", "page_utilization")
+                  "decode_stall_s", "batch_occupancy",
+                  "page_utilization", "chunk_queue_depth")
 
     def __init__(self):
         self._lock = threading.Lock()

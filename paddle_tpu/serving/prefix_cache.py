@@ -1,0 +1,236 @@
+"""Refcounted prefix cache over the paged KV pool.
+
+Reference capability: cross-request KV reuse in paged-attention serving
+stacks (Ragged Paged Attention, PAPERS.md; vLLM-style automatic prefix
+caching): requests sharing a prompt prefix — system prompts, few-shot
+headers — attach the SAME physical KV pages instead of recomputing the
+prefix, so admission prefills only the uncached suffix.
+
+Design:
+
+- **Granularity: full pages.** A cached unit is one FULL KV page
+  (``page_size`` token positions, all layers — the pool is
+  layer-stacked, one page id covers every layer). Full pages are
+  immutable after prefill (decode appends at ``position >= prompt_len``,
+  which page-aligned sharing keeps out of shared pages), so sharing
+  them is write-safe by construction.
+
+- **Keying: a trie keyed by page token tuples.** Node children map
+  ``tuple(page's tokens) -> child``; looking a chain up hashes one
+  page's tokens per step with the parent's identity carrying the rest
+  of the chain — a rolling keying of the token chain. Because dict
+  equality compares the actual tuples, a hash collision can never alias
+  two different prefixes (the engine's byte-exactness bar).
+
+- **Refcounts + LRU eviction.** ``refs`` counts live requests whose
+  page table contains the node's page. Nodes stay cached at zero refs
+  and are evicted LRU-first under page pressure (``evict``), but only
+  LEAF nodes: an interior node's children attend to its positions, so
+  freeing a parent first would dangle the chain. Evicting a leaf
+  exposes its parent as the next candidate.
+
+- **Match cap: at most ``floor((n-1)/page_size)`` pages.** At least one
+  suffix token is always left to prefill — the engine needs a fresh
+  forward pass to take first-token logits from — and the partially
+  filled tail page is therefore always request-PRIVATE: the cap is the
+  copy-on-write for the tail page (its cache-covered tokens are
+  recomputed into a private page rather than shared), which is what
+  lets decode append into it without touching shared state and keeps
+  outputs bitwise-identical to ``generate()``.
+
+Single-threaded by design: only the engine worker calls mutating
+methods (the engine serializes them under its tick lock).
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+from typing import Dict, List, Tuple
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    __slots__ = ("toks", "parent", "children", "page", "refs",
+                 "last_used")
+
+    def __init__(self, toks, parent, page: int, tick: int):
+        self.toks = toks                    # this page's token tuple
+        self.parent = parent
+        self.children: Dict[tuple, "_Node"] = {}
+        self.page = int(page)
+        self.refs = 0
+        self.last_used = tick
+
+    def __repr__(self):  # debugging aid only
+        return (f"_Node(page={self.page}, refs={self.refs}, "
+                f"children={len(self.children)})")
+
+
+class PrefixCache:
+    """Page-granular prefix registry over one ``PagePool``.
+
+    The pool is shared with the serving scheduler: cached pages remain
+    ALLOCATED in the pool (they hold live KV) until ``evict`` frees
+    them back. ``defrag_plan``-driven compaction must call ``remap``
+    with the same plan applied to the pool arrays.
+    """
+
+    def __init__(self, pool, attach_quantum: int = 1):
+        self.pool = pool
+        self.page_size = int(pool.page_size)
+        # acquire() attaches only multiples of this many pages: the
+        # chunk program's gathered-prefix width (prefix_pages) is a
+        # STATIC compile dimension, so unrestricted attach counts mean
+        # one XLA compile per distinct cached-prefix length — a compile
+        # storm inside the serving tick under diverse traffic. Quantum q
+        # bounds the value set at pps/q while giving up at most q-1
+        # pages of reuse per request. The trie still CACHES at full
+        # page granularity; only attachment is quantized.
+        self.attach_quantum = max(1, int(attach_quantum))
+        self._root = _Node((), None, -1, 0)
+        self._nodes = set()                 # every cached node
+        self._tick = itertools.count(1)
+        self.evictions = 0
+
+    # ------------------------------------------------------------ sizing ----
+    @property
+    def cached_pages(self) -> int:
+        return len(self._nodes)
+
+    @property
+    def reusable_pages(self) -> int:
+        """Cached pages not currently referenced by any live request."""
+        return sum(nd.refs == 0 for nd in self._nodes)
+
+    # ------------------------------------------------------------ lookup ----
+    def _max_pages(self, n_tokens: int) -> int:
+        # never cover the whole prompt: >= 1 token must remain for the
+        # suffix prefill (first-token logits + private tail page)
+        return max(0, (int(n_tokens) - 1) // self.page_size)
+
+    def _walk(self, prompt, max_pages: int) -> List[_Node]:
+        ps = self.page_size
+        node, out = self._root, []
+        for i in range(max_pages):
+            key = tuple(int(t) for t in prompt[i * ps:(i + 1) * ps])
+            nxt = node.children.get(key)
+            if nxt is None:
+                break
+            out.append(nxt)
+            node = nxt
+        return out
+
+    def match_pages(self, prompt) -> int:
+        """Non-pinning peek: how many pages ``acquire`` would attach."""
+        return len(self._walk(prompt, self._max_pages(len(prompt))))
+
+    def acquire(self, prompt) -> List[_Node]:
+        """Longest cached page-aligned prefix of ``prompt`` — truncated
+        to a multiple of ``attach_quantum`` pages — with every attached
+        node's refcount bumped (pinned against eviction). The caller
+        owns one release() per acquire()."""
+        nodes = self._walk(prompt, self._max_pages(len(prompt)))
+        q = self.attach_quantum
+        nodes = nodes[:(len(nodes) // q) * q]
+        t = next(self._tick)
+        for nd in nodes:
+            nd.refs += 1
+            nd.last_used = t
+        return nodes
+
+    def release(self, nodes: List[_Node]) -> None:
+        """Drop one reference per node (request retirement). Pages stay
+        cached at zero refs until evicted under pressure."""
+        for nd in nodes:
+            nd.refs -= 1
+            if nd.refs < 0:
+                raise AssertionError(
+                    f"prefix-cache refcount underflow on page {nd.page}")
+
+    # ------------------------------------------------------------ insert ----
+    def insert(self, prompt, parent_nodes: List[_Node],
+               pages: List[int]) -> Tuple[List[_Node], List[int]]:
+        """Register a freshly prefilled prompt's full pages.
+
+        ``parent_nodes`` — the chain the request attached at admission
+        (possibly empty); ``pages`` — the request's PRIVATE pool pages
+        holding prompt tokens ``len(parent_nodes)*ps ..`` in order.
+        Only FULL pages are offered (the caller passes
+        ``n_prompt // ps - len(parent_nodes)`` of them).
+
+        Returns ``(adopted, still_private)``: adopted nodes now own
+        their page (refs=1 for this request — pair with release() at
+        retirement); ``still_private`` pages duplicated an existing
+        chain entry (a concurrent identical prompt won the race) and
+        remain the request's to free. The request's page table keeps
+        pointing at its own pages either way — adoption changes
+        ownership, never the table."""
+        ps = self.page_size
+        node = parent_nodes[-1] if parent_nodes else self._root
+        start = len(parent_nodes)
+        adopted, still_private = [], []
+        t = next(self._tick)
+        for i, page in enumerate(pages):
+            j = start + i
+            key = tuple(int(x) for x in prompt[j * ps:(j + 1) * ps])
+            existing = node.children.get(key)
+            if existing is not None:
+                # identical content already cached: keep ours private.
+                # The chain continues through the EXISTING node — our
+                # next page's KV attends to bit-identical positions.
+                still_private.append(int(page))
+                node = existing
+                continue
+            child = _Node(key, node, page, t)
+            child.refs = 1
+            node.children[key] = child
+            self._nodes.add(child)
+            adopted.append(child)
+            node = child
+        return adopted, still_private
+
+    # ---------------------------------------------------------- eviction ----
+    def evict(self, want_pages: int) -> int:
+        """Free up to ``want_pages`` refcount-0 LEAF pages back to the
+        pool, LRU-first; returns how many were freed. Freeing a leaf
+        can expose its parent as the next candidate, which is pushed
+        onto the same heap — one O(N) candidate scan + O(log N) per
+        page, not a full rescan per page (eviction runs inside the
+        scheduler's admission path)."""
+        freed = 0
+        if want_pages <= 0:
+            return 0
+        heap = [(nd.last_used, id(nd), nd) for nd in self._nodes
+                if nd.refs == 0 and not nd.children]
+        heapq.heapify(heap)
+        while heap and freed < want_pages:
+            _, _, nd = heapq.heappop(heap)
+            if nd.refs or nd.children or nd not in self._nodes:
+                continue  # pinned/extended/evicted since it was pushed
+            parent = nd.parent
+            del parent.children[nd.toks]
+            self._nodes.discard(nd)
+            self.pool.free([nd.page])
+            self.evictions += 1
+            freed += 1
+            if (parent is not self._root and parent.refs == 0
+                    and not parent.children):
+                heapq.heappush(heap,
+                               (parent.last_used, id(parent), parent))
+        return freed
+
+    # ------------------------------------------------------------ defrag ----
+    def remap(self, plan: Dict[int, int]) -> None:
+        """Apply a ``PagePool.defrag_plan()`` to every cached node's
+        page id (the pool arrays + tables were rewritten by
+        ``apply_defrag``)."""
+        if not plan:
+            return
+        for nd in self._nodes:
+            nd.page = plan.get(nd.page, nd.page)
+
+    def stats(self) -> Dict[str, int]:
+        return {"cached_pages": self.cached_pages,
+                "reusable_pages": self.reusable_pages,
+                "evictions": self.evictions}

@@ -7,9 +7,14 @@ host-side: the decode batch is a FIXED array of ``max_batch`` slots (so
 the jitted decode step compiles once), pages come from the paged-KV
 ``PagePool`` free list, and admission is page-budget-aware — a request
 is admitted only when a slot AND all pages its full generation can touch
-(prompt + max_new_tokens) are available, so a running sequence can never
-hit pool exhaustion mid-flight. The queue is strict FIFO: when the head
-does not fit, nothing overtakes it (no starvation of big requests).
+(prompt + max_new_tokens, minus any prefix-cached pages it attaches) are
+available, so a running sequence can never hit pool exhaustion
+mid-flight. The queue is strict FIFO by default: when the head does not
+fit, nothing overtakes it (no starvation of big requests);
+``admission_window=N`` relaxes that to a bounded skip-ahead — up to N
+requests behind a stuck head may be admitted first, so small requests
+stop convoying behind one oversized head while the head still cannot be
+overtaken by more than a window's worth of traffic.
 """
 from __future__ import annotations
 
@@ -47,7 +52,8 @@ class Request:
                  "deadline_s", "temperature", "seed", "state", "tokens",
                  "submit_t", "admit_t", "first_token_t", "finish_t",
                  "slot", "pages", "cancel_flag", "stream", "done",
-                 "error")
+                 "error", "prefix_nodes", "cached_len", "prefilling",
+                 "chunk_done", "table_row")
 
     def __init__(self, prompt, max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
@@ -73,7 +79,13 @@ class Request:
         self.first_token_t: Optional[float] = None
         self.finish_t: Optional[float] = None
         self.slot: Optional[int] = None
-        self.pages: List[int] = []
+        self.pages: List[int] = []          # PRIVATE pages (this req frees)
+        self.prefix_nodes: List = []        # shared prefix-cache nodes
+        self.cached_len = 0                 # tokens covered by prefix_nodes
+        self.prefilling = False             # mid chunked-prefill (parked)
+        self.chunk_done = 0                 # suffix tokens prefilled so far
+        self.table_row = None               # real row while parked (the
+        #                                     scheduler row is all-TRASH)
         self.cancel_flag = False
         self.stream: "queue.Queue" = queue.Queue()
         self.done = threading.Event()
@@ -156,12 +168,31 @@ class Scheduler:
 
     def __init__(self, *, max_batch: int, pages_per_slot: int,
                  pool: PagePool, max_queue: Optional[int] = None,
-                 max_prompt_len: Optional[int] = None):
+                 max_prompt_len: Optional[int] = None,
+                 prefix_cache=None, admission_window: int = 0):
         self.max_batch = int(max_batch)
         self.pages_per_slot = int(pages_per_slot)
         self.pool = pool
         self.max_queue = max_queue
         self.max_prompt_len = max_prompt_len
+        # shared-prefix registry (serving/prefix_cache.py): admission
+        # attaches the longest cached page-aligned prefix and allocates
+        # only the remainder; retirement decrefs shared pages instead of
+        # freeing them. None = every page is private (pre-r8 behaviour).
+        self.prefix_cache = prefix_cache
+        # bounded skip-ahead: up to this many queued requests may
+        # overtake a head whose page budget does not fit RIGHT NOW.
+        # 0 = strict FIFO (the head blocks; nothing starves).
+        self.admission_window = int(admission_window)
+        if self.admission_window < 0:
+            raise ValueError("admission_window must be >= 0")
+        # per-head overtake budget: a sliding positional window alone
+        # would let a sustained stream of small arrivals overtake a
+        # stuck head forever (each lands within the window once its
+        # predecessor admits); counting overtakes per head makes the
+        # advertised bound real
+        self._head_id: Optional[int] = None
+        self._head_overtakes = 0
         self._lock = threading.Lock()
         self._queue: "deque[Request]" = deque()
         self.slots: List[Optional[Request]] = [None] * self.max_batch
@@ -212,46 +243,106 @@ class Scheduler:
 
     # ------------------------------------------------------------ slots ----
     def live(self) -> List[Tuple[int, Request]]:
+        """Slots in the DECODE batch (excludes parked mid-prefill ones)."""
+        return [(i, r) for i, r in enumerate(self.slots)
+                if r is not None and not r.prefilling]
+
+    def occupied(self) -> List[Tuple[int, Request]]:
+        """Every non-empty slot, decoding or mid-prefill (sweeps,
+        retirement flushes and defrag remaps must see both)."""
         return [(i, r) for i, r in enumerate(self.slots) if r is not None]
 
     @property
     def occupancy(self) -> float:
         return sum(r is not None for r in self.slots) / self.max_batch
 
+    def _try_reserve(self, req: Request) -> bool:
+        """Pin the longest cached prefix and allocate the request's
+        private pages; True = fully funded. On failure every side
+        effect is rolled back (pins released) so an eviction by a later
+        candidate can reclaim those pages."""
+        if self.prefix_cache is not None:
+            req.prefix_nodes = self.prefix_cache.acquire(req.prompt)
+            req.cached_len = len(req.prefix_nodes) * self.pool.page_size
+        need = self.pages_needed(req) - len(req.prefix_nodes)
+        if not self.pool.can_alloc(need):
+            # page pressure: reclaim refcount-0 cached prefixes
+            # (LRU-first) before giving up — our own prefix is pinned.
+            # Only when the shortfall is actually satisfiable: a
+            # never-fitting candidate must not drain the shared-prefix
+            # KV (destroying every later request's warm TTFT) for an
+            # eviction that cannot admit anyone. (reusable_pages is
+            # exact: refs pin whole chain prefixes, so a refcount-0
+            # subtree is always fully evictable leaf-upward.)
+            if (self.prefix_cache is not None
+                    and need <= self.pool.free_pages
+                    + self.prefix_cache.reusable_pages):
+                self.prefix_cache.evict(need - self.pool.free_pages)
+            if not self.pool.can_alloc(need):
+                if req.prefix_nodes:
+                    self.prefix_cache.release(req.prefix_nodes)
+                    req.prefix_nodes = []
+                    req.cached_len = 0
+                return False
+        req.pages = self.pool.alloc(need)
+        return True
+
     def admit(self) -> List[Tuple[int, Request]]:
-        """Admit queue-head requests while a free slot AND their full
-        page budget are available (strict FIFO — a head that does not
-        fit blocks the queue rather than being overtaken forever)."""
+        """Admit queued requests while a free slot AND their remaining
+        (non-prefix-cached) page budget are available. Strict FIFO by
+        default; with ``admission_window=N`` up to N requests behind a
+        non-fitting head may overtake it (FIFO order preserved among
+        the ones that fit)."""
         admitted = []
         while True:
             free = [i for i, r in enumerate(self.slots) if r is None]
             if not free:
                 break
+            req = None
             with self._lock:
-                if not self._queue:
-                    break
-                head = self._queue[0]
-                if not self.pool.can_alloc(self.pages_needed(head)):
-                    break
-                self._queue.popleft()
+                if self._queue:
+                    head = self._queue[0]
+                    if head.id != self._head_id:
+                        self._head_id = head.id
+                        self._head_overtakes = 0
+                budget = self.admission_window - self._head_overtakes
+                for idx in range(min(len(self._queue), budget + 1)):
+                    cand = self._queue[idx]
+                    if self._try_reserve(cand):
+                        del self._queue[idx]
+                        if idx > 0:
+                            self._head_overtakes += 1
+                        req = cand
+                        break
+            if req is None:
+                break
             slot = free[0]
-            head.pages = self.pool.alloc(self.pages_needed(head))
-            head.slot = slot
-            head.admit_t = time.monotonic()
-            head.state = RUNNING
-            self.slots[slot] = head
+            req.slot = slot
+            req.admit_t = time.monotonic()
+            req.state = RUNNING
+            self.slots[slot] = req
+            shared = [nd.page for nd in req.prefix_nodes]
             self.tables[slot, :] = PagePool.TRASH
-            self.tables[slot, :len(head.pages)] = head.pages
+            self.tables[slot, :len(shared)] = shared
+            self.tables[slot, len(shared):len(shared) + len(req.pages)] = \
+                req.pages
             self.lengths[slot] = 0  # set to prompt len after prefill
-            admitted.append((slot, head))
+            admitted.append((slot, req))
         return admitted
 
     def retire(self, slot: int, state: str) -> Request:
-        """Free the slot + its pages immediately; mark the request."""
+        """Free the slot immediately; private pages return to the pool,
+        shared prefix pages are DECREF'd (they stay cached for the next
+        request with the same prefix); mark the request."""
         req = self.slots[slot]
         assert req is not None
+        if req.prefix_nodes:
+            self.prefix_cache.release(req.prefix_nodes)
+            req.prefix_nodes = []
         self.pool.free(req.pages)
         req.pages = []
+        req.prefilling = False
+        req.table_row = None
         self.slots[slot] = None
         self.tables[slot, :] = PagePool.TRASH
         self.lengths[slot] = 0
@@ -259,12 +350,19 @@ class Scheduler:
         return req
 
     def remap_pages(self, mapping: Dict[int, int]) -> None:
-        """Apply a defrag plan to every live request's page LIST. The
-        table rows must NOT be remapped here: ``apply_defrag`` already
-        rewrote them alongside the pool arrays, and remapping twice
-        corrupts chained plans (e.g. {2:1, 5:2} would send a row entry
-        5 -> 2 -> 1 while its KV moved to slot 2)."""
+        """Apply a defrag plan to every occupied request's page LIST.
+        The table rows must NOT be remapped here: ``apply_defrag``
+        already rewrote them alongside the pool arrays, and remapping
+        twice corrupts chained plans (e.g. {2:1, 5:2} would send a row
+        entry 5 -> 2 -> 1 while its KV moved to slot 2). Prefix-cache
+        nodes are remapped by the engine (``PrefixCache.remap``)."""
         if not mapping:
             return
-        for _, req in self.live():
+        for _, req in self.occupied():
             req.pages = [mapping.get(p, p) for p in req.pages]
+            if req.table_row is not None:
+                # a PARKED request's real row is not in self.tables (the
+                # scheduler row is all-TRASH), so apply_defrag missed it
+                req.table_row = np.asarray(
+                    [mapping.get(int(p), int(p)) for p in req.table_row],
+                    np.int32)
