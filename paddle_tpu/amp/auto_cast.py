@@ -4,12 +4,11 @@ paddle/fluid/eager/amp_auto_cast.h)."""
 from __future__ import annotations
 
 import threading
-from typing import Optional, Set
+from typing import Set
 
 import jax.numpy as jnp
 
 from ..core import dtype as dtypes
-from ..core.tensor import Tensor
 
 # ops that benefit from low precision (MXU-bound)
 white_list: Set[str] = {

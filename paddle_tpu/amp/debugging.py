@@ -10,9 +10,8 @@ from __future__ import annotations
 
 import contextlib
 import enum
-from typing import Optional, Tuple
+from typing import Optional
 
-import numpy as np
 import jax.numpy as jnp
 
 from ..core.flags import set_flags, get_flags

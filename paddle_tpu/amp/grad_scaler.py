@@ -7,10 +7,8 @@ dynamic loss scaling is implemented for float16 parity.
 """
 from __future__ import annotations
 
-import numpy as np
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
 
 
 class GradScaler:

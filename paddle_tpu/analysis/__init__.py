@@ -1,0 +1,41 @@
+"""Static-analysis subsystem: jaxpr lint passes + paged-KV invariant
+checker for the serving stack.
+
+The JAX-native counterpart of the reference's IR pass infrastructure
+and runtime enforcement (``paddle/pir``, ``phi/core/enforce.h``):
+analysis over **jaxprs** (the IR every program here already lowers
+through) and over the serving stack's host-side state. Entry points:
+
+* ``tools/graph_lint.py`` — CLI running every pass over the flagship
+  llama + qwen2_moe serving graphs (the pre-merge check).
+* ``ServingEngine(check_invariants=True)`` — per-tick paged-KV
+  invariant checking (race-detector-style debug mode).
+* ``audit_engine(engine)`` — standalone audit of a live engine.
+
+See docs/ANALYSIS.md for each pass's invariant and how to add one.
+"""
+from .collectives import (CollectiveConsistencyPass,
+                          check_stage_consistency,
+                          collective_signature)
+from .dtype_drift import DtypeDriftPass
+from .framework import (Finding, GraphTarget, LintPass, LintReport,
+                        Severity, run_passes, trace_graph)
+from .host_sync import HostSyncPass
+from .kv_invariants import (KVInvariantError, Violation,
+                            audit_defrag_plan, audit_engine,
+                            audit_serving_state)
+from .recompile import (RecompileHazardPass, ServingGeometry,
+                        enumerate_chunk_programs)
+from .serving_graphs import (engine_geometry, pp_stage_targets,
+                             serving_targets)
+
+__all__ = [
+    "CollectiveConsistencyPass", "DtypeDriftPass", "Finding",
+    "GraphTarget", "HostSyncPass", "KVInvariantError", "LintPass",
+    "LintReport", "RecompileHazardPass", "ServingGeometry", "Severity",
+    "Violation", "audit_defrag_plan", "audit_engine",
+    "audit_serving_state", "check_stage_consistency",
+    "collective_signature", "engine_geometry",
+    "enumerate_chunk_programs", "pp_stage_targets", "run_passes",
+    "serving_targets", "trace_graph",
+]

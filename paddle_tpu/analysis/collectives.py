@@ -1,0 +1,141 @@
+"""Collective-consistency pass: pipeline stages must issue identical
+collective sequences.
+
+Generalizes ``Engine._verify_pp_forward_order`` (the ADVICE r5 guard):
+that check proves the pp stage list matches the model's forward
+*dataflow*; this one proves the stage *programs* agree on the one
+thing that deadlocks or silently corrupts a pipeline — the ordered
+sequence of collectives each stage issues. Two stages that disagree
+(one psum where another ppermutes, different axes, different scan trip
+counts around a collective) hang the mesh at best; at worst a
+reordered pair of reductions completes with transposed data.
+
+The signature of a program is the depth-first ordered list of its
+collective equations with their semantics-bearing params (axis names,
+permutation, tiling), each tagged with the loop structure that repeats
+it (a ppermute inside a length-8 scan is eight issues, not one — two
+stages with different trip counts are NOT consistent). Everything
+shape-local is deliberately excluded: stages hold different weight
+chunks and may differ freely in local math.
+
+Use :func:`collective_signature` directly, or the pass over a group of
+:class:`GraphTarget`\\ s that carry ``meta['stage_group']`` — targets
+in one group must agree pairwise.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+from .framework import Finding, GraphTarget, LintPass, Severity
+
+__all__ = ["COLLECTIVE_PRIMS", "collective_signature",
+           "CollectiveConsistencyPass", "check_stage_consistency"]
+
+COLLECTIVE_PRIMS = {
+    "psum", "psum2", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
+    "all_gather", "all_to_all", "reduce_scatter", "psum_scatter",
+    "pgather", "pshuffle",
+}
+
+# eqn params that carry collective SEMANTICS (vs. local tiling detail)
+_SIG_PARAMS = ("axes", "axis_name", "axis_index_groups", "perm",
+               "all_gather_dimension", "scatter_dimension",
+               "split_axis", "concat_axis", "tiled")
+
+
+def _freeze(v: Any):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def collective_signature(jaxpr) -> List[Tuple]:
+    """Ordered (prim, loop_nest, params) for every collective in the
+    program, depth-first — the stage's communication contract.
+    ``loop_nest`` records the loop frames that repeat the collective,
+    with scan trip counts: a ppermute inside a length-8 scan is eight
+    issues, and a stage scanning 4 layers differs from one scanning 8
+    even when the body matches."""
+    from ..core.graph_trace import sub_jaxprs
+    from jax._src import core as jax_core
+
+    sig: List[Tuple] = []
+
+    def walk(j, loops: Tuple):
+        if isinstance(j, jax_core.ClosedJaxpr):
+            j = j.jaxpr
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                params = tuple(
+                    (k, _freeze(eqn.params[k])) for k in _SIG_PARAMS
+                    if k in eqn.params)
+                sig.append((name, loops, params))
+            for label, sub in sub_jaxprs(eqn):
+                frame = name
+                if name in ("scan", "while", "fori_loop"):
+                    frame = (name, eqn.params.get("length"))
+                walk(sub, loops + (frame,)
+                     if name in ("scan", "while", "fori_loop")
+                     else loops)
+        return sig
+
+    return walk(jaxpr, ())
+
+
+def check_stage_consistency(
+        stages: Sequence[Tuple[str, Any]]) -> List[Tuple[str, str]]:
+    """Compare collective signatures across ``(name, jaxpr)`` stages.
+    Returns [(stage_name, description)] for every stage diverging from
+    the first one (the reference stage)."""
+    if len(stages) < 2:
+        return []
+    ref_name, ref_jaxpr = stages[0]
+    ref_sig = collective_signature(ref_jaxpr)
+    out = []
+    for name, jaxpr in stages[1:]:
+        sig = collective_signature(jaxpr)
+        if sig == ref_sig:
+            continue
+        # locate the first divergence for an actionable message
+        i = 0
+        while i < min(len(sig), len(ref_sig)) and sig[i] == ref_sig[i]:
+            i += 1
+        ours = sig[i] if i < len(sig) else "<end>"
+        theirs = ref_sig[i] if i < len(ref_sig) else "<end>"
+        out.append((name,
+                    f"collective #{i} is {ours} but stage "
+                    f"'{ref_name}' issues {theirs} "
+                    f"({len(sig)} vs {len(ref_sig)} collectives total)"))
+    return out
+
+
+class CollectiveConsistencyPass(LintPass):
+    """Group targets by ``meta['stage_group']`` and require identical
+    collective signatures inside each group. Run via
+    :func:`framework.run_passes` this fires once per target but keeps
+    state, reporting each group exactly once (on its last member)."""
+
+    name = "collective-consistency"
+
+    def __init__(self):
+        self._groups = {}
+
+    def run(self, target: GraphTarget) -> List[Finding]:
+        group = target.meta.get("stage_group")
+        if group is None:
+            return []
+        members = self._groups.setdefault(group, [])
+        members.append((target.name, target.jaxpr))
+        total = target.meta.get("stage_count")
+        if total is None or len(members) < total:
+            return []
+        findings = []
+        for name, desc in check_stage_consistency(members):
+            findings.append(Finding(
+                pass_name=self.name, severity=Severity.ERROR,
+                graph=name,
+                message=f"pipeline stage group '{group}': {desc}"))
+        return findings

@@ -1,0 +1,159 @@
+"""Pass framework for the static-analysis subsystem.
+
+Reference capability: the reference ships IR-level passes and runtime
+enforcement (``paddle/pir`` pass infrastructure, ``phi/core/enforce.h``
+check macros). The JAX-native counterpart analyses **jaxprs** — the
+one IR every flagship program already lowers through — plus host-side
+serving state (``kv_invariants.py``). This module is the shared
+plumbing: a finding record, a pass protocol, and a report that the
+``tools/graph_lint.py`` CLI and the tests consume identically.
+
+A pass is a callable object with ``name`` / ``run(target) ->
+List[Finding]``. Targets are :class:`GraphTarget` records (a traced
+jaxpr plus the metadata passes need: declared compute dtype, which
+outputs the caller donates/rebinds, how many batch slots the program
+serves). Passes never run the program — everything here is tracing
+plus host-side walks, so linting the flagship serving graphs costs
+milliseconds, not XLA compiles.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Severity", "Finding", "GraphTarget", "LintPass",
+           "LintReport", "run_passes", "trace_graph"]
+
+
+class Severity:
+    ERROR = "error"      # invariant violated / silent-wrongness class
+    WARNING = "warning"  # perf hazard, suspicious but not provably wrong
+    INFO = "info"        # informational (counts, program inventories)
+
+    ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+@dataclass
+class Finding:
+    """One lint result: which pass, on which graph, what and where."""
+    pass_name: str
+    severity: str
+    graph: str                 # target name (e.g. "llama.serving_decode_block")
+    message: str
+    #: control-flow path to the offending eqn, e.g. (("scan","jaxpr"),)
+    path: Tuple = ()
+
+    def __str__(self) -> str:
+        loc = "/".join(p[0] for p in self.path) or "top"
+        return (f"[{self.severity}] {self.pass_name} @ {self.graph} "
+                f"({loc}): {self.message}")
+
+
+@dataclass
+class GraphTarget:
+    """A traced program plus the call-site facts passes need.
+
+    ``donated_outputs``: indices into the jaxpr's flat outputs that the
+    caller donates back in (pool arrays the engine rebinds) — they
+    never cross to the host, so host-sync accounting excludes them.
+    ``slots``: batch width of the program (decode-batch S), for
+    per-slot byte budgets. ``steps_per_call``: decode steps one call
+    advances (the fused block's k); host-pull budgets are per step.
+    ``in_decode_loop``: the program IS a per-tick decode body — the
+    host-sync pass applies its output-size budget only there.
+    """
+    name: str
+    jaxpr: Any                              # jax.core.ClosedJaxpr
+    compute_dtype: Any = None               # declared model dtype
+    donated_outputs: Tuple[int, ...] = ()
+    slots: int = 1
+    steps_per_call: int = 1
+    in_decode_loop: bool = False
+    meta: Dict[str, Any] = field(default_factory=dict)
+
+
+def trace_graph(name: str, fn: Callable, args: Sequence,
+                static_kwargs: Optional[Dict[str, Any]] = None,
+                **target_kw) -> GraphTarget:
+    """Trace ``fn(*args, **static_kwargs)`` to a :class:`GraphTarget`.
+    ``args`` may be ShapeDtypeStructs — tracing is abstract, nothing
+    executes."""
+    import jax
+    closed = jax.make_jaxpr(
+        lambda *a: fn(*a, **(static_kwargs or {})))(*args)
+    return GraphTarget(name=name, jaxpr=closed, **target_kw)
+
+
+class LintPass:
+    """Base class: subclasses set ``name`` and implement ``run``."""
+
+    name: str = "pass"
+
+    def run(self, target: GraphTarget) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, target: GraphTarget, message: str,
+                severity: str = Severity.ERROR,
+                path: Tuple = ()) -> Finding:
+        return Finding(pass_name=self.name, severity=severity,
+                       graph=target.name, message=message, path=path)
+
+
+@dataclass
+class LintReport:
+    findings: List[Finding] = field(default_factory=list)
+    #: (pass, graph) pairs that ran — a pass that never ran is not a
+    #: clean pass (the vacuous-pass lesson, ADVICE r5)
+    ran: List[Tuple[str, str]] = field(default_factory=list)
+
+    @property
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings
+                if f.severity == Severity.ERROR]
+
+    @property
+    def ok(self) -> bool:
+        return not self.errors
+
+    def extend(self, other: "LintReport") -> None:
+        self.findings.extend(other.findings)
+        self.ran.extend(other.ran)
+
+    def summary(self) -> str:
+        n_err = len(self.errors)
+        n_warn = sum(f.severity == Severity.WARNING for f in self.findings)
+        return (f"{len(self.ran)} pass runs, {n_err} errors, "
+                f"{n_warn} warnings")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "runs": len(self.ran),
+            "findings": [
+                {"pass": f.pass_name, "severity": f.severity,
+                 "graph": f.graph, "message": f.message,
+                 "path": ["/".join(p) for p in f.path]}
+                for f in sorted(
+                    self.findings,
+                    key=lambda f: Severity.ORDER.get(f.severity, 9))],
+        }
+
+
+def run_passes(passes: Sequence[LintPass],
+               targets: Sequence[GraphTarget]) -> LintReport:
+    """Run every pass over every target; findings are accumulated, a
+    pass raising is converted into an ERROR finding (a crashed linter
+    must never read as a clean one)."""
+    report = LintReport()
+    for target in targets:
+        for p in passes:
+            try:
+                found = p.run(target)
+            except Exception as e:  # noqa: BLE001 - surfaced as finding
+                found = [Finding(
+                    pass_name=p.name, severity=Severity.ERROR,
+                    graph=target.name,
+                    message=f"pass crashed: {type(e).__name__}: {e}")]
+            report.findings.extend(found)
+            report.ran.append((p.name, target.name))
+    return report

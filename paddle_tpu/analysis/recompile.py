@@ -1,0 +1,150 @@
+"""Recompile-hazard pass: statically enumerate the program set a
+serving call site can produce.
+
+``serving_prefill_chunk`` takes ``prefix_pages`` as a STATIC argument
+— the gathered-prefix width is a shape — so every distinct value XLA
+sees is one more compile, and compiles land *inside the serving tick*
+(a multi-second stall per novel prefix length, the compile-storm
+failure mode the r8 attach quantum exists to prevent). Whether the
+quantum actually bounds the set is a function of pure host-side
+geometry: page size, slot budget, prompt buckets, attach quantum and
+chunk size. This pass enumerates the reachable set exactly and proves
+(or refutes) the ≤``limit``-programs-per-bucket invariant *before* any
+traffic runs.
+
+Reachability model (mirrors ``ServingEngine`` dispatch exactly):
+
+* the engine calls the chunk program with width ``tb`` = the prefill
+  chunk (when chunking is on) or the suffix bucket (prefix-hit path),
+  and ``prefix_pages`` = (attached cached pages) + (chunks already
+  written) · (chunk pages);
+* attached pages are multiples of ``attach_quantum`` capped by the
+  match cap ``floor((n-1)/ps)`` (one suffix token always remains);
+* chunk starts are page-aligned multiples of the chunk size past the
+  attach point; every start must leave ≥ 1 prompt token.
+
+The compiled-program key is ``(tb, prefix_pages)``; the invariant is
+``|{prefix_pages}| ≤ limit`` per width bucket. Prefill/decode program
+counts (one per prompt bucket, one decode shape) are reported as INFO
+so the CLI shows the whole compile inventory.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Set
+
+from .framework import Finding, GraphTarget, LintPass, Severity
+
+__all__ = ["ServingGeometry", "enumerate_chunk_programs",
+           "RecompileHazardPass"]
+
+
+@dataclass
+class ServingGeometry:
+    """The host-side facts that determine the serving program set."""
+    page_size: int
+    pages_per_slot: int
+    buckets: List[int]          # prompt-length buckets (sorted)
+    attach_quantum: int = 1     # 0/None = prefix cache off
+    prefill_chunk: Optional[int] = None
+
+    @staticmethod
+    def of_engine(engine) -> "ServingGeometry":
+        """Extract the geometry from a live ``ServingEngine``."""
+        return ServingGeometry(
+            page_size=engine.pool.page_size,
+            pages_per_slot=engine.scheduler.pages_per_slot,
+            buckets=list(engine._buckets),
+            attach_quantum=(engine.prefix_cache.attach_quantum
+                            if engine.prefix_cache is not None else 0),
+            prefill_chunk=engine._chunk)
+
+
+def _bucket(n: int, buckets) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def enumerate_chunk_programs(geom: ServingGeometry) -> Dict[int,
+                                                            Set[int]]:
+    """Exact reachable ``{chunk_width: {prefix_pages}}`` under the
+    engine's dispatch rules. Empty when no code path can ever call the
+    chunk program (no cache and no chunking)."""
+    ps = geom.page_size
+    q = geom.attach_quantum
+    chunk = geom.prefill_chunk
+    max_prompt = geom.buckets[-1]
+    out: Dict[int, Set[int]] = {}
+    if not q and chunk is None:
+        return out
+
+    def add(width: int, pp: int) -> None:
+        out.setdefault(int(width), set()).add(int(pp))
+
+    c_pages = chunk // ps if chunk is not None else None
+    for n in range(1, max_prompt + 1):
+        cap = (n - 1) // ps                      # match cap: >=1 suffix tok
+        attaches = [0]
+        if q:
+            attaches = list(range(0, (cap // q) * q + 1, q))
+        for a in attaches:
+            suffix = n - a * ps
+            if chunk is None:
+                if a == 0:
+                    continue    # whole-prompt prefill program, not chunk
+                add(_bucket(suffix, geom.buckets), a)
+                continue
+            if suffix <= chunk:
+                add(chunk, a)   # single suffix chunk at width `chunk`
+                continue
+            # parked: one chunk per tick at page-aligned starts
+            start_pages = a
+            done = 0
+            while done < suffix:
+                add(chunk, start_pages)
+                take = min(suffix - done, chunk)
+                done += take
+                start_pages += c_pages
+    return out
+
+
+class RecompileHazardPass(LintPass):
+    """Runs on targets whose ``meta['geometry']`` is a
+    :class:`ServingGeometry` (the CLI attaches the flagship engines');
+    jaxpr-free — the hazard is host-side dispatch, not graph content."""
+
+    name = "recompile-hazard"
+
+    def __init__(self, limit: int = 16):
+        self.limit = int(limit)
+
+    def run(self, target: GraphTarget) -> List[Finding]:
+        geom = target.meta.get("geometry")
+        if geom is None:
+            return []
+        findings: List[Finding] = []
+        programs = enumerate_chunk_programs(geom)
+        total = sum(len(v) for v in programs.values())
+        for width in sorted(programs):
+            vals = programs[width]
+            if len(vals) > self.limit:
+                lo, hi = min(vals), max(vals)
+                findings.append(self.finding(
+                    target,
+                    f"chunk-prefill width {width} reaches "
+                    f"{len(vals)} distinct static prefix_pages values "
+                    f"(range {lo}..{hi}) > limit {self.limit}: each is "
+                    f"one XLA compile inside the serving tick — raise "
+                    f"attach_quantum/prefill_chunk or shrink the "
+                    f"prompt budget"))
+        findings.append(self.finding(
+            target,
+            f"program inventory: {len(geom.buckets)} prefill buckets, "
+            f"{total} chunk programs over {len(programs)} width(s), "
+            f"1 decode shape — proven bound "
+            f"{max((len(v) for v in programs.values()), default=0)} "
+            f"prefix_pages/bucket (limit {self.limit})",
+            severity=Severity.INFO))
+        return findings

@@ -1,0 +1,196 @@
+"""Flagship serving-graph targets for the lint passes.
+
+One place that knows how to hand each flagship program to the
+analysers: abstract-trace (``jax.make_jaxpr`` over ShapeDtypeStructs —
+nothing allocates, nothing compiles) the serving step functions of a
+model module exactly as the engine jits them, tagged with the
+call-site facts the passes need (compute dtype, donated pool outputs,
+slot/step counts, engine geometry for the recompile pass, pp stage
+grouping for the collective pass).
+
+The geometries here are the FLAGSHIP shapes — the ones the engine
+tests and serving_bench drive on the CPU mesh — shrunk to tiny model
+dims (linting is structural; hidden size changes nothing a pass looks
+at, while tracing a 4-layer model keeps the CLI under a second).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .framework import GraphTarget, trace_graph
+from .recompile import ServingGeometry, enumerate_chunk_programs
+
+__all__ = ["engine_geometry", "serving_targets", "pp_stage_targets",
+           "FLAGSHIP_MODELS"]
+
+FLAGSHIP_MODELS = ("llama", "qwen2_moe")
+
+
+def engine_geometry(*, page_size: int, max_prompt_len: int,
+                    max_new_tokens_cap: int,
+                    prefill_chunk: Optional[int] = None,
+                    prompt_buckets=None,
+                    prefix_cache: bool = True) -> ServingGeometry:
+    """The ``ServingGeometry`` a ``ServingEngine(**same_kwargs)`` would
+    run — the same arithmetic as the engine ctor, computable without
+    building pools or starting workers (tests pin the two against each
+    other so this cannot drift)."""
+    from ..serving.engine import _default_buckets
+    buckets = sorted(set(int(b) for b in (
+        prompt_buckets or _default_buckets(max_prompt_len))))
+    pages_per_slot = -(-(buckets[-1] + max_new_tokens_cap - 1)
+                       // page_size)
+    quantum = max(1, -(-pages_per_slot // 16))
+    if prefill_chunk is not None:
+        # chunk ticks advance prefix_pages on the chunk grid, so the
+        # attach grid IS the chunk grid (see ServingEngine.__init__)
+        quantum = prefill_chunk // page_size
+    return ServingGeometry(
+        page_size=page_size, pages_per_slot=pages_per_slot,
+        buckets=buckets,
+        attach_quantum=quantum if prefix_cache else 0,
+        prefill_chunk=prefill_chunk)
+
+
+def _get_model(name: str):
+    if name == "llama":
+        from ..models import llama as mod
+        cfg = mod.LlamaConfig.tiny(use_flash_attention=False, remat=False)
+    elif name == "qwen2_moe":
+        from ..models import qwen2_moe as mod
+        cfg = mod.Qwen2MoeConfig.tiny(use_flash_attention=False,
+                                      remat=False)
+    else:
+        raise ValueError(f"unknown flagship model {name!r}; "
+                         f"one of {FLAGSHIP_MODELS}")
+    return mod, cfg
+
+
+def serving_targets(model: str = "llama", *, slots: int = 4,
+                    page_size: int = 4, max_prompt_len: int = 16,
+                    max_new_tokens_cap: int = 16,
+                    prefill_chunk: int = 8,
+                    decode_block: int = 4) -> List[GraphTarget]:
+    """GraphTargets for one model's flagship serving programs:
+    ``serving_prefill_chunk`` (cold + max-prefix variants),
+    ``serving_decode_block`` (the fused greedy tick) and
+    ``generate_paged`` (the offline batched decode), plus a jaxpr-free
+    geometry target for the recompile-hazard pass."""
+    import jax
+    import jax.numpy as jnp
+
+    mod, cfg = _get_model(model)
+    geom = engine_geometry(
+        page_size=page_size, max_prompt_len=max_prompt_len,
+        max_new_tokens_cap=max_new_tokens_cap,
+        prefill_chunk=prefill_chunk)
+    pps = geom.pages_per_slot
+    total_pages = slots * pps + 1
+    meta: Dict[str, Any] = {}
+    if model == "qwen2_moe":
+        # the router GEMM is fp32 BY DESIGN (stable softmax over expert
+        # logits — see qwen2_moe.init_params): declare the island so
+        # the dtype-drift pass pins every OTHER wide dot. The predicate
+        # is shape-tight: only a projection onto the expert dim passes.
+        n_e = cfg.num_experts
+        meta["wide_dot_ok"] = (
+            lambda lhs, rhs: rhs.shape and rhs.shape[-1] == n_e)
+
+    params = mod.abstract_params(cfg)
+    pools = jax.eval_shape(
+        lambda: mod.init_serving_pages(cfg, total_pages, page_size))
+    kp, vp = pools["k_pages"], pools["v_pages"]
+    sds = jax.ShapeDtypeStruct
+    i32 = jnp.int32
+
+    targets: List[GraphTarget] = []
+
+    # --- chunk prefill: the two extreme static prefix_pages values ---
+    max_pp = max((max(v) for v in
+                  enumerate_chunk_programs(geom).values()), default=0)
+    for pp in sorted({0, max_pp}):
+        targets.append(trace_graph(
+            f"{model}.serving_prefill_chunk[prefix_pages={pp}]",
+            mod.serving_prefill_chunk,
+            (params, sds((1, prefill_chunk), i32), sds((), i32),
+             sds((pps,), i32), kp, vp),
+            static_kwargs=dict(cfg=cfg, prefix_pages=pp,
+                               attn_impl="dense"),
+            compute_dtype=cfg.dtype, slots=1, meta=dict(meta)))
+
+    # --- fused greedy decode block: the per-tick hot program ---------
+    targets.append(trace_graph(
+        f"{model}.serving_decode_block[k={decode_block}]",
+        mod.serving_decode_block,
+        (params, sds((slots,), i32), sds((slots,), i32),
+         sds((slots, pps), i32), kp, vp),
+        static_kwargs=dict(cfg=cfg, num_steps=decode_block,
+                           attn_impl="dense"),
+        compute_dtype=cfg.dtype, slots=slots,
+        steps_per_call=decode_block, in_decode_loop=True,
+        # outputs (toks, k_pages, v_pages): the engine donates + rebinds
+        # the pools, so only toks crosses to the host
+        donated_outputs=(1, 2),
+        meta=dict(meta, geometry=geom)))
+
+    # --- offline batched decode: generate_paged ----------------------
+    if hasattr(mod, "generate_paged"):
+        B, T0, mnt = slots, max_prompt_len, max_new_tokens_cap
+        targets.append(trace_graph(
+            f"{model}.generate_paged[B={B}]",
+            mod.generate_paged,
+            (params, sds((B, T0), i32), sds((B,), i32)),
+            static_kwargs=dict(cfg=cfg, max_new_tokens=mnt,
+                               page_size=page_size, attn_impl="dense"),
+            compute_dtype=cfg.dtype, slots=B, steps_per_call=mnt,
+            in_decode_loop=True, meta=dict(meta)))
+    return targets
+
+
+def pp_stage_targets(num_stages: int = 2, virtual_chunks: int = 2,
+                     seq_len: int = 8, batch: int = 2
+                     ) -> List[GraphTarget]:
+    """One GraphTarget per pipeline stage chunk of the flagship llama
+    pp path (the round-robin VPP partition feeding
+    ``pipeline_train_1f1b``), grouped for the collective-consistency
+    pass: every chunk program must issue the identical collective
+    sequence or the lockstep schedule deadlocks/corrupts."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models import llama as L
+    from ..parallel.pipeline_1f1b import split_chunks_round_robin
+
+    cfg = L.LlamaConfig.tiny(use_flash_attention=False, remat=False,
+                             pp_stages=num_stages,
+                             vpp_chunks=virtual_chunks)
+    params = L.abstract_params(cfg)
+    VS = num_stages * virtual_chunks
+    x = jax.ShapeDtypeStruct((batch, seq_len, cfg.hidden_size),
+                             cfg.dtype)
+
+    def stage_fn(chunk_params, xm):
+        return L._scan_layers(chunk_params, xm, cfg, None,
+                              remat=False)
+
+    targets = []
+    for k in range(VS):
+        # each stage traces ITS OWN chunk slice (abstract-indexed out
+        # of the real round-robin split) — so a future heterogeneous
+        # partition, or any chunk-dependent program difference, shows
+        # up as a genuinely different jaxpr rather than the check
+        # comparing VS copies of one trace against itself
+        chunk_k = jax.eval_shape(
+            lambda p, k=k: jax.tree_util.tree_map(
+                lambda c: c[k],
+                split_chunks_round_robin(
+                    p, cfg.num_hidden_layers, num_stages,
+                    virtual_chunks)),
+            params["layers"])
+        targets.append(trace_graph(
+            f"llama.pp_stage_chunk[{k}/{VS}]", stage_fn, (chunk_k, x),
+            compute_dtype=cfg.dtype,
+            meta={"stage_group": f"llama.pp[{num_stages}x"
+                                 f"{virtual_chunks}]",
+                  "stage_count": VS}))
+    return targets

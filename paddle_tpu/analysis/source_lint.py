@@ -1,0 +1,201 @@
+"""AST-level source lint: the ruff-shaped subset that runs anywhere.
+
+The pre-merge check is ``ruff check`` (configured in pyproject.toml) —
+but ruff is a rust binary the runtime container does not ship, and a
+pre-merge gate that silently no-ops when its linter is missing is the
+vacuous-pass anti-pattern. This module implements the highest-signal
+rules with the stdlib ``ast`` so ``tools/graph_lint.py --ci`` always
+lints source, with ruff layered on top when available:
+
+* **unused-import** (F401): a module-level import never referenced.
+  Conservative by construction — names re-exported via ``__all__``,
+  imports under ``try``/``except`` (version shims), ``__future__``,
+  and any textual use (docstring examples excluded) are kept; only
+  imports with zero occurrences anywhere else in the file flag.
+* **none-compare** (E711): ``== None`` / ``!= None``.
+* **bare-except** (E722): ``except:`` catching BaseException silently.
+* **mutable-default** (B006): ``def f(x=[])`` / ``{}`` / ``set()``.
+
+Scope: ``paddle_tpu/`` and ``tools/`` (tests use pytest fixtures whose
+"unused" imports are the fixture mechanism).
+"""
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import List, Tuple
+
+__all__ = ["lint_file", "lint_tree"]
+
+
+def _import_names(node) -> List[Tuple[str, str]]:
+    """(bound_name, display) pairs one import statement binds."""
+    out = []
+    if isinstance(node, ast.Import):
+        for a in node.names:
+            bound = a.asname or a.name.split(".")[0]
+            out.append((bound, a.name))
+    elif isinstance(node, ast.ImportFrom):
+        if node.module == "__future__":
+            return []
+        for a in node.names:
+            if a.name == "*":
+                return []
+            out.append((a.asname or a.name, a.name))
+    return out
+
+
+def _code_text_without_import_lines(src: str, tree) -> str:
+    """Source with module-level import statements and comments blanked
+    — what a name must appear in to count as 'used'."""
+    lines = src.splitlines()
+    drop = set()
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for i in range(node.lineno, (node.end_lineno or
+                                         node.lineno) + 1):
+                drop.add(i)
+    kept = [("" if i + 1 in drop else ln)
+            for i, ln in enumerate(lines)]
+    text = "\n".join(kept)
+    # strip comments (a name in a comment is not a use)
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(text).readline)
+        spans = [(t.start, t.end) for t in toks
+                 if t.type == tokenize.COMMENT]
+    except tokenize.TokenError:
+        spans = []
+    if spans:
+        out = text.splitlines()
+        for (r0, c0), (_, c1) in spans:
+            ln = out[r0 - 1]
+            out[r0 - 1] = ln[:c0] + " " * (c1 - c0) + ln[c1:]
+        text = "\n".join(out)
+    return text
+
+
+_NOQA = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?",
+                   re.IGNORECASE)
+
+
+def _noqa_map(src: str):
+    """lineno -> set of suppressed codes (empty set = suppress all)."""
+    out = {}
+    for i, ln in enumerate(src.splitlines(), start=1):
+        m = _NOQA.search(ln)
+        if m:
+            codes = m.group("codes")
+            out[i] = (set(c.strip().upper()
+                          for c in codes.split(",") if c.strip())
+                      if codes else set())
+    return out
+
+
+def lint_file(path: Path, src: str = None) -> List[Tuple]:
+    """[(rule, lineno, message)] for one file. ``# noqa`` (optionally
+    ``# noqa: F401,E711``) on the statement's first line suppresses."""
+    if src is None:
+        src = Path(path).read_text()
+    try:
+        tree = ast.parse(src, filename=str(path))
+    except SyntaxError as e:
+        return [("E999", e.lineno or 0, f"syntax error: {e.msg}")]
+    findings: List[Tuple] = []
+    name = Path(path).name
+    noqa = _noqa_map(src)
+
+    def suppressed(rule: str, line: int) -> bool:
+        codes = noqa.get(line)
+        return codes is not None and (not codes or rule in codes)
+
+    # ---- unused module-level imports (skip __init__ re-export files) -
+    if name != "__init__.py":
+        guarded = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Try):
+                for n in ast.walk(node):
+                    if isinstance(n, (ast.Import, ast.ImportFrom)):
+                        guarded.add(id(n))
+        body_text = _code_text_without_import_lines(src, tree)
+        exported = set()
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "__all__"
+                            for t in node.targets)):
+                try:
+                    exported |= set(ast.literal_eval(node.value))
+                except (ValueError, TypeError):
+                    pass
+        for node in tree.body:
+            if not isinstance(node, (ast.Import, ast.ImportFrom)):
+                continue
+            if id(node) in guarded:
+                continue
+            for bound, display in _import_names(node):
+                if bound in exported or bound.startswith("_"):
+                    continue
+                if re.search(rf"\b{re.escape(bound)}\b", body_text):
+                    continue
+                if suppressed("F401", node.lineno):
+                    continue
+                findings.append((
+                    "F401", node.lineno,
+                    f"`{display}` imported as `{bound}` but unused"))
+
+    for node in ast.walk(tree):
+        # ---- == None / != None ----------------------------------
+        if isinstance(node, ast.Compare):
+            for op, cmp_ in zip(node.ops, node.comparators):
+                if (isinstance(op, (ast.Eq, ast.NotEq))
+                        and isinstance(cmp_, ast.Constant)
+                        and cmp_.value is None
+                        and not suppressed("E711", node.lineno)):
+                    kind = "==" if isinstance(op, ast.Eq) else "!="
+                    findings.append((
+                        "E711", node.lineno,
+                        f"comparison `{kind} None` — use "
+                        f"`is{' not' if kind == '!=' else ''} None`"))
+        # ---- bare except ----------------------------------------
+        if (isinstance(node, ast.ExceptHandler) and node.type is None
+                and not suppressed("E722", node.lineno)):
+            findings.append((
+                "E722", node.lineno,
+                "bare `except:` — catch a concrete exception (or "
+                "`Exception`)"))
+        # ---- mutable default args -------------------------------
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None]:
+                if suppressed("B006", node.lineno):
+                    continue
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                        isinstance(d, ast.Call)
+                        and isinstance(d.func, ast.Name)
+                        and d.func.id in ("list", "dict", "set")
+                        and not d.args and not d.keywords):
+                    findings.append((
+                        "B006", node.lineno,
+                        f"mutable default argument in "
+                        f"`{node.name}()` — shared across calls"))
+    return findings
+
+
+def lint_tree(root: Path, subdirs=("paddle_tpu", "tools")
+              ) -> List[Tuple]:
+    """[(path, rule, lineno, message)] over the repo's lintable set."""
+    root = Path(root)
+    out: List[Tuple] = []
+    for sub in subdirs:
+        base = root / sub
+        if not base.exists():
+            continue
+        for p in sorted(base.rglob("*.py")):
+            if "__pycache__" in p.parts:
+                continue
+            for rule, line, msg in lint_file(p):
+                out.append((str(p.relative_to(root)), rule, line, msg))
+    return out

@@ -8,7 +8,7 @@ computation instead of the reference's row-by-row double-grad loops.
 """
 from __future__ import annotations
 
-from typing import Callable, Sequence, Union
+from typing import Callable
 
 import jax
 import jax.numpy as jnp

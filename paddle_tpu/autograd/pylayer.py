@@ -10,7 +10,6 @@ see paddle_tpu.incubate.custom_vjp).
 """
 from __future__ import annotations
 
-from typing import Any
 
 import jax
 

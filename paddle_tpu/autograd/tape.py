@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import itertools
 import threading
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Callable, List, Sequence
 
 import jax
 import jax.numpy as jnp

@@ -1,4 +1,4 @@
-"""Shared layer-graph tracer.
+"""Shared layer-graph tracer + jaxpr walking utilities.
 
 One tracing forward that records, at TOP level (outside any leaf
 layer), both leaf-layer calls and functional registry ops — the
@@ -6,15 +6,62 @@ machinery behind `onnx/export.py` (graph emission) and
 `inference/passes.py` (dataflow-verified folds). Keeping it in one
 place means tuple outputs, kwargs tensors and consumer accounting
 behave identically for every consumer of the trace.
+
+The jaxpr side (``iter_jaxpr_eqns`` / ``sub_jaxprs``) is the shared
+walk every jaxpr-level analysis uses (``paddle_tpu/analysis``): one
+recursive traversal that sees through scan/while/cond/pjit/remat/
+shard_map bodies, yielding each equation with the control-flow path
+that reaches it — so a pass written against flat equations works
+unchanged on the serving graphs, whose hot loops all live inside
+``lax.scan``.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Dict, List, Set, Tuple
+from typing import Any, Dict, Iterator, List, Set, Tuple
 
 import jax
+from jax._src import core as jax_core
 
 from .tensor import Tensor
+
+
+# ---------------------------------------------------------------------------
+# jaxpr traversal
+# ---------------------------------------------------------------------------
+
+def sub_jaxprs(eqn) -> List[Tuple[str, "jax_core.Jaxpr"]]:
+    """The (label, jaxpr) bodies nested inside one equation.
+
+    Covers every closed-jaxpr-carrying param jax uses across versions
+    (scan/while/cond/pjit/custom_vjp/remat/shard_map/...) by TYPE, not
+    by a primitive-name allowlist — a new primitive with a jaxpr param
+    is walked automatically instead of silently skipped."""
+    out = []
+    for name, val in eqn.params.items():
+        vals = val if isinstance(val, (list, tuple)) else (val,)
+        for i, v in enumerate(vals):
+            label = name if len(vals) == 1 else f"{name}[{i}]"
+            if isinstance(v, jax_core.ClosedJaxpr):
+                out.append((label, v.jaxpr))
+            elif isinstance(v, jax_core.Jaxpr):
+                out.append((label, v))
+    return out
+
+
+def iter_jaxpr_eqns(jaxpr, path: Tuple = ()) -> Iterator[Tuple[Tuple,
+                                                               Any]]:
+    """Yield ``(path, eqn)`` for every equation, depth-first, where
+    ``path`` is the chain of ``(primitive_name, param_label)`` frames
+    that reaches the equation (empty for top level). ``jaxpr`` may be a
+    ``ClosedJaxpr`` or a raw ``Jaxpr``."""
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    for eqn in jaxpr.eqns:
+        yield path, eqn
+        for label, sub in sub_jaxprs(eqn):
+            yield from iter_jaxpr_eqns(
+                sub, path + ((eqn.primitive.name, label),))
 
 
 @dataclass

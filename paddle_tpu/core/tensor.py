@@ -14,7 +14,7 @@ static program loses its eager grad node).
 """
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional
+from typing import Callable, List, Optional
 
 import numpy as np
 import jax

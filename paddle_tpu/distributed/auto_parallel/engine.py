@@ -35,7 +35,7 @@ Fully heterogeneous graphs (no identical-block run) still raise.
 from __future__ import annotations
 
 import contextlib
-from typing import Any, Callable, List, Optional, Sequence
+from typing import Callable, List, Optional
 
 import numpy as np
 import jax

@@ -21,7 +21,6 @@ from __future__ import annotations
 
 from typing import Any, List, Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

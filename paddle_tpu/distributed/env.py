@@ -9,7 +9,6 @@ come from jax.process_index()/process_count().
 from __future__ import annotations
 
 import os
-from typing import Optional
 
 import jax
 

@@ -8,9 +8,7 @@ Parameter-server types (entries, *Dataset) are documented non-goals
 from __future__ import annotations
 
 import os
-from typing import List, Optional
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

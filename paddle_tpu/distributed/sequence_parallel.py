@@ -13,12 +13,9 @@ helpers give the explicit shard_map forms for custom schedules.
 """
 from __future__ import annotations
 
-import jax
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec
 
 from ..core.tensor import Tensor
-from ..parallel.mesh import get_hybrid_mesh
 from .mpu import ColumnParallelLinear, RowParallelLinear, _tp_put
 
 

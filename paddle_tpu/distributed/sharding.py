@@ -15,7 +15,6 @@ layout.
 from __future__ import annotations
 
 import warnings
-from typing import Optional
 
 import jax
 from jax.sharding import NamedSharding, PartitionSpec

@@ -13,7 +13,6 @@ import pickle
 from typing import Any
 
 import numpy as np
-import jax
 
 from ..core.tensor import Tensor, Parameter
 

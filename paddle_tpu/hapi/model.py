@@ -11,12 +11,10 @@ functional train steps or jit.to_static on the Layer.
 from __future__ import annotations
 
 import os
-import pickle
-from typing import List, Optional, Sequence
+from typing import List
 
 import numpy as np
 
-from ..core.tensor import Tensor
 from ..io.dataloader import DataLoader
 from ..io.dataset import Dataset
 from ..metric import Metric

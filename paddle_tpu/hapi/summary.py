@@ -1,7 +1,6 @@
 """Model summary (reference: python/paddle/hapi/model_summary.py)."""
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 

@@ -10,7 +10,7 @@ unsharded (single device) with identical numerics.
 """
 from __future__ import annotations
 
-from typing import Callable, List, Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

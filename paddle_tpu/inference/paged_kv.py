@@ -106,6 +106,12 @@ class PagePool:
 
     # ------------------------------------------------- serving helpers ----
     @property
+    def free_page_ids(self) -> frozenset:
+        """Snapshot of the free ids (audit/debug introspection — the
+        invariant checker reads this instead of the mutable internals)."""
+        return frozenset(self._free_set)
+
+    @property
     def used_pages(self) -> int:
         """Pages currently handed out (trash page excluded)."""
         return self.total_pages - 1 - len(self._free)

@@ -23,7 +23,7 @@ replaced by an identity layer so container indices keep working.
 """
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Tuple
+from typing import Tuple
 
 import numpy as np
 import jax.numpy as jnp

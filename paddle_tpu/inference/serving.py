@@ -25,7 +25,7 @@ import queue
 import threading
 from collections import deque
 from concurrent.futures import Future
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Callable, Optional, Sequence
 
 import numpy as np
 

@@ -3,7 +3,6 @@ batch_sampler.py)."""
 from __future__ import annotations
 
 import math
-from typing import Iterator, List, Optional
 
 import numpy as np
 

@@ -13,7 +13,7 @@ import ctypes
 import os
 import pickle
 import uuid
-from typing import Any, List, Optional, Tuple
+from typing import Any, List
 
 import numpy as np
 

@@ -16,7 +16,6 @@ qkv/ffn Linears) the same way any Linear-based Layer does.
 """
 from __future__ import annotations
 
-import numpy as np
 import jax.numpy as jnp
 
 from .. import nn

@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional
 
 import numpy as np
 import jax
@@ -131,6 +131,14 @@ def init_params(cfg: LlamaConfig, key: jax.Array) -> Dict[str, Any]:
         "final_norm": jnp.ones((D,), cfg.dtype),
         "lm_head": init(ks[8], (D, V), D),
     }
+
+
+def abstract_params(cfg: LlamaConfig):
+    """ShapeDtypeStruct pytree of ``init_params`` output without
+    computing (or allocating) anything — what tracing-only tooling
+    (analysis/serving_graphs.py graph lint, cost models) feeds to
+    ``jax.make_jaxpr`` so a lint run costs milliseconds, not an init."""
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
 
 
 def param_specs(cfg: LlamaConfig) -> Dict[str, Any]:

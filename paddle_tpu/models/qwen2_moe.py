@@ -389,6 +389,12 @@ def make_batch(cfg: Qwen2MoeConfig, batch_size: int, seq_len: int,
 # dispatches on the config type.
 
 
+def abstract_params(cfg: Qwen2MoeConfig):
+    """ShapeDtypeStruct pytree of ``init_params`` (tracing-only
+    tooling; see models/llama.py abstract_params)."""
+    return jax.eval_shape(lambda: init_params(cfg, jax.random.PRNGKey(0)))
+
+
 def init_serving_pages(cfg: Qwen2MoeConfig, total_pages: int,
                        page_size: int):
     from .llama import init_serving_pages as _impl

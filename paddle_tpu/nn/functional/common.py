@@ -3,7 +3,6 @@
 flash_attention.py:242)."""
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 import jax

@@ -5,13 +5,12 @@ ConvGeneralDilated which maps onto the MXU directly — no cuDNN-style
 algorithm search needed (XLA picks the layout)."""
 from __future__ import annotations
 
-from typing import Optional, Sequence, Union
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ...ops.registry import register_op, call_op
+from ...ops.registry import register_op
 
 
 def _pair(v, n):

@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ...ops.registry import register_op, call_op, OPS
+from ...ops.registry import register_op
 from ...core.tensor import Tensor
 
 __all__ = [

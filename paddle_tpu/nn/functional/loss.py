@@ -1,7 +1,6 @@
 """Loss functionals (reference: python/paddle/nn/functional/loss.py)."""
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

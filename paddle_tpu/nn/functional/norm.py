@@ -4,7 +4,6 @@ these are jnp reductions + elementwise — XLA fuses them into single kernels,
 which is the CINN/fused-kernel replacement for norm ops."""
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

@@ -1,10 +1,7 @@
 """Basic layers (reference: python/paddle/nn/layer/common.py)."""
 from __future__ import annotations
 
-import numpy as np
 
-from ..core import dtype as dtypes
-from ..core.tensor import Parameter, Tensor
 from . import functional as F
 from . import initializer as I
 from .layer import Layer

@@ -7,13 +7,12 @@ reference's layer/functional split).
 """
 from __future__ import annotations
 
-from typing import Optional
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .layer import Layer, Sequential
+from .layer import Layer
 from ..core.tensor import Tensor
 from . import functional as F
 from . import initializer as I

@@ -7,14 +7,12 @@ from __future__ import annotations
 
 import math
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ..core.tensor import Tensor
 from ..ops.registry import call_op
 from . import initializer as I
-from .layer import Layer, LayerList
+from .layer import Layer
 
 
 class RNNCellBase(Layer):

@@ -8,7 +8,6 @@ from __future__ import annotations
 
 import collections
 
-import numpy as np
 
 from ..ops import manipulation as man
 from . import functional as F

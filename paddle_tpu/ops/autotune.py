@@ -18,7 +18,7 @@ dominate; tests exercise the machinery on CPU where timing is honest.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, Sequence, Tuple
 
 import jax
 

@@ -1,7 +1,6 @@
 """Comparison & logical ops (reference: python/paddle/tensor/logic.py)."""
 from __future__ import annotations
 
-import numpy as np
 import jax.numpy as jnp
 
 from ..core.tensor import Tensor

@@ -1,7 +1,6 @@
 """Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
 from __future__ import annotations
 
-from typing import Optional, Sequence
 
 import numpy as np
 import jax

@@ -4,7 +4,6 @@ single relayout HLOs — XLA handles copy elision, so there is no view/stride
 machinery to replicate."""
 from __future__ import annotations
 
-from typing import List, Optional, Sequence, Union
 
 import numpy as np
 import jax

@@ -7,7 +7,6 @@ machinery (paddle/phi/kernels/funcs/elementwise_base.h).
 """
 from __future__ import annotations
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

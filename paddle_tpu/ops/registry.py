@@ -20,12 +20,11 @@ reference's monkey_patch_tensor) and the `_C_ops`-style flat namespace.
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Dict, Optional, Sequence
+from typing import Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
 
-from ..core import dtype as dtypes
 from ..core.flags import get_flag
 from ..core.tensor import Tensor
 from ..autograd import tape as _tape

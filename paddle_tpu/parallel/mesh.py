@@ -21,7 +21,7 @@ Axis naming convention (matching fleet's order topology.py:189):
 from __future__ import annotations
 
 import dataclasses
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import numpy as np
 import jax

@@ -17,13 +17,11 @@ overlaps the permute with compute.
 """
 from __future__ import annotations
 
-from functools import partial
 from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
 
 def stack_stage_params(params_list):

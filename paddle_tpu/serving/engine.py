@@ -39,6 +39,7 @@ latency histograms live in serving/metrics.py; prefill/decode spans are
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 from functools import partial
@@ -166,6 +167,14 @@ class ServingEngine:
     stall for in-flight streams while long prompts are absorbed).
     admission_window: 0 (default) = strict-FIFO admission; N lets up to
     N queued requests overtake a head whose page budget does not fit.
+    check_invariants: True runs the paged-KV invariant checker
+    (analysis/kv_invariants.py) after every tick and around every
+    defrag — the race-detector-style debug mode: any page-ownership /
+    refcount / dead-slot-row violation raises ``KVInvariantError``
+    instead of silently cross-contaminating KV. Default comes from the
+    ``PADDLE_TPU_SERVING_CHECK_INVARIANTS`` env var (the test suite
+    turns it on); cost is host-side only (<10% of a CPU-mesh tick,
+    measured in docs/ANALYSIS.md).
     """
 
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
@@ -178,7 +187,8 @@ class ServingEngine:
                  quantization: Optional[str] = None,
                  prefix_cache: bool = True,
                  prefill_chunk: Optional[int] = None,
-                 admission_window: int = 0):
+                 admission_window: int = 0,
+                 check_invariants: Optional[bool] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if prefill_chunk is not None:
@@ -221,13 +231,53 @@ class ServingEngine:
         # attach granularity: prefix_pages is a STATIC dim of the chunk
         # program, so unrestricted attach counts would compile one
         # program per distinct cached-prefix length; quantizing to
-        # multiples of ceil(pps/16) bounds the value set at <= 16 per
-        # engine while giving up at most quantum-1 pages of reuse
+        # multiples of ceil(pps/16) bounds the attach value set at
+        # <= 16 while giving up at most quantum-1 pages of reuse.
+        # Under chunked prefill the chunk ticks themselves advance
+        # prefix_pages in chunk-page steps, so chunk programs reach
+        # every multiple of chunk_pages REGARDLESS of attach quantum —
+        # an attach grid off the chunk grid only multiplies the union
+        # {attach + k*chunk_pages} toward ~pages_per_slot values (the
+        # pre-r9 hazard at prefix_ab geometry: 38 programs where <= 16
+        # was claimed), while a coarser grid than chunk_pages gives up
+        # reuse for nothing. The optimum is exactly the chunk grid;
+        # the residual bound is then user-controlled by the chunk size
+        # (ceil(max_prompt/prefill_chunk) programs) and checked below.
+        quantum = max(1, -(-pages_per_slot // 16))
+        if prefill_chunk is not None:
+            quantum = prefill_chunk // page_size
         self.prefix_cache = PrefixCache(
-            self.pool,
-            attach_quantum=max(1, -(-pages_per_slot // 16))) \
-            if prefix_cache else None
+            self.pool, attach_quantum=quantum) if prefix_cache else None
         self._chunk = prefill_chunk
+        # statically prove the chunk-program bound for THIS geometry
+        # (the recompile-hazard lint pass, analysis/recompile.py): a
+        # too-small chunk against a big prompt budget means one XLA
+        # compile per chunk start, landing inside serving ticks — warn
+        # at construction instead of stalling under traffic
+        if prefill_chunk is not None or self.prefix_cache is not None:
+            from ..analysis.recompile import (ServingGeometry,
+                                              enumerate_chunk_programs)
+            programs = enumerate_chunk_programs(ServingGeometry(
+                page_size=page_size, pages_per_slot=pages_per_slot,
+                buckets=list(self._buckets),
+                attach_quantum=quantum if self.prefix_cache is not None
+                else 0,
+                prefill_chunk=prefill_chunk))
+            worst = max((len(v) for v in programs.values()), default=0)
+            if worst > 16:
+                import warnings
+                warnings.warn(
+                    f"serving geometry reaches {worst} distinct "
+                    f"chunk-prefill programs in one width bucket "
+                    f"(> 16): each is an XLA compile inside a serving "
+                    f"tick. Raise prefill_chunk (or shrink "
+                    f"max_prompt_len) — see docs/ANALYSIS.md "
+                    f"recompile-hazard.", stacklevel=2)
+        if check_invariants is None:
+            check_invariants = os.environ.get(
+                "PADDLE_TPU_SERVING_CHECK_INVARIANTS", ""
+            ).strip().lower() in ("1", "true", "yes", "on")
+        self._check_invariants = bool(check_invariants)
         self.scheduler = Scheduler(
             max_batch=max_batch, pages_per_slot=pages_per_slot,
             pool=self.pool, max_queue=max_queue,
@@ -337,6 +387,26 @@ class ServingEngine:
             snap["gauges"]["prefix_cache"] = self.prefix_cache.stats()
         return snap
 
+    def audit(self):
+        """Standalone paged-KV invariant audit (serialized against
+        ticks): returns the violation list — empty when healthy."""
+        from ..analysis.kv_invariants import audit_serving_state
+        with self._tick_lock:
+            return audit_serving_state(
+                self.pool, self.scheduler, self.prefix_cache,
+                prefill_queue=tuple(self._prefill_q))
+
+    def _audit_or_raise(self) -> None:
+        """Per-tick debug-mode check (caller holds the tick lock)."""
+        from ..analysis.kv_invariants import (KVInvariantError,
+                                              audit_serving_state)
+        violations = audit_serving_state(
+            self.pool, self.scheduler, self.prefix_cache,
+            prefill_queue=tuple(self._prefill_q))
+        if violations:
+            self.metrics.inc("invariant_violations", len(violations))
+            raise KVInvariantError(violations)
+
     def defragment(self) -> int:
         """Compact live pages to the pool's low indices (the paged-KV
         defrag hook): rewrites the pool arrays + every live slot's table
@@ -346,6 +416,16 @@ class ServingEngine:
             plan = self.pool.defrag_plan()
             if not plan:
                 return 0
+            if self._check_invariants:
+                # closure check BEFORE anything is rewritten: the plan
+                # must cover every live reference source (rows, page
+                # lists, parked stashed rows, cached trie pages)
+                from ..analysis.kv_invariants import (KVInvariantError,
+                                                      audit_defrag_plan)
+                bad = audit_defrag_plan(plan, self.pool, self.scheduler,
+                                        self.prefix_cache)
+                if bad:
+                    raise KVInvariantError(bad)
             self._kp, self._vp, tables = apply_defrag(
                 plan, self._kp, self._vp, self.scheduler.tables)
             # np.array (not asarray): the jnp result is a zero-copy
@@ -355,6 +435,8 @@ class ServingEngine:
             if self.prefix_cache is not None:
                 self.prefix_cache.remap(plan)  # cached-node page ids
             self.pool.commit_defrag(plan)
+            if self._check_invariants:
+                self._audit_or_raise()
             return len(plan)
 
     # ------------------------------------------------------------ worker ----
@@ -442,8 +524,7 @@ class ServingEngine:
         take = min(n - start, tb)
         padded = np.zeros((1, tb), np.int32)
         padded[0, :take] = req.prompt[start:start + take]
-        row = req.table_row if req.table_row is not None \
-            else self.scheduler.tables[slot]
+        row = self.scheduler.effective_row(slot)
         jnp = self._jnp
         with RecordEvent("serving.prefill_chunk"):
             logits, self._kp, self._vp = self._chunk_jit(
@@ -604,6 +685,8 @@ class ServingEngine:
                         self._last_decode_t = time.perf_counter()
                     else:
                         self._last_decode_t = None
+                    if ticked and self._check_invariants:
+                        self._audit_or_raise()
                 if ticked:
                     # pace OUTSIDE the tick lock: sleeping inside it
                     # starves defragment() (python locks are unfair)

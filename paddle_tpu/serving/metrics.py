@@ -72,7 +72,7 @@ class ServingMetrics:
                 "timed_out", "rejected", "prefills", "prefill_chunks",
                 "decode_steps", "tokens_out", "prefix_hits",
                 "prefix_misses", "prefix_hit_tokens",
-                "prefix_pages_saved")
+                "prefix_pages_saved", "invariant_violations")
     HISTOGRAMS = ("queue_wait_s", "ttft_s", "decode_step_s",
                   "decode_stall_s", "batch_occupancy",
                   "page_utilization", "chunk_queue_depth")

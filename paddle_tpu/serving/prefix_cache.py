@@ -94,6 +94,11 @@ class PrefixCache:
         self.evictions = 0
 
     # ------------------------------------------------------------ sizing ----
+    def nodes(self):
+        """Snapshot list of every cached node (audit/debug
+        introspection — the paged-KV invariant checker walks these)."""
+        return list(self._nodes)
+
     @property
     def cached_pages(self) -> int:
         return len(self._nodes)

@@ -252,6 +252,16 @@ class Scheduler:
         retirement flushes and defrag remaps must see both)."""
         return [(i, r) for i, r in enumerate(self.slots) if r is not None]
 
+    def effective_row(self, slot: int) -> np.ndarray:
+        """The table row whose pages actually belong to the slot's
+        request: the stashed REAL row while the request is parked mid
+        chunked-prefill (the scheduler row is then all-TRASH for the
+        shared decode program), else the live scheduler row."""
+        req = self.slots[slot]
+        if req is not None and req.table_row is not None:
+            return req.table_row
+        return self.tables[slot]
+
     @property
     def occupancy(self) -> float:
         return sum(r is not None for r in self.slots) / self.max_batch

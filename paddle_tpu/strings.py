@@ -11,7 +11,7 @@ conversion to/from the numeric token tensors that DO go to the chip.
 """
 from __future__ import annotations
 
-from typing import Iterable, List, Optional, Sequence, Union
+from typing import List, Optional, Sequence, Union
 
 import numpy as np
 

@@ -32,7 +32,6 @@ from typing import Any, Callable, Dict, Optional, Sequence
 
 import numpy as np
 import jax
-import jax.numpy as jnp
 
 
 def include_paths() -> list:

@@ -24,7 +24,7 @@ TPU-native design notes:
 from __future__ import annotations
 
 import itertools
-from typing import List, Optional, Sequence
+from typing import Optional
 
 import numpy as np
 import jax

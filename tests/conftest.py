@@ -34,6 +34,14 @@ import jax
 # (production/bench keeps JAX's default TPU-friendly precision)
 jax.config.update("jax_default_matmul_precision", "highest")
 
+# every ServingEngine the suite builds runs the paged-KV invariant
+# checker after every tick (analysis/kv_invariants.py): the engine
+# tests in the smoke tier double as a continuous audit of page
+# ownership / refcounts / dead-slot rows — a bookkeeping bug fails the
+# suite at the tick that introduced it, not at some later token
+# mismatch. (Tests that need it OFF pass check_invariants=False.)
+os.environ.setdefault("PADDLE_TPU_SERVING_CHECK_INVARIANTS", "1")
+
 # persistent XLA compile cache: repeat suite runs (and reruns of a
 # single failing test) skip recompilation entirely
 _cache_dir = os.environ.get(
@@ -53,6 +61,7 @@ _SMOKE_MODULES = {
     "test_ops", "test_autograd", "test_llama", "test_generate",
     "test_paged_kv", "test_int8_decode", "test_inference", "test_moe",
     "test_pallas_kernels", "test_distributed", "test_prefix_cache",
+    "test_analysis",
 }
 
 

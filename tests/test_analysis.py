@@ -1,0 +1,573 @@
+"""Static-analysis subsystem (ISSUE r9).
+
+Detection is PROVEN, not assumed (the vacuous-pass lesson, ADVICE r5's
+`test_export_int_scalar_const_dtype`): every lint pass and the paged-KV
+invariant checker must (a) run clean on healthy flagship state and (b)
+catch a deliberately seeded bug of the exact class it exists for —
+f32-weight drift, host callbacks in decode loops, oversized host
+pulls, diverging pipeline collectives, unbounded chunk-program sets,
+corrupted refcounts, double-attached pages, stale defrag mappings,
+non-TRASH dead-slot rows.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu._compat import shard_map
+from paddle_tpu.analysis import (CollectiveConsistencyPass,
+                                 DtypeDriftPass, HostSyncPass,
+                                 KVInvariantError, RecompileHazardPass,
+                                 ServingGeometry, Severity,
+                                 audit_defrag_plan, audit_serving_state,
+                                 check_stage_consistency,
+                                 collective_signature, engine_geometry,
+                                 enumerate_chunk_programs,
+                                 pp_stage_targets, run_passes,
+                                 serving_targets, trace_graph)
+from paddle_tpu.inference.paged_kv import PagePool, apply_defrag
+from paddle_tpu.models import llama as L
+from paddle_tpu.serving import PrefixCache, ServingEngine
+
+sds = jax.ShapeDtypeStruct
+CFG = L.LlamaConfig.tiny(dtype=jnp.float32, use_flash_attention=False,
+                         remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return L.init_params(CFG, jax.random.PRNGKey(0))
+
+
+def _errors(findings):
+    return [f for f in findings if f.severity == Severity.ERROR]
+
+
+# ---------------------------------------------------------------------------
+# flagship graphs lint clean (the CLI's acceptance bar)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("model", ["llama", "qwen2_moe"])
+def test_flagship_serving_graphs_lint_clean(model):
+    targets = serving_targets(model)
+    report = run_passes(
+        [DtypeDriftPass(), HostSyncPass(), RecompileHazardPass()],
+        targets)
+    assert report.ran, "passes must actually run"
+    assert report.ok, "\n".join(str(f) for f in report.errors)
+    # the recompile pass PROVED a bound (info finding present), it did
+    # not just fail to run
+    assert any(f.pass_name == "recompile-hazard"
+               and "proven bound" in f.message
+               for f in report.findings)
+
+
+def test_pp_stage_chunks_consistent():
+    targets = pp_stage_targets()
+    report = run_passes([CollectiveConsistencyPass()], targets)
+    assert len(report.ran) == len(targets)
+    assert report.ok
+
+
+# ---------------------------------------------------------------------------
+# dtype-drift: seeded mutations
+# ---------------------------------------------------------------------------
+
+def test_dtype_drift_catches_f32_weight_in_bf16_model():
+    def bad(x, w):
+        return (x @ w).astype(jnp.bfloat16)
+
+    t = trace_graph("bad", bad,
+                    (sds((4, 8), jnp.bfloat16), sds((8, 8), jnp.float32)),
+                    compute_dtype=jnp.bfloat16)
+    errs = _errors(DtypeDriftPass().run(t))
+    assert errs and "dot_general" in errs[0].message
+
+    def good(x, w):
+        return x @ w
+
+    t2 = trace_graph("good", good,
+                     (sds((4, 8), jnp.bfloat16),
+                      sds((8, 8), jnp.bfloat16)),
+                     compute_dtype=jnp.bfloat16)
+    assert not DtypeDriftPass().run(t2)
+
+
+def test_dtype_drift_catches_f32_const_pollution():
+    table = jnp.asarray(np.linspace(0, 1, 16, dtype=np.float32))
+
+    def bad(x):
+        return x * table      # f32 closure const forces the upcast
+
+    t = trace_graph("bad", bad, (sds((4, 16), jnp.bfloat16),),
+                    compute_dtype=jnp.bfloat16)
+    errs = _errors(DtypeDriftPass().run(t))
+    assert errs and "constant" in errs[0].message
+    # the bf16-cast version of the same constant is clean
+    table16 = table.astype(jnp.bfloat16)
+
+    def good(x):
+        return x * table16
+
+    t2 = trace_graph("good", good, (sds((4, 16), jnp.bfloat16),),
+                     compute_dtype=jnp.bfloat16)
+    assert not DtypeDriftPass().run(t2)
+
+
+def test_dtype_drift_scalar_eps_exempt_and_f64_flagged():
+    def norm(x):
+        # the idiomatic f32 island: explicit upcast, reduce, downcast
+        xf = x.astype(jnp.float32)
+        return (xf * lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True)
+                               + 1e-5)).astype(x.dtype)
+
+    t = trace_graph("norm", norm, (sds((4, 8), jnp.bfloat16),),
+                    compute_dtype=jnp.bfloat16)
+    assert not DtypeDriftPass().run(t)
+
+    from jax.experimental import enable_x64
+    with enable_x64():
+        def f64fn(x):
+            return x.astype(jnp.float64) * 2.0
+
+        t2 = trace_graph("f64", f64fn, (sds((4,), jnp.float32),),
+                         compute_dtype=jnp.bfloat16)
+    errs = _errors(DtypeDriftPass().run(t2))
+    assert errs and "float64" in errs[0].message
+
+
+# ---------------------------------------------------------------------------
+# host-sync: seeded mutations
+# ---------------------------------------------------------------------------
+
+def test_host_sync_catches_callback_in_decode_loop():
+    def bad(x):
+        def body(c, _):
+            jax.debug.callback(lambda v: None, c)
+            return c + 1, c
+
+        return lax.scan(body, x, None, length=3)
+
+    t = trace_graph("bad", bad, (sds((4,), jnp.float32),),
+                    in_decode_loop=True)
+    errs = _errors(HostSyncPass().run(t))
+    assert errs and "callback" in errs[0].message
+    assert errs[0].path and errs[0].path[0][0] == "scan"
+
+
+def test_host_sync_catches_oversized_logits_pull():
+    V = 256
+
+    def bad_tick(x, w):
+        return x @ w           # [S, V] f32 logits cross to the host
+
+    t = trace_graph("bad", bad_tick,
+                    (sds((4, 64), jnp.float32), sds((64, V), jnp.float32)),
+                    slots=4, steps_per_call=1, in_decode_loop=True)
+    errs = _errors(HostSyncPass().run(t))
+    assert errs and "bytes/slot/step" in errs[0].message
+
+    def good_tick(x, w):
+        return jnp.argmax(x @ w, -1).astype(jnp.int32)  # [S] tokens
+
+    t2 = trace_graph("good", good_tick,
+                     (sds((4, 64), jnp.float32),
+                      sds((64, V), jnp.float32)),
+                     slots=4, steps_per_call=1, in_decode_loop=True)
+    assert not HostSyncPass().run(t2)
+
+
+def test_host_sync_prefill_exempt_from_pull_budget():
+    """Prefill programs legitimately return logits once per prompt."""
+    def prefill(x, w):
+        return x @ w
+
+    t = trace_graph("prefill", prefill,
+                    (sds((1, 64), jnp.float32),
+                     sds((64, 256), jnp.float32)),
+                    slots=1, in_decode_loop=False)
+    assert not HostSyncPass().run(t)
+
+
+# ---------------------------------------------------------------------------
+# collective-consistency: seeded mutations
+# ---------------------------------------------------------------------------
+
+def _two_device_mesh():
+    devs = np.array(jax.devices()[:2])
+    return Mesh(devs, ("x",))
+
+
+def test_collective_divergence_caught():
+    mesh = _two_device_mesh()
+
+    def stage_a(x):
+        return shard_map(lambda v: lax.psum(v, "x"), mesh=mesh,
+                         in_specs=P("x"), out_specs=P())(x)
+
+    def stage_b(x):
+        return shard_map(
+            lambda v: lax.ppermute(v, "x", [(0, 1), (1, 0)]),
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"))(x)
+
+    x = jnp.ones((2, 4))
+    ja = jax.make_jaxpr(stage_a)(x)
+    jb = jax.make_jaxpr(stage_b)(x)
+    assert collective_signature(ja) != collective_signature(jb)
+    bad = check_stage_consistency([("s0", ja), ("s1", jb)])
+    assert bad and bad[0][0] == "s1"
+    assert not check_stage_consistency([("s0", ja), ("s1", ja)])
+
+
+def test_collective_signature_counts_scan_trips():
+    """Stages whose ring loops run different trip counts are NOT
+    consistent even though the loop bodies match."""
+    mesh = _two_device_mesh()
+
+    def ring(x, hops):
+        def inner(v):
+            def body(c, _):
+                return lax.ppermute(c, "x", [(0, 1), (1, 0)]), None
+
+            out, _ = lax.scan(body, v, None, length=hops)
+            return out
+
+        return shard_map(inner, mesh=mesh, in_specs=P("x"),
+                         out_specs=P("x"))(x)
+
+    x = jnp.ones((2, 4))
+    j3 = jax.make_jaxpr(lambda v: ring(v, 3))(x)
+    j5 = jax.make_jaxpr(lambda v: ring(v, 5))(x)
+    assert check_stage_consistency([("s0", j3), ("s1", j5)])
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard: proof + seeded hazard
+# ---------------------------------------------------------------------------
+
+def test_recompile_enumeration_matches_live_engine_geometry(params):
+    """engine_geometry() (the static mirror) must agree with a real
+    engine's extracted geometry — the proof is about the engine that
+    actually runs, not a lookalike."""
+    kw = dict(page_size=4, max_prompt_len=16, max_new_tokens_cap=16,
+              prefill_chunk=8)
+    with ServingEngine(params, CFG, max_batch=2, **kw) as eng:
+        live = ServingGeometry.of_engine(eng)
+    assert engine_geometry(**kw) == live
+
+
+def test_recompile_pass_proves_flagship_bound_and_flags_hazard():
+    good = engine_geometry(page_size=4, max_prompt_len=16,
+                           max_new_tokens_cap=16, prefill_chunk=8)
+    progs = enumerate_chunk_programs(good)
+    assert progs and all(len(v) <= 16 for v in progs.values())
+
+    # seeded hazard: quantum 1 with a large prompt/slot budget — the
+    # pre-r9 failure mode (attach grid off the chunk grid)
+    bad = ServingGeometry(page_size=8, pages_per_slot=40,
+                          buckets=[32, 64, 128, 256],
+                          attach_quantum=1, prefill_chunk=32)
+    over = enumerate_chunk_programs(bad)
+    assert any(len(v) > 16 for v in over.values())
+    t = trace_graph("geom", lambda x: x, (sds((1,), jnp.float32),),
+                    meta={"geometry": bad})
+    errs = _errors(RecompileHazardPass().run(t))
+    assert errs and "prefix_pages" in errs[0].message
+
+
+def test_engine_warns_on_unbounded_chunk_program_set(params):
+    """A too-small chunk against a big prompt budget means one compile
+    per chunk start inside serving ticks — the ctor must say so at
+    construction, not stall under traffic."""
+    import warnings
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        eng = ServingEngine(params, CFG, max_batch=1, page_size=4,
+                            max_prompt_len=128, max_new_tokens_cap=4,
+                            prefill_chunk=4, check_invariants=False)
+        eng.close()
+    assert any("chunk-prefill programs" in str(x.message) for x in w)
+    # sane geometry: no warning
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        eng = ServingEngine(params, CFG, max_batch=1, page_size=4,
+                            max_prompt_len=16, max_new_tokens_cap=4,
+                            prefill_chunk=8, check_invariants=False)
+        eng.close()
+    assert not [x for x in w
+                if "chunk-prefill programs" in str(x.message)]
+
+
+def test_chunked_attach_quantum_sits_on_chunk_grid(params):
+    """The r9 fix: with prefill_chunk=N the attach quantum is a
+    multiple of N/page_size, so chunk starts stay on one grid."""
+    with ServingEngine(params, CFG, max_batch=2, page_size=4,
+                       max_prompt_len=16, max_new_tokens_cap=16,
+                       prefill_chunk=8) as eng:
+        q = eng.prefix_cache.attach_quantum
+        assert q % (8 // 4) == 0
+
+
+# ---------------------------------------------------------------------------
+# paged-KV invariant checker: healthy engine clean, mutations caught
+# ---------------------------------------------------------------------------
+
+def _eng(params, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_prompt_len", 16)
+    kw.setdefault("max_new_tokens_cap", 16)
+    kw.setdefault("check_invariants", True)
+    return ServingEngine(params, CFG, **kw)
+
+
+def _ref(params, prompt, n):
+    out = L.generate(params, jnp.asarray(prompt)[None], CFG,
+                     max_new_tokens=n)
+    return np.asarray(out)[0, len(prompt):]
+
+
+def test_checker_clean_through_mixed_workload(params):
+    rng = np.random.RandomState(0)
+    with _eng(params, prefill_chunk=4) as eng:
+        hs = [eng.submit(rng.randint(0, 256, (n,)).astype(np.int32), 5)
+              for n in (12, 3, 15, 12, 7)]
+        for h in hs:
+            h.result(timeout=300)
+        assert eng.audit() == []
+    assert eng.pool.used_pages == 0
+
+
+def test_checker_catches_refcount_corruption(params):
+    prompt = np.arange(1, 13, dtype=np.int32)
+    with _eng(params) as eng:
+        eng.submit(prompt, 4).result(timeout=300)
+        nodes = eng.prefix_cache.nodes()
+        assert nodes
+        nodes[0].refs += 1          # seeded bug: leaked reference
+        bad = eng.audit()
+        assert any(v.code == "refcount-drift" for v in bad)
+        nodes[0].refs -= 1
+        assert eng.audit() == []
+
+
+def test_checker_catches_double_attached_page(params):
+    """The page-aliasing bug class: one physical page in two live
+    slots' rows without a backing trie refcount."""
+    rng = np.random.RandomState(1)
+    p1 = rng.randint(0, 256, (6,)).astype(np.int32)
+    p2 = rng.randint(0, 256, (6,)).astype(np.int32)
+    eng = _eng(params, check_invariants=False, tick_interval_s=0.01)
+    try:
+        h1 = eng.submit(p1, 12)
+        h2 = eng.submit(p2, 12)
+        it = iter(h1)
+        next(it)                    # both slots live
+        with eng._tick_lock:
+            occ = eng.scheduler.occupied()
+            if len(occ) == 2:
+                (s1, r1), (s2, r2) = occ
+                # double-attach: slot 2's first page aliased into
+                # slot 1's row (classic mis-maintained page table)
+                eng.scheduler.tables[s1, -1] = r2.pages[0]
+                bad = audit_serving_state(eng.pool, eng.scheduler,
+                                          eng.prefix_cache)
+                assert any(v.code in ("share-uncached", "row-mismatch")
+                           for v in bad)
+                eng.scheduler.tables[s1, -1] = PagePool.TRASH
+    finally:
+        eng.close(drain=False)
+
+
+def test_checker_catches_freelist_aliasing(params):
+    prompt = np.arange(1, 9, dtype=np.int32)
+    eng = _eng(params, check_invariants=False, tick_interval_s=0.01)
+    try:
+        h = eng.submit(prompt, 12)
+        it = iter(h)
+        next(it)
+        with eng._tick_lock:
+            occ = eng.scheduler.occupied()
+            if occ:
+                _, req = occ[0]
+                page = req.pages[0]
+                # seeded bug: a live page pushed back to the free list
+                eng.pool._free.append(page)
+                eng.pool._free_set.add(page)
+                bad = audit_serving_state(eng.pool, eng.scheduler,
+                                          eng.prefix_cache)
+                assert any(v.code == "page-free-owned" for v in bad)
+                eng.pool._free.remove(page)
+                eng.pool._free_set.discard(page)
+    finally:
+        eng.close(drain=False)
+
+
+def test_checker_catches_parked_row_leak(params):
+    """A parked (mid chunked-prefill) slot whose scheduler row is not
+    all-TRASH: the dead-slot contract the TPU pallas page loop depends
+    on."""
+    rng = np.random.RandomState(2)
+    long_p = rng.randint(0, 256, (16,)).astype(np.int32)
+    short_p = rng.randint(0, 256, (2,)).astype(np.int32)
+    eng = _eng(params, prefill_chunk=4, max_batch=2,
+               check_invariants=False, tick_interval_s=0.02)
+    try:
+        h_short = eng.submit(short_p, 24)
+        it = iter(h_short)
+        next(it)
+        h_long = eng.submit(long_p, 4)
+        seen = False
+        for _ in range(400):
+            time.sleep(0.002)
+            with eng._tick_lock:
+                parked = [(s, r) for s, r in eng.scheduler.occupied()
+                          if r.table_row is not None]
+                if parked:
+                    seen = True
+                    slot, req = parked[0]
+                    # healthy parked state passes
+                    assert audit_serving_state(
+                        eng.pool, eng.scheduler,
+                        eng.prefix_cache) == []
+                    # seeded bug: one real entry leaks into the row
+                    eng.scheduler.tables[slot, 0] = req.table_row[0]
+                    bad = audit_serving_state(eng.pool, eng.scheduler,
+                                              eng.prefix_cache)
+                    assert any(v.code == "parked-row-live"
+                               for v in bad)
+                    eng.scheduler.tables[slot, 0] = PagePool.TRASH
+                    break
+            if h_long._req.done.is_set():
+                break
+        assert seen, "no parked slot observed — chunk too large?"
+        h_long.result(timeout=300)
+        h_short.result(timeout=300)
+    finally:
+        eng.close()
+
+
+def test_defrag_plan_audit_catches_stale_mapping(params):
+    prompt = np.arange(1, 13, dtype=np.int32)
+    with _eng(params) as eng:
+        eng.submit(prompt, 4).result(timeout=300)
+        with eng._tick_lock:
+            plan = eng.pool.defrag_plan()
+            assert audit_defrag_plan(plan, eng.pool, eng.scheduler,
+                                     eng.prefix_cache) == []
+            # stale mapping: pretend a freed page is still being moved
+            free_page = max(eng.pool.free_page_ids)
+            stale = dict(plan)
+            stale[free_page] = 1
+            bad = audit_defrag_plan(stale, eng.pool, eng.scheduler,
+                                    eng.prefix_cache)
+            assert any(v.code == "defrag-stale-src" for v in bad)
+
+
+def test_per_tick_checker_fails_engine_on_live_corruption(params):
+    """Detection through the LIVE path: corrupt state under the tick
+    lock and the next tick's audit kills the engine, surfacing
+    KVInvariantError to every caller."""
+    rng = np.random.RandomState(3)
+    eng = _eng(params, tick_interval_s=0.01)
+    try:
+        eng.submit(rng.randint(0, 256, (9,)).astype(np.int32), 4) \
+           .result(timeout=300)
+        h = eng.submit(rng.randint(0, 256, (9,)).astype(np.int32), 24)
+        it = iter(h)
+        next(it)
+        with eng._tick_lock:
+            nodes = eng.prefix_cache.nodes()
+            assert nodes
+            nodes[0].refs += 3      # corruption the next tick must see
+        with pytest.raises(KVInvariantError):
+            h.result(timeout=300)
+    finally:
+        eng.close(drain=False)
+
+
+# ---------------------------------------------------------------------------
+# defrag while a chunk-prefill slot is parked (satellite)
+# ---------------------------------------------------------------------------
+
+def test_defrag_while_chunk_prefill_parked(params):
+    """Defrag running while a slot is parked mid chunked-prefill must
+    remap the dead-slot scheduler row (all-TRASH, trivially), the
+    STASHED real row, and the prefix-cached pages consistently — the
+    parked request then completes byte-exact and the checker stays
+    green throughout."""
+    rng = np.random.RandomState(4)
+    churn = rng.randint(0, 256, (10,)).astype(np.int32)
+    long_p = rng.randint(0, 256, (16,)).astype(np.int32)
+    short_p = rng.randint(0, 256, (2,)).astype(np.int32)
+    eng = _eng(params, prefill_chunk=4, max_batch=3,
+               tick_interval_s=0.02)
+    try:
+        # all three admit together (3 free slots): churn takes the LOW
+        # pages and retires after 2 tokens — while the long prompt is
+        # still parked mid chunked-prefill — leaving a low hole that
+        # gives defrag real work across: a live decode row (short), a
+        # parked slot's STASHED row (long), and churn's now-cached
+        # prefix pages in the trie
+        h_churn = eng.submit(churn, 2)
+        h_short = eng.submit(short_p, 30)
+        h_long = eng.submit(long_p, 6)
+        moved = None
+        for _ in range(800):
+            time.sleep(0.002)
+            with eng._tick_lock:
+                parked = [r for _, r in eng.scheduler.occupied()
+                          if r.table_row is not None]
+                fragmented = (h_churn._req.done.is_set()
+                              and bool(eng.pool.defrag_plan()))
+            if parked and fragmented:
+                moved = eng.defragment()   # audits plan + result
+                break
+            if h_long._req.done.is_set():
+                break
+        assert moved is not None, \
+            "never saw a parked slot + fragmentation window"
+        assert moved > 0
+        out_long = h_long.result(timeout=300)
+        out_short = h_short.result(timeout=300)
+        assert eng.audit() == []
+    finally:
+        eng.close()
+    np.testing.assert_array_equal(out_long, _ref(params, long_p, 6))
+    np.testing.assert_array_equal(out_short, _ref(params, short_p, 30))
+
+
+# ---------------------------------------------------------------------------
+# source lint
+# ---------------------------------------------------------------------------
+
+def test_source_lint_rules_and_noqa(tmp_path):
+    from paddle_tpu.analysis.source_lint import lint_file
+    f = tmp_path / "m.py"
+    f.write_text(
+        "import os\n"
+        "import sys  # noqa: F401\n"
+        "from typing import Optional\n"
+        "x = None\n"
+        "ok = x == None\n"
+        "def g(a=[]):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except:\n"
+        "        pass\n"
+        "    return os.sep\n")
+    rules = sorted(r for r, _, _ in lint_file(f))
+    assert rules == ["B006", "E711", "E722", "F401"]  # sys suppressed
+
+
+def test_repo_source_lint_clean():
+    from paddle_tpu.analysis.source_lint import lint_tree
+    import pathlib
+    root = pathlib.Path(__file__).resolve().parent.parent
+    findings = lint_tree(root)
+    assert findings == [], "\n".join(map(str, findings))

@@ -10,7 +10,6 @@ modes: step | fwd | grad | grad_dense | grad_nosm
 import sys
 import time
 
-import numpy as np
 import jax
 import jax.numpy as jnp
 

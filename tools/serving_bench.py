@@ -189,7 +189,11 @@ class Bench:
                   decode_block_size=a.decode_block,
                   prefix_cache=not a.no_prefix_cache,
                   prefill_chunk=a.prefill_chunk or None,
-                  admission_window=a.admission_window)
+                  admission_window=a.admission_window,
+                  # None = env default; True = per-tick paged-KV
+                  # invariant checking (violations raise inside the
+                  # tick -> every handle errors -> main exits non-zero)
+                  check_invariants=a.check_invariants or None)
         kw.update(over)
         return ServingEngine(self.params, self.cfg, **kw)
 
@@ -206,6 +210,15 @@ class Bench:
         outs = [h.result(timeout=600) for h in handles]
         wall = time.perf_counter() - t0
         snap = eng.stats()
+        if a.check_invariants:
+            # final standalone audit on top of the per-tick checks —
+            # the post-drain state (page leaks) is only visible here
+            violations = eng.audit()
+            if violations:
+                eng.close()
+                raise SystemExit(
+                    "serving_bench --check-invariants: "
+                    + "; ".join(str(v) for v in violations))
         eng.close()
         useful = sum(len(o) for o in outs)
         ttfts = [h.ttft_s for h in handles]
@@ -402,6 +415,11 @@ def main(argv=None):
     ap.add_argument("--admission-window", type=int, default=0,
                     help="queued requests allowed to overtake a "
                          "non-fitting head (0 = strict FIFO)")
+    ap.add_argument("--check-invariants", action="store_true",
+                    help="run the paged-KV invariant checker "
+                         "(analysis/kv_invariants.py) after every "
+                         "engine tick + a final audit; any violation "
+                         "exits non-zero")
     ap.add_argument("--modes", nargs="+",
                     default=["sequential", "batcher", "engine"],
                     help="any of: sequential batcher engine prefix_ab")
