@@ -43,14 +43,21 @@ class GradScaler:
         if not self._enable:
             return
         inv = 1.0 / self._scale
-        found = False
-        for p in optimizer._param_list:
-            if p._grad is not None:
-                g = p._grad._data * inv
-                finite = bool(jnp.isfinite(g).all()) if not _is_traced(g) else True
-                found = found or not finite
-                p._grad._data = g
-        self._found_inf = found
+        grads = [p._grad for p in optimizer._param_list
+                 if p._grad is not None]
+        scaled = [g._data * inv for g in grads]
+        for g, a in zip(grads, scaled):
+            g._data = a
+        if not scaled or any(_is_traced(a) for a in scaled):
+            self._found_inf = False
+            return
+        # ONE device->host sync for the whole grad set: the per-param
+        # bool() pull this replaces is the host-sync lint's bug class —
+        # N round-trips per step through the tunnelled runtime, each a
+        # full device sync (analysis/host_sync.py; the [S,V] logits
+        # lesson applied to training)
+        finite = jnp.stack([jnp.isfinite(a).all() for a in scaled])
+        self._found_inf = not bool(finite.all())
 
     def step(self, optimizer):
         if not self._enable:

@@ -1,5 +1,5 @@
 """Static-analysis subsystem: jaxpr lint passes + paged-KV invariant
-checker for the serving stack.
+checker for the serving AND training stacks.
 
 The JAX-native counterpart of the reference's IR pass infrastructure
 and runtime enforcement (``paddle/pir``, ``phi/core/enforce.h``):
@@ -7,19 +7,28 @@ analysis over **jaxprs** (the IR every program here already lowers
 through) and over the serving stack's host-side state. Entry points:
 
 * ``tools/graph_lint.py`` — CLI running every pass over the flagship
-  llama + qwen2_moe serving graphs (the pre-merge check).
+  llama + qwen2_moe serving graphs and the llama train-step graphs at
+  the dp / dp×mp / pp(1F1B) / zero-sharded geometries (the pre-merge
+  check).
 * ``ServingEngine(check_invariants=True)`` — per-tick paged-KV
   invariant checking (race-detector-style debug mode).
-* ``audit_engine(engine)`` — standalone audit of a live engine.
+* ``audit_engine(engine)`` — standalone audit of a live engine;
+  ``audit_engine_plan(engine)`` — mpu-hint audit of an auto-parallel
+  Engine's plan; ``Engine.donation_audit()`` — donation audit of the
+  live jitted train step.
 
 See docs/ANALYSIS.md for each pass's invariant and how to add one.
 """
 from .collectives import (CollectiveConsistencyPass,
                           check_stage_consistency,
-                          collective_signature)
+                          collective_signature, scan_trip_counts)
+from .donation import DonationAuditPass, jit_donation_flags
 from .dtype_drift import DtypeDriftPass
 from .framework import (Finding, GraphTarget, LintPass, LintReport,
-                        Severity, run_passes, trace_graph)
+                        PASS_REGISTRY, Severity, default_passes,
+                        register_pass, run_passes, trace_graph)
+from .hbm import (HbmEstimate, HbmPeakPass, estimate_hbm_peak,
+                  xla_peak_bytes)
 from .host_sync import HostSyncPass
 from .kv_invariants import (KVInvariantError, Violation,
                             audit_defrag_plan, audit_engine,
@@ -28,14 +37,25 @@ from .recompile import (RecompileHazardPass, ServingGeometry,
                         enumerate_chunk_programs)
 from .serving_graphs import (engine_geometry, pp_stage_targets,
                              serving_targets)
+from .sharding_lint import (ShardingLintPass, audit_engine_plan,
+                            spec_shard_factor)
+from .training_graphs import (TRAIN_GEOMETRIES, flagship_train_objects,
+                              train_stage_targets, train_step_target,
+                              training_targets)
 
 __all__ = [
-    "CollectiveConsistencyPass", "DtypeDriftPass", "Finding",
-    "GraphTarget", "HostSyncPass", "KVInvariantError", "LintPass",
-    "LintReport", "RecompileHazardPass", "ServingGeometry", "Severity",
-    "Violation", "audit_defrag_plan", "audit_engine",
+    "CollectiveConsistencyPass", "DonationAuditPass", "DtypeDriftPass",
+    "Finding", "GraphTarget", "HbmEstimate", "HbmPeakPass",
+    "HostSyncPass", "KVInvariantError", "LintPass", "LintReport",
+    "PASS_REGISTRY", "RecompileHazardPass", "ServingGeometry",
+    "Severity", "ShardingLintPass", "TRAIN_GEOMETRIES", "Violation",
+    "audit_defrag_plan", "audit_engine", "audit_engine_plan",
     "audit_serving_state", "check_stage_consistency",
-    "collective_signature", "engine_geometry",
-    "enumerate_chunk_programs", "pp_stage_targets", "run_passes",
-    "serving_targets", "trace_graph",
+    "collective_signature", "default_passes", "engine_geometry",
+    "enumerate_chunk_programs", "estimate_hbm_peak",
+    "flagship_train_objects", "jit_donation_flags", "pp_stage_targets",
+    "register_pass", "run_passes", "scan_trip_counts",
+    "serving_targets", "spec_shard_factor", "trace_graph",
+    "train_stage_targets", "train_step_target", "training_targets",
+    "xla_peak_bytes",
 ]

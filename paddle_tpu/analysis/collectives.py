@@ -26,10 +26,12 @@ from __future__ import annotations
 
 from typing import Any, List, Sequence, Tuple
 
-from .framework import Finding, GraphTarget, LintPass, Severity
+from .framework import (Finding, GraphTarget, LintPass, Severity,
+                        register_pass)
 
 __all__ = ["COLLECTIVE_PRIMS", "collective_signature",
-           "CollectiveConsistencyPass", "check_stage_consistency"]
+           "CollectiveConsistencyPass", "check_stage_consistency",
+           "scan_trip_counts"]
 
 COLLECTIVE_PRIMS = {
     "psum", "psum2", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
@@ -51,13 +53,22 @@ def _freeze(v: Any):
     return v
 
 
-def collective_signature(jaxpr) -> List[Tuple]:
+def collective_signature(jaxpr, include_loops: bool = False
+                         ) -> List[Tuple]:
     """Ordered (prim, loop_nest, params) for every collective in the
     program, depth-first — the stage's communication contract.
     ``loop_nest`` records the loop frames that repeat the collective,
     with scan trip counts: a ppermute inside a length-8 scan is eight
     issues, and a stage scanning 4 layers differs from one scanning 8
-    even when the body matches."""
+    even when the body matches.
+
+    ``include_loops=True`` additionally records every loop frame itself
+    as a ``("__loop__", nest, (("length", n),))`` entry — the mode the
+    TRAINING stage check runs in: pipeline stage chunks under GSPMD
+    carry no explicit collectives (XLA inserts them at compile), but
+    their layer-scan trip counts ARE the per-stage work contract, and a
+    chunk scanning a different layer count desynchronizes the lockstep
+    schedule exactly like a diverging collective would."""
     from ..core.graph_trace import sub_jaxprs
     from jax._src import core as jax_core
 
@@ -74,29 +85,44 @@ def collective_signature(jaxpr) -> List[Tuple]:
                     if k in eqn.params)
                 sig.append((name, loops, params))
             for label, sub in sub_jaxprs(eqn):
-                frame = name
                 if name in ("scan", "while", "fori_loop"):
                     frame = (name, eqn.params.get("length"))
-                walk(sub, loops + (frame,)
-                     if name in ("scan", "while", "fori_loop")
-                     else loops)
+                    if include_loops:
+                        sig.append(("__loop__", loops,
+                                    (("length",
+                                      eqn.params.get("length")),)))
+                    walk(sub, loops + (frame,))
+                else:
+                    walk(sub, loops)
         return sig
 
     return walk(jaxpr, ())
 
 
+def scan_trip_counts(jaxpr) -> List[int]:
+    """Every ``lax.scan`` trip count in the program, depth-first."""
+    from ..core.graph_trace import iter_jaxpr_eqns
+    out = []
+    for _path, eqn in iter_jaxpr_eqns(jaxpr):
+        if (eqn.primitive.name == "scan"
+                and eqn.params.get("length") is not None):
+            out.append(int(eqn.params["length"]))
+    return out
+
+
 def check_stage_consistency(
-        stages: Sequence[Tuple[str, Any]]) -> List[Tuple[str, str]]:
+        stages: Sequence[Tuple[str, Any]],
+        include_loops: bool = False) -> List[Tuple[str, str]]:
     """Compare collective signatures across ``(name, jaxpr)`` stages.
     Returns [(stage_name, description)] for every stage diverging from
     the first one (the reference stage)."""
     if len(stages) < 2:
         return []
     ref_name, ref_jaxpr = stages[0]
-    ref_sig = collective_signature(ref_jaxpr)
+    ref_sig = collective_signature(ref_jaxpr, include_loops)
     out = []
     for name, jaxpr in stages[1:]:
-        sig = collective_signature(jaxpr)
+        sig = collective_signature(jaxpr, include_loops)
         if sig == ref_sig:
             continue
         # locate the first divergence for an actionable message
@@ -112,11 +138,21 @@ def check_stage_consistency(
     return out
 
 
+@register_pass
 class CollectiveConsistencyPass(LintPass):
     """Group targets by ``meta['stage_group']`` and require identical
-    collective signatures inside each group. Run via
-    :func:`framework.run_passes` this fires once per target but keeps
-    state, reporting each group exactly once (on its last member)."""
+    collective signatures inside each group (loop trip counts included
+    when any member sets ``meta['signature_include_loops']`` — the
+    training stage-chunk mode). Run via :func:`framework.run_passes`
+    this fires once per target but keeps state, reporting each group
+    exactly once (on its last member).
+
+    Per-target rule: a target carrying ``meta['expected_scan_trips']``
+    (the 1F1B train step: ``pipeline_1f1b.schedule_ticks(S, M, V)``)
+    must contain a scan with exactly that trip count — the schedule's
+    fill + steady + drain tick arithmetic. A schedule edit that changes
+    the tick count without updating ``schedule_ticks`` (or vice versa)
+    is a lockstep desync and fails here before it ever runs."""
 
     name = "collective-consistency"
 
@@ -124,16 +160,30 @@ class CollectiveConsistencyPass(LintPass):
         self._groups = {}
 
     def run(self, target: GraphTarget) -> List[Finding]:
+        findings: List[Finding] = []
+        expected = target.meta.get("expected_scan_trips")
+        if expected is not None:
+            trips = scan_trip_counts(target.jaxpr)
+            if int(expected) not in trips:
+                findings.append(self.finding(
+                    target,
+                    f"no scan with the schedule's expected trip count "
+                    f"{expected} (traced scan lengths: {sorted(set(trips))})"
+                    f" — the 1F1B tick arithmetic and the traced "
+                    f"schedule disagree"))
+
         group = target.meta.get("stage_group")
         if group is None:
-            return []
+            return findings
         members = self._groups.setdefault(group, [])
-        members.append((target.name, target.jaxpr))
+        members.append((target.name, target.jaxpr,
+                        bool(target.meta.get("signature_include_loops"))))
         total = target.meta.get("stage_count")
         if total is None or len(members) < total:
-            return []
-        findings = []
-        for name, desc in check_stage_consistency(members):
+            return findings
+        include_loops = any(m[2] for m in members)
+        for name, desc in check_stage_consistency(
+                [(n, j) for n, j, _ in members], include_loops):
             findings.append(Finding(
                 pass_name=self.name, severity=Severity.ERROR,
                 graph=name,

@@ -1,0 +1,159 @@
+"""Donation/aliasing audit: params and optimizer state enter the train
+step donated, or the step pays double residency.
+
+A training step that does not donate its state holds params + optimizer
+moments TWICE at the update (old buffers pinned as live jit inputs
+while the new ones materialize) — on a memory-bound trainer that is the
+difference between fitting and OOMing, and like the host-sync logits
+pull it produces zero errors and perfectly correct numerics. The audit
+pins the invariant statically, the same way PR 4's host-sync byte
+budget pinned the decode-output class:
+
+* **undonated-state** (error): a param/optimizer-state input leaf not
+  donated and larger than the per-leaf byte budget (default 256 B —
+  scalars and step counters are free, weight-shaped leaves are not).
+* **unaliasable-donation** (warning): a donated input with no output of
+  identical shape/dtype to alias onto — XLA quietly drops the donation
+  and the buffer is doubly resident anyway (the classic cause: a dtype
+  or layout change on the updated state).
+* an INFO inventory (donated vs pulled bytes) so the CLI shows what a
+  step actually keeps on device vs returns to the host.
+
+``jit_donation_flags`` extracts ground truth from a *lowering* (still
+zero compiles): which flat inputs the jitted callable actually marks
+``tf.aliasing_output``. The training targets declare donation flags in
+meta (mirroring ``donate_argnums``); a test pins the two against each
+other so the declared flags cannot drift from what jax really does —
+the engine_geometry()-vs-live-engine lesson applied to donation.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+from .framework import (Finding, GraphTarget, LintPass, Severity,
+                        register_pass)
+
+__all__ = ["DonationAuditPass", "jit_donation_flags"]
+
+
+def _nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    n = int(np.prod(shape)) if shape else 1
+    return n * np.dtype(dtype).itemsize
+
+
+@register_pass
+class DonationAuditPass(LintPass):
+    name = "donation-audit"
+
+    def __init__(self, max_undonated_bytes: int = 256):
+        #: per-leaf budget for non-donated param/opt inputs
+        self.max_bytes = int(max_undonated_bytes)
+
+    def run(self, target: GraphTarget) -> List[Finding]:
+        donated = target.meta.get("donated_invars")
+        if donated is None:
+            return []  # target declares no donation contract
+        jaxpr = target.jaxpr.jaxpr
+        if len(donated) != len(jaxpr.invars):
+            return [self.finding(
+                target,
+                f"donated_invars has {len(donated)} flags for "
+                f"{len(jaxpr.invars)} traced invars — the donation meta "
+                f"is misaligned with the graph (unused args pruned from "
+                f"a lowering?); fix the target construction")]
+        labels = target.meta.get("invar_labels",
+                                 [f"arg{i}" for i in
+                                  range(len(jaxpr.invars))])
+        classes = target.meta.get("invar_classes",
+                                  ["?"] * len(jaxpr.invars))
+        findings: List[Finding] = []
+
+        don_bytes = pull_bytes = 0
+        out_shapes = {}
+        for o in jaxpr.outvars:
+            aval = getattr(o, "aval", None)
+            if aval is not None and getattr(aval, "shape", None) is not None:
+                key = (tuple(aval.shape), np.dtype(aval.dtype).name)
+                out_shapes[key] = out_shapes.get(key, 0) + 1
+
+        for i, v in enumerate(jaxpr.invars):
+            b = _nbytes(v.aval)
+            if donated[i]:
+                don_bytes += b
+                key = (tuple(v.aval.shape), np.dtype(v.aval.dtype).name)
+                if out_shapes.get(key, 0) > 0:
+                    out_shapes[key] -= 1
+                else:
+                    findings.append(self.finding(
+                        target,
+                        f"{labels[i]} is donated but no output matches "
+                        f"its shape/dtype {key} — XLA cannot alias it, "
+                        f"the buffer is doubly resident anyway",
+                        severity=Severity.WARNING))
+            else:
+                pull_bytes += b
+                if classes[i] in ("param", "opt") and b > self.max_bytes:
+                    findings.append(self.finding(
+                        target,
+                        f"{labels[i]} ({classes[i]}, {b} bytes) enters "
+                        f"the step NON-donated (budget {self.max_bytes} "
+                        f"B/leaf) — old and new buffers are live "
+                        f"simultaneously at the update; add it to "
+                        f"donate_argnums"))
+        findings.append(self.finding(
+            target,
+            f"donation inventory: {don_bytes / 2**20:.2f} MiB donated "
+            f"(updated in place), {pull_bytes / 2**20:.2f} MiB "
+            f"non-donated inputs", severity=Severity.INFO))
+        return findings
+
+
+def jit_donation_flags(jitted, *args, n_invars: Optional[int] = None,
+                       **kwargs) -> Sequence[bool]:
+    """Which flat inputs of ``jitted`` are donation-aliased, from its
+    LOWERED module (tracing only, no compile): jax stamps donated
+    parameters with ``tf.aliasing_output`` (or ``jax.buffer_donor``) in
+    the StableHLO entry function. ``args`` may be ShapeDtypeStructs."""
+    lowered = jitted.lower(*args, **kwargs)
+    text = lowered.as_text()
+    # only the entry function's signature (one printed line); each
+    # %argN's attribute dict sits between its marker and the next —
+    # split on the markers rather than regex-matching the dict, whose
+    # values legally contain nested braces ('{replicated}' shardings)
+    head = next((ln for ln in text.splitlines() if "@main" in ln), text)
+    parts = re.split(r"%arg(\d+):", head)
+    flagged = set()
+    arity = 0
+    for idx_s, seg in zip(parts[1::2], parts[2::2]):
+        arity = max(arity, int(idx_s) + 1)
+        # the result list follows the last arg: stop at the arrow so a
+        # result attribute can never be credited to that arg
+        seg = seg.split("->")[0]
+        if "tf.aliasing_output" in seg or "jax.buffer_donor" in seg:
+            flagged.add(int(idx_s))
+    # jit's default keep_unused=False PRUNES unused flat args from the
+    # lowered @main: %argN numbers positions in the KEPT list, not the
+    # caller's flat signature. Map back through kept_var_idx so the
+    # flags align with an UNPRUNED jaxpr's invars (a step with one
+    # unused state leaf would otherwise shift every flag after it).
+    try:
+        kept = sorted(lowered._lowering.compile_args["kept_var_idx"])
+    except Exception:
+        kept = None
+    if kept is not None and arity == len(kept):
+        flagged = {kept[i] for i in flagged}
+        arity = kept[-1] + 1 if kept else 0
+    if n_invars is None:
+        try:
+            import jax
+            n_invars = len(jax.tree_util.tree_leaves(lowered.args_info))
+        except Exception:
+            n_invars = arity
+    return [i in flagged for i in range(n_invars)]

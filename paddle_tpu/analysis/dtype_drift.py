@@ -38,7 +38,8 @@ from typing import Dict, List, Set
 import numpy as np
 
 from ..core.graph_trace import sub_jaxprs
-from .framework import Finding, GraphTarget, LintPass, Severity
+from .framework import (Finding, GraphTarget, LintPass, Severity,
+                        register_pass)
 
 __all__ = ["DtypeDriftPass"]
 
@@ -67,6 +68,7 @@ def _width(dt) -> int:
     return np.dtype(dt).itemsize
 
 
+@register_pass
 class DtypeDriftPass(LintPass):
     name = "dtype-drift"
 

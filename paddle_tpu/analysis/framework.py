@@ -22,7 +22,29 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Severity", "Finding", "GraphTarget", "LintPass",
-           "LintReport", "run_passes", "trace_graph"]
+           "LintReport", "PASS_REGISTRY", "register_pass",
+           "default_passes", "run_passes", "trace_graph"]
+
+#: name -> LintPass subclass; every pass registers itself here so the
+#: CLI (tools/graph_lint.py) and the tests build the same pass set —
+#: a pass that exists but is wired nowhere is the vacuous-pass
+#: anti-pattern in a new costume.
+PASS_REGISTRY: Dict[str, type] = {}
+
+
+def register_pass(cls):
+    """Class decorator: add a LintPass subclass to ``PASS_REGISTRY``
+    under its ``name``."""
+    PASS_REGISTRY[cls.name] = cls
+    return cls
+
+
+def default_passes(**ctor_kwargs) -> List["LintPass"]:
+    """One instance of every registered pass, in registration order.
+    ``ctor_kwargs[name]`` supplies per-pass constructor kwargs (e.g.
+    ``{"recompile-hazard": {"limit": 16}})``."""
+    return [cls(**ctor_kwargs.get(name, {}))
+            for name, cls in PASS_REGISTRY.items()]
 
 
 class Severity:

@@ -31,7 +31,8 @@ from typing import List
 import numpy as np
 
 from ..core.graph_trace import iter_jaxpr_eqns
-from .framework import Finding, GraphTarget, LintPass, Severity
+from .framework import (Finding, GraphTarget, LintPass, Severity,
+                        register_pass)
 
 __all__ = ["HostSyncPass"]
 
@@ -44,6 +45,7 @@ def _in_loop(path) -> bool:
     return any(frame[0] in _LOOP_PRIMS for frame in path)
 
 
+@register_pass
 class HostSyncPass(LintPass):
     name = "host-sync"
 

@@ -33,7 +33,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Set
 
-from .framework import Finding, GraphTarget, LintPass, Severity
+from .framework import (Finding, GraphTarget, LintPass, Severity,
+                        register_pass)
 
 __all__ = ["ServingGeometry", "enumerate_chunk_programs",
            "RecompileHazardPass"]
@@ -110,6 +111,7 @@ def enumerate_chunk_programs(geom: ServingGeometry) -> Dict[int,
     return out
 
 
+@register_pass
 class RecompileHazardPass(LintPass):
     """Runs on targets whose ``meta['geometry']`` is a
     :class:`ServingGeometry` (the CLI attaches the flagship engines');

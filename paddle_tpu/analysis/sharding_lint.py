@@ -1,0 +1,192 @@
+"""SPMD sharding lint: the declared layout must be the intended one.
+
+The silent-wrongness class here never crashes: a large weight whose
+PartitionSpec quietly degenerated to replicated costs an all-gather's
+worth of HBM on every device; an optimizer moment that missed its ZeRO
+dp dim pays ``dp``-times the memory the stage was supposed to save; a
+spec written against an axis the mesh does not have (``"mp"`` vs
+``"tp"`` — the Engine and the functional llama stack use different
+names) shards NOTHING while reading as if it did. All three are
+host-side facts of the traced step plus its declared input specs
+(``analysis/training_graphs.py`` tags every flat invar with the spec
+``train_state_specs`` places it by), so they are statically checkable
+with zero compiles.
+
+Rules, each anchored to a concrete failure:
+
+* **unknown-axis** (error): a declared spec (or a traced
+  ``with_sharding_constraint`` site) names a mesh axis that does not
+  exist or has degree 1 while the tensor is large — the spec is
+  decorative, the array is actually replicated.
+* **replicated-large** (error): an input tensor ≥ ``replicated_bytes``
+  whose spec shards over no axis with degree > 1. Small tensors
+  replicate by design (the planner's ``min_shard_size`` logic); big
+  ones replicating silently is the all-gather-blowup bug.
+* **zero-uncovered** (error): on a target declaring
+  ``meta['zero_stage'] >= 1``, an optimizer-state leaf that
+  ``zero_spec`` COULD dp-shard but whose declared spec carries no dp
+  axis. Unshardable leaves (scalars, no dp-divisible free dim) are
+  exempt — ``zero_spec`` returning None is the documented contract.
+
+``audit_engine_plan`` is the Engine-side companion: it re-derives the
+mpu usage hints for every parameter the auto-parallel Engine planned
+and flags plan entries that contradict them (the hint path losing to
+the dim-order heuristic is exactly the mesh-axis-mismatch bug class).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+from ..core.graph_trace import iter_jaxpr_eqns
+from .framework import (Finding, GraphTarget, LintPass, Severity,
+                        register_pass)
+
+__all__ = ["ShardingLintPass", "audit_engine_plan", "spec_shard_factor"]
+
+
+def _spec_axes(spec):
+    """Flat mesh-axis names a PartitionSpec references."""
+    axes = []
+    for entry in tuple(spec):
+        if entry is None:
+            continue
+        for ax in (entry if isinstance(entry, (tuple, list)) else (entry,)):
+            axes.append(ax)
+    return axes
+
+
+def spec_shard_factor(spec, mesh_axes) -> int:
+    """How many ways ``spec`` splits an array on a mesh with
+    ``mesh_axes`` (axis name -> size); 1 = fully replicated."""
+    f = 1
+    for ax in _spec_axes(spec):
+        f *= int(mesh_axes.get(ax, 1))
+    return f
+
+
+def _nbytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    n = int(np.prod(shape)) if shape else 1
+    return n * np.dtype(dtype).itemsize
+
+
+@register_pass
+class ShardingLintPass(LintPass):
+    name = "sharding-lint"
+
+    def __init__(self, replicated_bytes: int = 4 << 20):
+        #: tensors at least this large must shard over SOME real axis
+        self.replicated_bytes = int(replicated_bytes)
+
+    def run(self, target: GraphTarget) -> List[Finding]:
+        specs = target.meta.get("in_specs")
+        if specs is None:
+            return []  # serving targets carry no declared spec tree
+        mesh_axes = dict(target.meta.get("mesh_axes", {}))
+        labels = target.meta.get("invar_labels",
+                                 [f"arg{i}" for i in range(len(specs))])
+        classes = target.meta.get("invar_classes", ["?"] * len(specs))
+        invars = target.jaxpr.jaxpr.invars
+        findings: List[Finding] = []
+        nontrivial = any(v > 1 for v in mesh_axes.values())
+
+        for i, (v, spec) in enumerate(zip(invars, specs)):
+            bytes_ = _nbytes(v.aval)
+            bad_axes = [ax for ax in _spec_axes(spec)
+                        if ax not in mesh_axes]
+            if bad_axes:
+                findings.append(self.finding(
+                    target,
+                    f"{labels[i]}: spec {tuple(spec)} names mesh "
+                    f"axes {bad_axes} that do not exist on this mesh "
+                    f"{mesh_axes} — the spec is decorative and the "
+                    f"array is fully replicated"))
+                continue
+            if (nontrivial and bytes_ >= self.replicated_bytes
+                    and spec_shard_factor(spec, mesh_axes) == 1
+                    and classes[i] in ("param", "opt")):
+                findings.append(self.finding(
+                    target,
+                    f"{labels[i]} ({bytes_ / 2**20:.1f} MiB, "
+                    f"{classes[i]}) materializes fully replicated on "
+                    f"every device (spec {tuple(spec)}) — an "
+                    f"all-gather's worth of HBM per device; shard it "
+                    f"or raise the planner's threshold deliberately"))
+
+        # ---- zero coverage ------------------------------------------
+        if int(target.meta.get("zero_stage", 0)) >= 1 \
+                and mesh_axes.get("dp", 1) > 1:
+            from ..distributed.sharding import zero_spec
+            for i, (v, spec) in enumerate(zip(invars, specs)):
+                if classes[i] != "opt":
+                    continue
+                shape = getattr(v.aval, "shape", ())
+                if not shape:
+                    continue  # scalars (step counts) replicate by design
+                if "dp" in _spec_axes(spec):
+                    continue
+                if zero_spec(spec, shape, mesh_axes["dp"]) is None:
+                    continue  # genuinely unshardable: documented exempt
+                findings.append(self.finding(
+                    target,
+                    f"{labels[i]}: optimizer-state leaf "
+                    f"{tuple(shape)} is zero_spec-shardable but its "
+                    f"declared spec {tuple(spec)} carries no dp axis — "
+                    f"ZeRO stage {target.meta['zero_stage']} pays "
+                    f"{mesh_axes['dp']}x the memory it claims to save"))
+
+        # ---- traced constraint sites --------------------------------
+        for path, eqn in iter_jaxpr_eqns(target.jaxpr):
+            if eqn.primitive.name != "sharding_constraint":
+                continue
+            sh = eqn.params.get("sharding")
+            spec = getattr(sh, "spec", None)
+            if spec is None:
+                continue
+            missing = [ax for ax in _spec_axes(spec)
+                       if ax not in mesh_axes]
+            if missing and mesh_axes:
+                findings.append(self.finding(
+                    target,
+                    f"with_sharding_constraint names mesh axes "
+                    f"{missing} absent from the target mesh "
+                    f"{mesh_axes}", path=path))
+        return findings
+
+
+def audit_engine_plan(engine) -> List[Finding]:
+    """Mesh-axis-mismatch audit of a prepared auto-parallel Engine: for
+    every parameter owned by an mpu layer type, the plan entry must
+    equal the usage hint the layer type declares (``Engine._mpu_hint``)
+    — the planner's dim-order heuristic winning over an explicit
+    Column/Row/Vocab declaration is a silent wrong-axis layout. Returns
+    findings (empty = clean)."""
+    engine.prepare()
+    findings: List[Finding] = []
+    if engine.strategy.mp_degree <= 1:
+        return findings
+    owners = engine._param_owners()
+    name_of = {id(p): n for n, p in engine.model.named_parameters()}
+    for name, p in engine.model.named_parameters():
+        owner = owners.get(id(p))
+        if owner is None:
+            continue
+        hint = engine._mpu_hint(p, owner)
+        if hint is None:
+            continue
+        planned = engine.plan.get(name)
+        if planned is None or tuple(planned) != tuple(hint):
+            findings.append(Finding(
+                pass_name="sharding-lint", severity=Severity.ERROR,
+                graph=f"engine.plan[{name_of.get(id(p), name)}]",
+                message=f"planned spec "
+                        f"{tuple(planned) if planned is not None else None}"
+                        f" contradicts the {type(owner).__name__} usage "
+                        f"hint {tuple(hint)} — the mpu declaration must "
+                        f"win over the size heuristic"))
+    return findings

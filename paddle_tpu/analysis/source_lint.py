@@ -15,6 +15,12 @@ lints source, with ruff layered on top when available:
 * **none-compare** (E711): ``== None`` / ``!= None``.
 * **bare-except** (E722): ``except:`` catching BaseException silently.
 * **mutable-default** (B006): ``def f(x=[])`` / ``{}`` / ``set()``.
+* **unused-local** (F841): a local bound by a plain ``name = ...``
+  assignment (or ``except ... as name``) and never read anywhere in
+  the function — including nested closures. Conservative: tuple
+  unpacking, augmented assignment, underscore-prefixed names and
+  ``global``/``nonlocal`` names never flag (matching ruff's default
+  F841 scope; an unused loop variable is B007's business, not ours).
 
 Scope: ``paddle_tpu/`` and ``tools/`` (tests use pytest fixtures whose
 "unused" imports are the fixture mechanism).
@@ -145,6 +151,47 @@ def lint_file(path: Path, src: str = None) -> List[Tuple]:
                 findings.append((
                     "F401", node.lineno,
                     f"`{display}` imported as `{bound}` but unused"))
+
+    # ---- unused locals (F841) ---------------------------------------
+    def _own_statements(fn):
+        """Nodes belonging to ``fn`` itself — nested function/lambda/
+        class bodies excluded (their assignments are their own scope:
+        a nested class's attribute binding is read via attribute
+        access, which name-level analysis cannot see)."""
+        stack = list(fn.body)
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda, ast.ClassDef)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        loaded, external = set(), set()
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Name) and isinstance(
+                    n.ctx, (ast.Load, ast.Del)):
+                loaded.add(n.id)  # closures in nested defs count
+            elif isinstance(n, (ast.Global, ast.Nonlocal)):
+                external |= set(n.names)
+        binds = []  # (name, lineno)
+        for n in _own_statements(fn):
+            if (isinstance(n, ast.Assign) and len(n.targets) == 1
+                    and isinstance(n.targets[0], ast.Name)):
+                binds.append((n.targets[0].id, n.lineno))
+            elif isinstance(n, ast.ExceptHandler) and n.name:
+                binds.append((n.name, n.lineno))
+        for bound, line in binds:
+            if (bound.startswith("_") or bound in external
+                    or bound in loaded or suppressed("F841", line)):
+                continue
+            findings.append((
+                "F841", line,
+                f"local `{bound}` in `{fn.name}()` is assigned but "
+                f"never used"))
 
     for node in ast.walk(tree):
         # ---- == None / != None ----------------------------------

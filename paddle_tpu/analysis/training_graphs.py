@@ -1,0 +1,244 @@
+"""Flagship training-graph targets for the lint passes.
+
+The training mirror of ``serving_graphs.py``: abstract-trace the llama
+auto-parallel train step (``models/llama.py make_train_step`` — model
+fwd + bwd + adamw as ONE program) exactly as a trainer would jit it,
+at the flagship parallel geometries, and tag each target with the
+call-site facts the training passes need: the declared per-leaf
+PartitionSpecs (``train_state_specs`` — the same tree ``init_fn``
+places by, so the lint sees the real layout), which flat inputs the
+step donates (``donate_argnums=(0,)``: the whole state), what each
+input IS (param / optimizer state / batch data), the mesh axis sizes,
+and for the 1F1B geometry the schedule's expected scan trip count.
+
+Everything here is ``jax.eval_shape`` + ``jax.make_jaxpr`` over
+ShapeDtypeStructs — nothing allocates, nothing compiles; linting all
+geometries costs a few seconds of tracing on one CPU core. Model dims
+are the tiny config: the passes are structural and per-leaf, so hidden
+size changes nothing they look at, while keeping the CLI fast.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from .framework import GraphTarget
+
+__all__ = ["TRAIN_GEOMETRIES", "training_targets", "train_step_target",
+           "train_stage_targets", "flagship_train_objects"]
+
+#: name -> mesh degrees + schedule knobs. The acceptance geometries:
+#: plain dp, dp x mp(tp), pp (1F1B + interleaved VPP), and
+#: dp-zero-sharded optimizer state.
+TRAIN_GEOMETRIES: Dict[str, Dict] = {
+    "dp":      dict(dp=2, tp=1, pp=1, vpp=1, microbatches=1,
+                    zero_stage=0),
+    "dp_mp":   dict(dp=2, tp=2, pp=1, vpp=1, microbatches=1,
+                    zero_stage=0),
+    "pp_1f1b": dict(dp=1, tp=1, pp=2, vpp=2, microbatches=4,
+                    zero_stage=0),
+    "zero1":   dict(dp=4, tp=2, pp=1, vpp=1, microbatches=1,
+                    zero_stage=1),
+}
+
+
+def _train_cfg(g: Dict, dtype=None):
+    from ..models import llama as L
+    kw = dict(use_flash_attention=False, remat=False,
+              pp_stages=g["pp"], vpp_chunks=g["vpp"],
+              num_microbatches=g["microbatches"])
+    if g["pp"] > 1:
+        kw["pp_schedule"] = "1f1b"
+    if dtype is not None:
+        kw["dtype"] = dtype
+    return L.LlamaConfig.tiny(**kw)
+
+
+def _abstract_state(cfg, mesh, optimizer, zero_stage):
+    import jax
+    from ..models import llama as L
+    _, init_fn = L.make_train_step(cfg, mesh, optimizer=optimizer,
+                                   zero_stage=zero_stage)
+    return jax.eval_shape(lambda: init_fn(jax.random.PRNGKey(0)))
+
+
+def _flat_call_site(state, batch, state_specs, batch_specs):
+    """(labels, classes, specs, donated) aligned with the traced step's
+    flat invars — the order ``jax.make_jaxpr`` flattens (state, batch)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    paths, _ = jax.tree_util.tree_flatten_with_path((state, batch))
+    flat_specs, _ = jax.tree_util.tree_flatten(
+        (state_specs, batch_specs),
+        is_leaf=lambda x: isinstance(x, P))
+    if len(paths) != len(flat_specs):
+        raise AssertionError(
+            f"spec tree ({len(flat_specs)} leaves) does not match the "
+            f"state/batch tree ({len(paths)} leaves)")
+    labels, classes, donated = [], [], []
+    for path, _leaf in paths:
+        label = jax.tree_util.keystr(path)
+        labels.append(label)
+        # path[0] selects state (index 0) vs batch (index 1)
+        in_state = getattr(path[0], "idx", None) == 0
+        if not in_state:
+            cls = "data"
+        else:
+            key = getattr(path[1], "key", None) if len(path) > 1 else None
+            cls = {"params": "param", "opt": "opt"}.get(key, "counter")
+        classes.append(cls)
+        donated.append(bool(in_state))  # donate_argnums=(0,): the state
+    return labels, classes, flat_specs, donated
+
+
+def train_step_target(geometry: str = "dp", *, batch_size: int = 4,
+                      seq_len: int = 8, dtype=None,
+                      hbm_budget_bytes: Optional[int] = None
+                      ) -> GraphTarget:
+    """One geometry's train-step GraphTarget (abstract, zero compiles)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import llama as L
+    from ..parallel.mesh import init_hybrid_mesh
+    from ..parallel.pipeline_1f1b import schedule_ticks
+
+    g = TRAIN_GEOMETRIES[geometry]
+    cfg = _train_cfg(g, dtype)
+    hm = init_hybrid_mesh(dp=g["dp"], pp=g["pp"], tp=g["tp"],
+                          set_global=False)
+    mesh = hm.mesh
+    optimizer = L.default_train_optimizer()
+    step_fn, _ = L.make_train_step(cfg, mesh, optimizer=optimizer,
+                                   zero_stage=g["zero_stage"])
+    state = _abstract_state(cfg, mesh, optimizer, g["zero_stage"])
+    state_specs = L.train_state_specs(cfg, mesh, optimizer,
+                                      g["zero_stage"])
+    sds = jax.ShapeDtypeStruct
+    batch = {"tokens": sds((batch_size, seq_len), jnp.int32),
+             "labels": sds((batch_size, seq_len), jnp.int32)}
+    dp_spec = P("dp", None) if g["dp"] > 1 else P()
+    batch_specs = {"tokens": dp_spec, "labels": dp_spec}
+
+    closed = jax.make_jaxpr(lambda s, b: step_fn(s, b))(state, batch)
+    labels, classes, specs, donated = _flat_call_site(
+        state, batch, state_specs, batch_specs)
+    if len(closed.jaxpr.invars) != len(labels):
+        raise AssertionError(
+            f"traced step has {len(closed.jaxpr.invars)} invars but the "
+            f"call-site tree has {len(labels)} leaves — the flat "
+            f"alignment the passes rely on broke")
+    meta = dict(
+        in_specs=specs, donated_invars=donated, invar_labels=labels,
+        invar_classes=classes, mesh_axes=dict(mesh.shape),
+        zero_stage=g["zero_stage"], train_geometry=geometry,
+    )
+    if g["pp"] > 1:
+        meta["expected_scan_trips"] = schedule_ticks(
+            g["pp"], g["microbatches"], g["vpp"])
+    if hbm_budget_bytes is not None:
+        meta["hbm_budget_bytes"] = int(hbm_budget_bytes)
+    return GraphTarget(
+        name=f"llama.train_step[{geometry}]", jaxpr=closed,
+        compute_dtype=cfg.dtype, meta=meta)
+
+
+def training_targets(geometries=None, **kw) -> List[GraphTarget]:
+    """GraphTargets for every flagship training geometry plus the 1F1B
+    stage-chunk group."""
+    out = [train_step_target(gname, **kw)
+           for gname in (geometries or TRAIN_GEOMETRIES)]
+    out += train_stage_targets()
+    return out
+
+
+def train_stage_targets(num_stages: int = 2, virtual_chunks: int = 2,
+                        seq_len: int = 8, batch: int = 2
+                        ) -> List[GraphTarget]:
+    """One fwd+bwd GraphTarget per 1F1B stage chunk (the per-slot
+    program ``pipeline_train_1f1b`` vmaps every tick), grouped for the
+    collective-consistency pass in loop-signature mode: under GSPMD the
+    chunks carry no explicit collectives, but their layer-scan trip
+    counts are the lockstep work contract — a chunk scanning a
+    different layer count (heterogeneous partition, a bad round-robin
+    edit) desynchronizes the schedule exactly like a diverging
+    collective."""
+    import jax
+
+    from ..models import llama as L
+    from ..parallel.pipeline_1f1b import split_chunks_round_robin
+
+    cfg = L.LlamaConfig.tiny(use_flash_attention=False, remat=False,
+                             pp_stages=num_stages,
+                             vpp_chunks=virtual_chunks,
+                             pp_schedule="1f1b")
+    params = L.abstract_params(cfg)
+    VS = num_stages * virtual_chunks
+    x = jax.ShapeDtypeStruct((batch, seq_len, cfg.hidden_size),
+                             cfg.dtype)
+
+    def chunk_fwd_bwd(chunk_params, xm):
+        y, pull = jax.vjp(
+            lambda p, h: L._scan_layers(p, h, cfg, None, remat=False),
+            chunk_params, xm)
+        return pull(y)  # grads wrt (chunk_params, xm)
+
+    targets = []
+    for k in range(VS):
+        chunk_k = jax.eval_shape(
+            lambda p, k=k: jax.tree_util.tree_map(
+                lambda c: c[k],
+                split_chunks_round_robin(
+                    p, cfg.num_hidden_layers, num_stages,
+                    virtual_chunks)),
+            params["layers"])
+        closed = jax.make_jaxpr(chunk_fwd_bwd)(chunk_k, x)
+        targets.append(GraphTarget(
+            name=f"llama.train_stage_chunk[{k}/{VS}]", jaxpr=closed,
+            compute_dtype=cfg.dtype,
+            meta={"stage_group": f"llama.train_pp[{num_stages}x"
+                                 f"{virtual_chunks}]",
+                  "stage_count": VS,
+                  "signature_include_loops": True}))
+    return targets
+
+
+def flagship_train_objects(dtype=None, batch_size: int = 4,
+                           seq_len: int = 8, zero_stage: int = 0):
+    """(target, step_fn, state, batch) for the single-device flagship
+    llama train step with CONCRETE arrays — the estimator-accuracy
+    harness: tests compile ``step_fn`` once and compare the target's
+    static estimate against XLA's own accounting. f32 by default: bf16
+    modules compiled on the CPU backend get float-normalized (f32)
+    buffers, an XLA-CPU artifact that would skew the comparison."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..models import llama as L
+    from ..parallel.mesh import init_hybrid_mesh
+
+    cfg = L.LlamaConfig.tiny(use_flash_attention=False, remat=False,
+                             dtype=dtype or jnp.float32)
+    hm = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
+    optimizer = L.default_train_optimizer()
+    step_fn, init_fn = L.make_train_step(cfg, hm.mesh,
+                                         optimizer=optimizer,
+                                         zero_stage=zero_stage)
+    state = init_fn(jax.random.PRNGKey(0))
+    batch = {"tokens": jnp.zeros((batch_size, seq_len), jnp.int32),
+             "labels": jnp.zeros((batch_size, seq_len), jnp.int32)}
+    state_specs = L.train_state_specs(cfg, hm.mesh, optimizer,
+                                      zero_stage)
+    closed = jax.make_jaxpr(lambda s, b: step_fn(s, b))(state, batch)
+    labels, classes, specs, donated = _flat_call_site(
+        state, batch, state_specs,
+        {"tokens": P(), "labels": P()})
+    target = GraphTarget(
+        name="llama.train_step[flagship-1dev]", jaxpr=closed,
+        compute_dtype=cfg.dtype,
+        meta=dict(in_specs=specs, donated_invars=donated,
+                  invar_labels=labels, invar_classes=classes,
+                  mesh_axes=dict(hm.mesh.shape), zero_stage=zero_stage,
+                  train_geometry="flagship-1dev"))
+    return target, step_fn, state, batch

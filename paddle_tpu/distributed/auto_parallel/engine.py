@@ -698,6 +698,55 @@ class Engine:
         return outs
 
     # ------------------------------------------------------------ intro ----
+    def donation_audit(self, x, y):
+        """Run the static donation/aliasing audit over the LIVE jitted
+        train step (analysis/donation.py): params, optimizer state and
+        buffers must enter donated (``_build_jit_step`` donates argnums
+        0-2) and every donated buffer must have an output to alias onto
+        — otherwise the step holds old+new state simultaneously at the
+        update. Returns error/warning findings (empty list = clean);
+        call after fit() has compiled the step (>= 2 batches).
+
+        The donation flags come from the step's actual LOWERING
+        (``tf.aliasing_output`` — what XLA will really alias), not from
+        re-stating the donate_argnums, so this audit cannot drift from
+        the jit wrapper it checks."""
+        if self._jit_step is None:
+            raise RuntimeError("run fit() for at least 2 steps first")
+        import jax
+
+        from ...analysis import Severity, jit_donation_flags
+        from ...analysis.donation import DonationAuditPass
+        from ...analysis.framework import GraphTarget
+
+        name_of = {id(p): n for n, p in self.model.named_parameters()}
+        groups = [
+            ("param", [name_of.get(id(p), f"param{i}")
+                       for i, p in enumerate(self._params)],
+             [p._data for p in self._params]),
+            ("opt", [f"opt_state[{i}]"
+                     for i in range(len(self._state_t))],
+             [t._data for t in self._state_t]),
+            ("buffer", [f"buffer[{i}]" for i in range(len(self._bufs))],
+             [b._data for b in self._bufs]),
+            ("data", ["x"], [x]),
+            ("data", ["y"], [y]),
+        ]
+        args = tuple(arrs for _, _, arrs in groups[:3]) + (x, y)
+        abstract = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+        donated = jit_donation_flags(self._jit_step, *abstract)
+        closed = jax.make_jaxpr(
+            lambda *a: self._jit_step.__wrapped__(*a))(*abstract)
+        labels = [lbl for _, lbls, _ in groups for lbl in lbls]
+        classes = [cls for cls, lbls, _ in groups for _ in lbls]
+        target = GraphTarget(
+            name="engine.jit_step", jaxpr=closed,
+            meta=dict(donated_invars=list(donated),
+                      invar_labels=labels, invar_classes=classes))
+        return [f for f in DonationAuditPass().run(target)
+                if f.severity != Severity.INFO]
+
     def distributed_plan(self):
         """The planner's decisions, name -> PartitionSpec (reference:
         Engine's dist_context program annotations)."""

@@ -25,8 +25,18 @@ from ..parallel.mesh import get_hybrid_mesh
 def zero_spec(base_spec, shape, dp: int, axis: str = "dp"):
     """ZeRO layout for one array: shard the first dp-divisible,
     not-already-sharded dim over the dp axis; None when no dim qualifies
-    (caller decides whether that is a warning or an error)."""
+    (caller decides whether that is a warning or an error).
+
+    An array whose base spec already uses ``axis`` is already
+    zero-sharded and returns None too — re-adding the axis on a second
+    dim would build an invalid duplicate-axis PartitionSpec (the
+    zero3-then-zero1 double-placement bug the sharding lint pinned:
+    optimizer moments inherit the param's zero3 spec and must not be
+    dp-sharded again)."""
     names = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    if any(n == axis or (isinstance(n, (tuple, list)) and axis in n)
+           for n in names):
+        return None
     for i, (n, s) in enumerate(zip(names, shape)):
         if n is None and s and s % dp == 0:
             names[i] = axis
@@ -92,7 +102,10 @@ def group_sharded_parallel(model, optimizer, level: str = "os_g",
     segment_size), schedules its own collectives (sync_comm), and HBM
     offload is a remat/policy decision here (offload)."""
     if level not in ("os", "os_g", "p_g_os"):
-        raise ValueError(f"unknown sharding level {level!r}")
+        raise ValueError(
+            f"unknown sharding level {level!r}: expected 'os' (stage 1, "
+            "optimizer state), 'os_g' (stage 2, + gradients) or "
+            "'p_g_os' (stage 3, + parameters)")
     import warnings
     for name, val, why in [
             ("offload", offload, "use jax.checkpoint policies / remat "

@@ -582,13 +582,72 @@ def grads_1f1b(params, batch, cfg: LlamaConfig, mesh: Mesh):
     return loss, grads
 
 
+def default_train_optimizer():
+    """The optimizer ``make_train_step`` builds when none is given —
+    one definition so the analysis targets (analysis/training_graphs.py)
+    derive specs for the exact optimizer the step runs."""
+    import optax
+    return optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+
+
+def train_state_specs(cfg: LlamaConfig, mesh: Mesh, optimizer=None,
+                      zero_stage: int = 0):
+    """PartitionSpec pytree matching ``make_train_step``'s state
+    ``{"params", "opt", "step"}`` — the declared layout, computed
+    without allocating anything. ``init_fn`` places by these specs and
+    the static sharding lint reads the same tree, so the two cannot
+    drift.
+
+    Optimizer-state leaves inherit the owning param's (tp/pp) spec
+    (every params-shaped subtree of the optax state maps one-to-one);
+    zero_stage >= 1 layers a dp dim on top of each leaf's own spec via
+    ``zero_spec``; zero_stage >= 3 does the same to the params.
+    """
+    from ..distributed.sharding import zero_spec
+    if optimizer is None:
+        optimizer = default_train_optimizer()
+    dp = mesh.shape.get("dp", 1)
+    pspecs = param_specs(cfg)
+    abs_params = abstract_params(cfg)
+
+    def add_zero(tree, abs_tree):
+        def place(sp, a):
+            if not getattr(a, "shape", None):
+                return sp  # scalars (step counts) stay replicated
+            zs = zero_spec(sp, a.shape, dp)
+            return sp if zs is None else zs
+        return jax.tree_util.tree_map(
+            place, tree, abs_tree, is_leaf=lambda x: isinstance(x, P))
+
+    # opt-state leaves mirror params subtree-by-subtree (adamw mu/nu);
+    # anything not params-shaped (count scalars) replicates
+    p_def = jax.tree_util.tree_structure(abs_params)
+    abs_opt = jax.eval_shape(optimizer.init, abs_params)
+
+    def params_like(node):
+        try:
+            return jax.tree_util.tree_structure(node) == p_def
+        except Exception:
+            return False
+
+    opt_specs = jax.tree_util.tree_map(
+        lambda node: pspecs if params_like(node) else P(),
+        abs_opt, is_leaf=params_like)
+    if zero_stage >= 1 and dp > 1:
+        opt_specs = add_zero(opt_specs, abs_opt)
+    if zero_stage >= 3 and dp > 1:
+        pspecs = add_zero(pspecs, abs_params)
+    return {"params": pspecs, "opt": opt_specs, "step": P()}
+
+
 def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None,
                     zero_stage: int = 0):
     """Build the jitted SPMD train step (fwd+bwd+adamw) over ``mesh``.
 
     Returns (step_fn, init_fn). ``init_fn(key)`` places params and
-    optimizer state sharded on the mesh; ``step_fn(state, batch)`` is one
-    update.
+    optimizer state sharded on the mesh per ``train_state_specs``;
+    ``step_fn(state, batch)`` is one update (state donated — params and
+    optimizer buffers are updated in place, never doubly resident).
 
     zero_stage (reference: fleet group-sharded stages,
     dygraph_sharding_optimizer.py:48 / group_sharded_stage3.py):
@@ -602,7 +661,7 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None,
     """
     import optax
     if optimizer is None:
-        optimizer = optax.adamw(3e-4, b1=0.9, b2=0.95, weight_decay=0.1)
+        optimizer = default_train_optimizer()
     if zero_stage not in (0, 1, 2, 3):
         raise ValueError(f"zero_stage must be 0..3, got {zero_stage}")
 
@@ -611,55 +670,56 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None,
         raise ValueError(f"pp_schedule must be 'gpipe' or '1f1b', "
                          f"got {cfg.pp_schedule!r}")
 
-    def _zero_place(tree, base_specs):
-        """dp-shard every leaf on its first free divisible dim, on top of
-        the existing tp/pp layout."""
-        from ..distributed.sharding import zero_spec
-
-        def place(x, spec):
-            zs = zero_spec(spec, x.shape, mesh.shape.get("dp", 1))
-            if zs is None:
-                return x  # scalars / unshardable: replicated
-            return jax.device_put(x, NamedSharding(mesh, zs))
-        return jax.tree_util.tree_map(place, tree, base_specs,
-                                      is_leaf=lambda x: isinstance(x, P))
-
     def init_fn(key):
-        params = init_params(cfg, key)
-        params = shard_params(params, cfg, mesh)
-        specs = param_specs(cfg)
-        if zero_stage >= 3:
-            params = _zero_place(params, specs)
-        opt_state = optimizer.init(params)
-        if zero_stage >= 1 and mesh.shape.get("dp", 1) > 1:
-            # optimizer.init already gave every moment its param's (tp/pp)
-            # sharding; add the dp dim on top of each leaf's OWN current
-            # spec (matching params by shape would mis-place same-shape,
-            # differently-sharded weights)
-            from ..distributed.sharding import zero_spec
+        specs = train_state_specs(cfg, mesh, optimizer, zero_stage)
+        params = jax.tree_util.tree_map(
+            lambda x, sp: jax.device_put(x, NamedSharding(mesh, sp)),
+            init_params(cfg, key), specs["params"])
+        # moments are born directly in their declared (possibly
+        # dp-sharded) layout: optimizer.init on unsharded params would
+        # transiently hold 2x full param bytes replicated per device —
+        # the exact peak ZeRO stages exist to avoid
+        opt_shardings = jax.tree_util.tree_map(
+            lambda sp: NamedSharding(mesh, sp), specs["opt"],
+            is_leaf=lambda x: isinstance(x, P))
+        opt_state = jax.jit(optimizer.init,
+                            out_shardings=opt_shardings)(params)
+        return {"params": params, "opt": opt_state,
+                "step": jax.device_put(
+                    jnp.zeros((), jnp.int32),
+                    NamedSharding(mesh, specs["step"]))}
 
-            def place(x):
-                if not hasattr(x, "shape") or not x.shape:
-                    return x  # scalars (step counts) stay replicated
-                cur = (x.sharding.spec
-                       if isinstance(getattr(x, "sharding", None),
-                                     NamedSharding) else P())
-                zs = zero_spec(cur, x.shape, mesh.shape["dp"])
-                if zs is None:
-                    return x
-                return jax.device_put(x, NamedSharding(mesh, zs))
-            opt_state = jax.tree_util.tree_map(place, opt_state)
-        return {"params": params, "opt": opt_state, "step": jnp.zeros((), jnp.int32)}
+    # ZeRO-3 rebuild-on-forward (group_sharded_stage3.py): compute runs
+    # on params gathered back to their tp/pp-only layout; only STORAGE
+    # (the state between steps) is dp-sharded. Besides being the
+    # reference semantics, this keeps dp-sharded weights out of the
+    # differentiated layer scan, which the CPU SPMD partitioner
+    # miscompiles (fwd+bwd loss drifts 3e-3 from the f64 reference —
+    # pinned by tests/test_zero_sharding.py numerics tests).
+    fwd_pspecs = param_specs(cfg) if zero_stage >= 3 else None
+    stored_pspecs = (train_state_specs(cfg, mesh, optimizer,
+                                       zero_stage)["params"]
+                     if zero_stage >= 3 else None)
+
+    def _constrain(params, specs):
+        return jax.tree_util.tree_map(
+            lambda x, sp: lax.with_sharding_constraint(
+                x, NamedSharding(mesh, sp)), params, specs)
 
     @partial(jax.jit, donate_argnums=(0,))
     def step_fn(state, batch):
+        params = state["params"]
+        if zero_stage >= 3:
+            params = _constrain(params, fwd_pspecs)
         if use_1f1b:
-            loss, grads = grads_1f1b(state["params"], batch, cfg, mesh)
+            loss, grads = grads_1f1b(params, batch, cfg, mesh)
         else:
             loss, grads = jax.value_and_grad(loss_fn)(
-                state["params"], batch, cfg, mesh)
-        updates, opt = optimizer.update(grads, state["opt"], state["params"])
-        params = optax.apply_updates(state["params"], updates)
+                params, batch, cfg, mesh)
+        updates, opt = optimizer.update(grads, state["opt"], params)
+        params = optax.apply_updates(params, updates)
+        if zero_stage >= 3:
+            params = _constrain(params, stored_pspecs)
         return {"params": params, "opt": opt,
                 "step": state["step"] + 1}, loss
 

@@ -59,8 +59,6 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 def gather_tree(ids, parents, name=None):
     """Beam-search ancestry walk (reference extension.py gather_tree over
     phi gather_tree kernel): ids/parents [T, B, W] -> full paths."""
-    T = ids.shape[0]
-
     def step(carry, xs):
         beam = carry                        # [B, W] current beam index
         ids_t, parents_t = xs
@@ -734,8 +732,6 @@ def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
     col = (sparse_csr_columns.data
            if isinstance(sparse_csr_columns, Tensor)
            else jnp.asarray(sparse_csr_columns))
-    nnz_per = np.asarray(off)[..., -1]
-
     class _SP:
         indptr = np.asarray(off).reshape(B * H, T + 1)
         indices = np.asarray(col).reshape(B * H, -1)

@@ -201,7 +201,6 @@ def index_fill(x, index, axis, value, name=None):
 
 @register_op()
 def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
-    n = min(x.shape[axis1], x.shape[axis2])
     k = y.shape[-1]
     i = jnp.arange(k)
     r = i + max(-offset, 0)

@@ -306,7 +306,6 @@ def sort(x, axis=-1, descending=False, stable=False, name=None):
 @register_op(differentiable=False)
 def argsort(x, axis=-1, descending=False, stable=False, name=None):
     if descending:
-        n = x.shape[axis]
         idx = jnp.argsort(-x, axis=axis, stable=True)
         return idx.astype(jnp.int32)
     return jnp.argsort(x, axis=axis, stable=True).astype(jnp.int32)

@@ -130,7 +130,6 @@ def _cache_key(name, fn, treedef, tensors, diff_mask, statics, tensor_pos):
 def _build_cached(name, fn, treedef, leaves_template, tensor_pos,
                   diff_mask):
     """Build jitted fwd / bwd for one (structure, avals, statics) class."""
-    n_tensors = len(tensor_pos)
 
     def rebuild(tensor_arrays):
         leaves = list(leaves_template)

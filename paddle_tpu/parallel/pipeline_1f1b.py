@@ -67,6 +67,18 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
+def schedule_ticks(num_stages: int, num_microbatches: int,
+                   virtual_chunks: int = 1) -> int:
+    """Trip count of the 1F1B schedule scan: ``M + 2·S·V - 1`` lockstep
+    ticks (fill + steady state + drain). This is the ONE definition —
+    ``pipeline_train_1f1b`` sizes its scan with it and the
+    collective-consistency lint checks the traced scan against it, so a
+    schedule edit that changes the tick arithmetic cannot silently
+    desynchronize the two."""
+    S = int(num_stages) * int(virtual_chunks)
+    return int(num_microbatches) + 2 * S - 1
+
+
 def _tree_zeros_f32(t):
     return jax.tree_util.tree_map(
         lambda x: jnp.zeros(x.shape, jnp.float32), t)
@@ -200,8 +212,8 @@ def pipeline_train_1f1b(
 
     carry0 = (fstate0, bstate0, saved0, gacc0, ghead0,
               jnp.zeros((), jnp.float32))
-    (carry_out, dx_stream) = lax.scan(tick, carry0,
-                                      jnp.arange(M + 2 * S - 1))
+    (carry_out, dx_stream) = lax.scan(
+        tick, carry0, jnp.arange(schedule_ticks(S_dev, M, V)))
     _, _, _, gacc, ghead, loss_sum = carry_out
 
     # stage-0 dx for microbatch m emerges at tick m + (2S-1)

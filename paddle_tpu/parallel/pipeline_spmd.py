@@ -52,7 +52,6 @@ def pipeline_spmd(
     Returns ``[M, mb, ...]`` outputs, each having passed through all stages.
     """
     S = num_stages
-    M = x.shape[0]
     if remat:
         stage_fn = jax.checkpoint(stage_fn)
     stages_step = jax.vmap(stage_fn)  # over the stage axis

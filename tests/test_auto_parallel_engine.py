@@ -439,6 +439,50 @@ def test_engine_warns_on_non_dp_divisible_batch():
         eng.fit([(x, y)], epochs=1)
 
 
+def test_engine_donation_audit_passes_on_live_step():
+    """ISSUE 5 satellite: the donation audit must pass on the LIVE
+    jitted Engine step — params, optimizer state and buffers all enter
+    donated (donate_argnums=(0,1,2)) and every donated buffer aliases
+    an output. The donation flags are read back from the step's actual
+    lowering, so this is a regression pin on the jit wrapper itself."""
+    model = MLP()
+    opt = pt.optimizer.AdamW(learning_rate=1e-3,
+                             parameters=model.parameters())
+    eng = Engine(model, loss=_mse, optimizer=opt,
+                 strategy=Strategy(dp_degree=2, mp_degree=2,
+                                   min_shard_size=128))
+    data = list(_data(3))
+    eng.fit(data, epochs=1)
+    assert eng._jit_step is not None
+    x, y = eng._shard_arr(data[0][0]), eng._shard_arr(data[0][1])
+    assert eng.donation_audit(x, y) == []
+
+
+def test_engine_plan_audit_matches_mpu_hints():
+    """Mesh-axis-mismatch audit: a prepared Engine's plan must agree
+    with the mpu usage declarations; a contradicting entry is caught."""
+    from jax.sharding import PartitionSpec as P
+    from paddle_tpu.analysis import audit_engine_plan
+    from paddle_tpu.distributed import mpu
+
+    class MpuNet(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.emb = mpu.VocabParallelEmbedding(64, 32)
+            self.col = mpu.ColumnParallelLinear(32, 64)
+            self.row = mpu.RowParallelLinear(64, 32)
+
+        def forward(self, x):
+            return self.row(self.col(self.emb(x)))
+
+    eng = Engine(MpuNet(), strategy=Strategy(mp_degree=2,
+                                             min_shard_size=1 << 30))
+    assert audit_engine_plan(eng) == []
+    eng.plan["col.weight"] = P("mp", None)     # seeded: wrong axis/dim
+    bad = audit_engine_plan(eng)
+    assert bad and "ColumnParallelLinear" in bad[0].message
+
+
 def test_planner_honors_mpu_layer_types():
     """r4 Weak #5: Column/Row/Vocab parallel layer types are usage
     declarations; the planner must use them instead of dim-order

@@ -182,3 +182,40 @@ def test_zero_spec_picks_first_free_divisible_dim():
     assert tuple(zero_spec(P("tp"), (32, 64), 8)) == ("tp", "dp")
     assert zero_spec(P(), (7, 9), 8) is None
     assert zero_spec(P(), (), 8) is None
+    # already dp-sharded arrays are DONE, not re-sharded on a second
+    # dim (P('dp','dp') is invalid — the zero3 moments bug)
+    assert zero_spec(P("dp", None), (32, 64), 8) is None
+
+
+def test_zero3_moments_valid_at_small_dp():
+    """Regression: zero3 at dp=2 used to stack a second 'dp' onto
+    moments whose param spec already carried one (layer weights have a
+    free dp-divisible dim left over) — an invalid PartitionSpec at
+    init. The whole state must place cleanly and every spec use each
+    axis at most once."""
+    hm = init_hybrid_mesh(dp=2, pp=1, tp=1, set_global=False)
+    with hm.mesh:
+        _, init = L.make_train_step(CFG, hm.mesh, zero_stage=3)
+        state = init(jax.random.PRNGKey(0))
+    for leaf in jax.tree_util.tree_leaves(state):
+        spec = tuple(leaf.sharding.spec)
+        axes = [a for a in spec if a is not None]
+        assert len(axes) == len(set(axes)), spec
+
+
+def test_train_state_specs_match_placed_state():
+    """The declared spec tree (what the sharding lint reads) and the
+    actually placed state (what init_fn builds) are the same thing —
+    leaf for leaf."""
+    hm = init_hybrid_mesh(dp=8, pp=1, tp=1, set_global=False)
+    with hm.mesh:
+        _, init = L.make_train_step(CFG, hm.mesh, zero_stage=1)
+        state = init(jax.random.PRNGKey(0))
+    specs = L.train_state_specs(CFG, hm.mesh, zero_stage=1)
+    flat_s = jax.tree_util.tree_leaves(state)
+    flat_p = jax.tree_util.tree_leaves(
+        specs, is_leaf=lambda s: isinstance(s, P))
+    assert len(flat_s) == len(flat_p)
+    for leaf, spec in zip(flat_s, flat_p):
+        assert tuple(leaf.sharding.spec) == tuple(spec), \
+            (leaf.shape, leaf.sharding.spec, spec)

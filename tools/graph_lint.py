@@ -1,11 +1,11 @@
 """Graph lint CLI: run the static-analysis passes over the flagship
-serving graphs.
+serving AND training graphs.
 
 The pre-merge check (with ruff — see pyproject.toml):
 
     JAX_PLATFORMS=cpu python tools/graph_lint.py --ci
 
-runs, in a few seconds and with zero XLA compiles:
+runs, in seconds and with zero XLA compiles:
 
   * the jaxpr lint passes (dtype-drift, host-sync,
     collective-consistency) over the flagship llama + qwen2_moe
@@ -15,14 +15,19 @@ runs, in a few seconds and with zero XLA compiles:
   * the recompile-hazard pass over the flagship engine geometry —
     statically proving the ≤16-programs-per-bucket chunk-prefill
     invariant;
+  * the TRAINING passes (sharding-lint, donation-audit, hbm-peak,
+    collective-consistency trip counts) over the llama auto-parallel
+    train step at the dp / dp×mp / pp-1F1B / zero1 geometries plus the
+    1F1B stage-chunk group (analysis/training_graphs.py);
   * (--ci) the AST source lint over paddle_tpu/ + tools/
     (analysis/source_lint.py), plus `ruff check` when the binary is
     installed (the container image does not ship it; the AST subset
     always runs so the gate can never silently no-op).
 
 Exit status: non-zero on any ERROR finding. `--json` emits a
-machine-readable report; `--verbose` includes INFO findings (program
-inventories, declared f32 islands).
+machine-readable report including the per-geometry HBM peak estimates;
+`--verbose` includes INFO findings (program inventories, declared f32
+islands, donation inventories, HBM tops).
 """
 import argparse
 import json
@@ -30,27 +35,30 @@ import os
 import shutil
 import subprocess
 import sys
+import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def build_passes(limit: int):
-    from paddle_tpu.analysis import (CollectiveConsistencyPass,
-                                     DtypeDriftPass, HostSyncPass,
-                                     RecompileHazardPass)
-    return [DtypeDriftPass(), HostSyncPass(),
-            RecompileHazardPass(limit=limit),
-            CollectiveConsistencyPass()]
+    from paddle_tpu.analysis import default_passes
+    return default_passes(**{"recompile-hazard": {"limit": limit}})
 
 
-def run_graph_passes(models, limit):
+def run_graph_passes(models, limit, suite="all"):
     from paddle_tpu.analysis import (pp_stage_targets, run_passes,
-                                     serving_targets)
+                                     serving_targets, training_targets)
     targets = []
-    for m in models:
-        targets += serving_targets(m)
-    targets += pp_stage_targets()
-    return run_passes(build_passes(limit), targets)
+    if suite in ("all", "serving"):
+        for m in models:
+            targets += serving_targets(m)
+        targets += pp_stage_targets()
+    if suite in ("all", "training"):
+        targets += training_targets()
+    passes = build_passes(limit)
+    report = run_passes(passes, targets)
+    hbm = next((p for p in passes if p.name == "hbm-peak"), None)
+    return report, (hbm.reports if hbm is not None else {})
 
 
 def run_ruff(root):
@@ -67,9 +75,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--models", nargs="+",
                     default=["llama", "qwen2_moe"],
-                    help="flagship models to lint")
+                    help="flagship models to lint (serving suite)")
     ap.add_argument("--limit", type=int, default=16,
                     help="recompile-hazard programs-per-bucket bound")
+    ap.add_argument("--suite", choices=["all", "serving", "training"],
+                    default="all")
     ap.add_argument("--ci", action="store_true",
                     help="also run the source lint (+ruff if installed)"
                          " — the pre-merge configuration")
@@ -78,12 +88,21 @@ def main(argv=None):
                     help="include INFO findings")
     args = ap.parse_args(argv)
 
-    # lint runs must not grab the TPU tunnel: tracing is platform-free
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # lint runs must not grab the TPU tunnel, and the training
+    # geometries need the virtual 8-device CPU mesh (tracing only —
+    # nothing executes on the fake devices)
+    from paddle_tpu.testing import force_host_cpu_devices
+    force_host_cpu_devices(8)
 
-    report = run_graph_passes(args.models, args.limit)
+    t0 = time.time()
+    report, hbm = run_graph_passes(args.models, args.limit, args.suite)
     ok = report.ok
     out = {"graph": report.to_dict()}
+    out["hbm"] = [
+        {"graph": name, "peak_bytes": est.peak_bytes,
+         "input_bytes": est.args_bytes,
+         "top": [{"bytes": b, "value": lbl} for b, lbl in est.top]}
+        for name, est in sorted(hbm.items())]
 
     if args.ci:
         from paddle_tpu.analysis.source_lint import lint_tree
@@ -98,17 +117,19 @@ def main(argv=None):
         if not ruff_ok:
             out["ruff"]["output"] = ruff_out[-4000:]
         ok = ok and ruff_ok
+    out["seconds"] = round(time.time() - t0, 2)
 
     if args.json:
         print(json.dumps(out, indent=2))
     else:
         from paddle_tpu.analysis import Severity
-        shown = 0
         for f in report.findings:
             if f.severity == Severity.INFO and not args.verbose:
                 continue
             print(f)
-            shown += 1
+        if args.verbose:
+            for name, est in sorted(hbm.items()):
+                print(est)
         if args.ci:
             for item in out.get("source", []):
                 print(f"[error] source-lint @ {item['file']}:"
@@ -119,7 +140,7 @@ def main(argv=None):
                   f"{'' if r['ran'] else ' (not installed)'}")
             if not r["ok"]:
                 print(out["ruff"].get("output", ""))
-        print(f"graph lint: {report.summary()} -> "
+        print(f"graph lint: {report.summary()} in {out['seconds']}s -> "
               f"{'OK' if ok else 'FAIL'}")
     return 0 if ok else 1
 
