@@ -24,9 +24,12 @@ from .collectives import (CollectiveConsistencyPass,
                           collective_signature, scan_trip_counts)
 from .donation import DonationAuditPass, jit_donation_flags
 from .dtype_drift import DtypeDriftPass
-from .framework import (Finding, GraphTarget, LintPass, LintReport,
-                        PASS_REGISTRY, Severity, default_passes,
-                        register_pass, run_passes, trace_graph)
+from .framework import (ExactnessContract, Finding, GraphTarget,
+                        LintPass, LintReport, PASS_REGISTRY,
+                        REWRITE_REGISTRY, RewritePass, Severity,
+                        default_passes, default_rewrites,
+                        register_pass, register_rewrite, run_passes,
+                        trace_graph)
 from .hbm import (HbmEstimate, HbmPeakPass, estimate_hbm_peak,
                   xla_peak_bytes)
 from .host_sync import HostSyncPass
@@ -35,8 +38,12 @@ from .kv_invariants import (KVInvariantError, Violation,
                             audit_serving_state)
 from .recompile import (RecompileHazardPass, ServingGeometry,
                         enumerate_chunk_programs)
+from .rewrite import (FusedRmsNormPass, Int8EpilogueFusePass,
+                      RewriteResult, VerifyOutcome, count_matches,
+                      rewrite_callable, rewrite_jaxpr, rewrite_target,
+                      run_rewrite_suite, verify_rewrite, verify_site)
 from .serving_graphs import (engine_geometry, pp_stage_targets,
-                             serving_targets)
+                             rewrite_targets, serving_targets)
 from .sharding_lint import (ShardingLintPass, audit_engine_plan,
                             spec_shard_factor)
 from .training_graphs import (TRAIN_GEOMETRIES, flagship_train_objects,
@@ -45,17 +52,23 @@ from .training_graphs import (TRAIN_GEOMETRIES, flagship_train_objects,
 
 __all__ = [
     "CollectiveConsistencyPass", "DonationAuditPass", "DtypeDriftPass",
-    "Finding", "GraphTarget", "HbmEstimate", "HbmPeakPass",
-    "HostSyncPass", "KVInvariantError", "LintPass", "LintReport",
-    "PASS_REGISTRY", "RecompileHazardPass", "ServingGeometry",
-    "Severity", "ShardingLintPass", "TRAIN_GEOMETRIES", "Violation",
+    "ExactnessContract", "Finding", "FusedRmsNormPass", "GraphTarget",
+    "HbmEstimate", "HbmPeakPass", "HostSyncPass",
+    "Int8EpilogueFusePass", "KVInvariantError", "LintPass",
+    "LintReport", "PASS_REGISTRY", "REWRITE_REGISTRY",
+    "RecompileHazardPass", "RewritePass", "RewriteResult",
+    "ServingGeometry", "Severity", "ShardingLintPass",
+    "TRAIN_GEOMETRIES", "VerifyOutcome", "Violation",
     "audit_defrag_plan", "audit_engine", "audit_engine_plan",
     "audit_serving_state", "check_stage_consistency",
-    "collective_signature", "default_passes", "engine_geometry",
-    "enumerate_chunk_programs", "estimate_hbm_peak",
-    "flagship_train_objects", "jit_donation_flags", "pp_stage_targets",
-    "register_pass", "run_passes", "scan_trip_counts",
-    "serving_targets", "spec_shard_factor", "trace_graph",
-    "train_stage_targets", "train_step_target", "training_targets",
-    "xla_peak_bytes",
+    "collective_signature", "count_matches", "default_passes",
+    "default_rewrites", "engine_geometry", "enumerate_chunk_programs",
+    "estimate_hbm_peak", "flagship_train_objects",
+    "jit_donation_flags", "pp_stage_targets", "register_pass",
+    "register_rewrite", "rewrite_callable", "rewrite_jaxpr",
+    "rewrite_target", "rewrite_targets", "run_passes",
+    "run_rewrite_suite", "scan_trip_counts", "serving_targets",
+    "spec_shard_factor", "trace_graph", "train_stage_targets",
+    "train_step_target", "training_targets", "verify_rewrite",
+    "verify_site", "xla_peak_bytes",
 ]

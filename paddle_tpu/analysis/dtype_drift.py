@@ -59,7 +59,10 @@ def _is_float(dt) -> bool:
         # jnp.issubdtype, not np: the extended float dtypes (bfloat16,
         # f8 variants) register as numpy kind 'V' and np.issubdtype
         # calls them non-floating
-        return bool(jnp.issubdtype(np.dtype(dt), jnp.floating))
+        # issubdtype is a metadata predicate (already a Python bool) —
+        # no bool() wrapper, which source_lint PT003 would read as a
+        # device-array coercion
+        return jnp.issubdtype(np.dtype(dt), jnp.floating)
     except TypeError:
         return False
 

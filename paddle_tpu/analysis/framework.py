@@ -23,7 +23,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["Severity", "Finding", "GraphTarget", "LintPass",
            "LintReport", "PASS_REGISTRY", "register_pass",
-           "default_passes", "run_passes", "trace_graph"]
+           "default_passes", "run_passes", "trace_graph",
+           "ExactnessContract", "RewritePass", "REWRITE_REGISTRY",
+           "register_rewrite", "default_rewrites"]
 
 #: name -> LintPass subclass; every pass registers itself here so the
 #: CLI (tools/graph_lint.py) and the tests build the same pass set —
@@ -31,12 +33,34 @@ __all__ = ["Severity", "Finding", "GraphTarget", "LintPass",
 #: anti-pattern in a new costume.
 PASS_REGISTRY: Dict[str, type] = {}
 
+#: name -> RewritePass subclass. Same contract as PASS_REGISTRY: the
+#: rewrite suite (tools/graph_lint.py --suite rewrite), the rewriting
+#: engine wrapper (serving) and the tests all build from this one
+#: registry, so a rewrite that exists but is wired nowhere cannot
+#: happen.
+REWRITE_REGISTRY: Dict[str, type] = {}
+
 
 def register_pass(cls):
     """Class decorator: add a LintPass subclass to ``PASS_REGISTRY``
     under its ``name``."""
     PASS_REGISTRY[cls.name] = cls
     return cls
+
+
+def register_rewrite(cls):
+    """Class decorator: add a RewritePass subclass to
+    ``REWRITE_REGISTRY`` under its ``name``."""
+    REWRITE_REGISTRY[cls.name] = cls
+    return cls
+
+
+def default_rewrites(names=None) -> List["RewritePass"]:
+    """One instance of every registered rewrite (or of ``names``), in
+    registration order."""
+    if names is None:
+        return [cls() for cls in REWRITE_REGISTRY.values()]
+    return [REWRITE_REGISTRY[n]() for n in names]
 
 
 def default_passes(**ctor_kwargs) -> List["LintPass"]:
@@ -119,6 +143,66 @@ class LintPass:
                 path: Tuple = ()) -> Finding:
         return Finding(pass_name=self.name, severity=severity,
                        graph=target.name, message=message, path=path)
+
+
+@dataclass
+class ExactnessContract:
+    """What a rewrite is allowed to change about the numbers.
+
+    ``bitwise=True`` — the replacement is byte-identical (integer
+    outputs, or a substitution proven to round identically).
+    ``ulp=N`` — the replacement performs the same operations in the
+    same association, but compiler clustering (FMA contraction, fusion
+    boundaries) may round differently: outputs must be within N units-
+    in-last-place of the OUTPUT dtype (the kernel-substitution
+    contract). Otherwise the rewrite genuinely reassociates (e.g.
+    moving a dequant scale across a matmul) and must pin
+    ``rtol``/``atol``: close-enough-by-accident is not a contract.
+    """
+    bitwise: bool = False
+    ulp: int = 0
+    rtol: float = 0.0
+    atol: float = 0.0
+
+    def describe(self) -> str:
+        if self.bitwise:
+            return "bitwise"
+        if self.ulp:
+            return f"ulp<={self.ulp}"
+        return f"rtol={self.rtol:g} atol={self.atol:g}"
+
+
+class RewritePass:
+    """Base class for graph rewrites (the optimizer counterpart of
+    :class:`LintPass`). Subclasses declare:
+
+    * ``name`` — registry key;
+    * ``contract`` — the :class:`ExactnessContract` the verifier
+      enforces before the rewrite is allowed to ship;
+    * ``patterns()`` — anchor-variant list of :mod:`patterns` trees
+      describing the subgraph to replace;
+    * ``arg_names`` — which pattern captures feed the replacement, in
+      call order;
+    * ``build(statics)`` — the replacement callable taking the captured
+      values; ``statics`` holds the ``Lit`` captures (Python numbers).
+    * ``validate(match, jaxpr)`` — optional cross-binding check.
+
+    The machinery that applies these lives in ``analysis/rewrite.py``;
+    passes themselves stay declarative.
+    """
+
+    name: str = "rewrite"
+    contract: ExactnessContract = ExactnessContract(bitwise=True)
+    arg_names: Tuple[str, ...] = ()
+
+    def patterns(self):
+        raise NotImplementedError
+
+    def build(self, statics: Dict[str, Any]) -> Callable:
+        raise NotImplementedError
+
+    def validate(self, match, jaxpr) -> bool:
+        return True
 
 
 @dataclass

@@ -43,7 +43,7 @@ from .framework import (GraphTarget, LintPass, Severity, register_pass)
 from .sharding_lint import spec_shard_factor
 
 __all__ = ["HbmEstimate", "estimate_hbm_peak", "HbmPeakPass",
-           "xla_peak_bytes"]
+           "xla_cost_analysis", "xla_peak_bytes"]
 
 
 def _nbytes(aval) -> int:
@@ -241,6 +241,24 @@ class HbmPeakPass(LintPass):
                 f"{int(budget) / 2**20:.2f} MiB — the step does not "
                 f"fit the geometry it claims to run on"))
         return findings
+
+
+def xla_cost_analysis(compiled) -> Dict[str, float]:
+    """``compiled.cost_analysis()`` normalized across jax versions: the
+    current one returns a LIST with one properties-dict per partition,
+    older ones return the dict directly. Always returns a (possibly
+    empty) plain dict for the addressable partition, so callers can
+    ``.get("flops")`` without version branches — the one shared helper
+    for every cost_analysis consumer (this module's accuracy pin,
+    tools/resnet_bench.py, tools/decode_profile.py, the 1F1B
+    schedule-efficiency test)."""
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return {}
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    return dict(ca) if ca else {}
 
 
 def xla_peak_bytes(compiled) -> Optional[int]:

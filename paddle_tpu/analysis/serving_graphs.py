@@ -21,7 +21,7 @@ from .framework import GraphTarget, trace_graph
 from .recompile import ServingGeometry, enumerate_chunk_programs
 
 __all__ = ["engine_geometry", "serving_targets", "pp_stage_targets",
-           "FLAGSHIP_MODELS"]
+           "rewrite_targets", "FLAGSHIP_MODELS"]
 
 FLAGSHIP_MODELS = ("llama", "qwen2_moe")
 
@@ -144,6 +144,87 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
                                page_size=page_size, attn_impl="dense"),
             compute_dtype=cfg.dtype, slots=B, steps_per_call=mnt,
             in_decode_loop=True, meta=dict(meta)))
+    return targets
+
+
+def rewrite_targets(models=("llama",), *, slots: int = 4,
+                    page_size: int = 4, max_prompt_len: int = 16,
+                    max_new_tokens_cap: int = 16, decode_block: int = 4,
+                    serving_pool: Optional[List[GraphTarget]] = None
+                    ) -> List[GraphTarget]:
+    """Flagship targets for the REWRITE suite (graph_lint --suite
+    rewrite): per model, the fused decode block and the cold prefill
+    chunk — both traced with the fused norm/rope kernels OFF (the
+    default off-TPU), so the jnp rmsnorm formulation the
+    ``fused-rmsnorm`` substitution targets is really present — plus,
+    for llama, the int8 decode step traced with the UNFUSED
+    dequantize-then-matmul idiom (``PADDLE_TPU_INT8_IMPL=unfused``),
+    the seeded graph the ``int8-epilogue-fuse`` pass must fire on.
+
+    Each target's ``meta['expect_rewrites']`` names the rewrites that
+    MUST fire there — the suite errors if one does not, so the
+    patterns cannot silently rot as the model code evolves.
+
+    ``serving_pool``: already-traced serving targets (the lint suite's
+    — same default geometry) to select from instead of re-tracing
+    them, so ``graph_lint --suite all`` traces each flagship program
+    once."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+
+    targets: List[GraphTarget] = []
+    for m in models:
+        pool = (serving_pool if serving_pool is not None
+                else serving_targets(
+                    m, slots=slots, page_size=page_size,
+                    max_prompt_len=max_prompt_len,
+                    max_new_tokens_cap=max_new_tokens_cap,
+                    decode_block=decode_block))
+        for t in pool:
+            if not t.name.startswith(m + "."):
+                continue
+            if ("serving_decode_block" in t.name
+                    or "prefix_pages=0" in t.name):
+                t.meta["expect_rewrites"] = ("fused-rmsnorm",)
+                targets.append(t)
+
+    # --- int8: the un-fused dequant-matmul decode step (llama is the
+    # int8 flagship — skipped when the caller excluded llama) ---------
+    if "llama" not in models:
+        return targets
+    from ..quantization.decode import quantize_for_decode
+    mod, cfg = _get_model("llama")
+    geom = engine_geometry(
+        page_size=page_size, max_prompt_len=max_prompt_len,
+        max_new_tokens_cap=max_new_tokens_cap)
+    pps = geom.pages_per_slot
+    total_pages = slots * pps + 1
+    qparams = jax.eval_shape(lambda: quantize_for_decode(
+        mod.init_params(cfg, jax.random.PRNGKey(0)), cfg))
+    pools = jax.eval_shape(
+        lambda: mod.init_serving_pages(cfg, total_pages, page_size))
+    sds, i32 = jax.ShapeDtypeStruct, jnp.int32
+    prev = os.environ.get("PADDLE_TPU_INT8_IMPL")
+    os.environ["PADDLE_TPU_INT8_IMPL"] = "unfused"
+    try:
+        t = trace_graph(
+            "llama.serving_decode_step[int8-unfused]",
+            mod.serving_decode_step,
+            (qparams, sds((slots,), i32), sds((slots,), i32),
+             sds((slots, pps), i32), pools["k_pages"],
+             pools["v_pages"]),
+            static_kwargs=dict(cfg=cfg, attn_impl="dense"),
+            compute_dtype=cfg.dtype, slots=slots, in_decode_loop=True,
+            donated_outputs=(1, 2))
+    finally:
+        if prev is None:
+            os.environ.pop("PADDLE_TPU_INT8_IMPL", None)
+        else:
+            os.environ["PADDLE_TPU_INT8_IMPL"] = prev
+    t.meta["expect_rewrites"] = ("int8-epilogue-fuse", "fused-rmsnorm")
+    targets.append(t)
     return targets
 
 

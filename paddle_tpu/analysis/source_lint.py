@@ -21,6 +21,19 @@ lints source, with ruff layered on top when available:
   unpacking, augmented assignment, underscore-prefixed names and
   ``global``/``nonlocal`` names never flag (matching ruff's default
   F841 scope; an unused loop variable is B007's business, not ours).
+* **host-sync** (PT001/PT002/PT003) — *library code only*
+  (``paddle_tpu/``; tools and tests, which legitimately pull results
+  to the host, are exempt): the source-level companion of the
+  host-sync GRAPH pass (analysis/host_sync.py). ``jax.device_get``
+  (PT001) and ``.block_until_ready()`` (PT002) calls, and
+  ``float(...)``/``bool(...)`` coercions whose argument involves a
+  ``jnp``/``jax``/``lax`` expression (PT003) — each is a device→host
+  round-trip that serializes the dispatch pipeline (the
+  GradScaler-per-param and [S,V]-logits bug classes). Deliberate
+  syncs (a ``synchronize()`` API, a timing harness) carry
+  ``# noqa: PT00x`` with a justification. The PT003 heuristic is
+  conservative by construction: coercions of locals it cannot prove
+  jax-rooted do not flag.
 
 Scope: ``paddle_tpu/`` and ``tools/`` (tests use pytest fixtures whose
 "unused" imports are the fixture mechanism).
@@ -100,9 +113,12 @@ def _noqa_map(src: str):
     return out
 
 
-def lint_file(path: Path, src: str = None) -> List[Tuple]:
+def lint_file(path: Path, src: str = None,
+              host_sync_scope: bool = False) -> List[Tuple]:
     """[(rule, lineno, message)] for one file. ``# noqa`` (optionally
-    ``# noqa: F401,E711``) on the statement's first line suppresses."""
+    ``# noqa: F401,E711``) on the statement's first line suppresses.
+    ``host_sync_scope=True`` (library code under ``paddle_tpu/``)
+    additionally runs the PT00x host-sync rules."""
     if src is None:
         src = Path(path).read_text()
     try:
@@ -193,6 +209,46 @@ def lint_file(path: Path, src: str = None) -> List[Tuple]:
                 f"local `{bound}` in `{fn.name}()` is assigned but "
                 f"never used"))
 
+    # ---- host syncs in library code (PT001/PT002/PT003) -------------
+    if host_sync_scope:
+        def _jax_rooted(expr) -> bool:
+            return any(isinstance(n, ast.Name)
+                       and n.id in ("jnp", "jax", "lax")
+                       for n in ast.walk(expr))
+
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if ((isinstance(f, ast.Attribute) and f.attr == "device_get")
+                    or (isinstance(f, ast.Name)
+                        and f.id == "device_get")):
+                if not suppressed("PT001", node.lineno):
+                    findings.append((
+                        "PT001", node.lineno,
+                        "`jax.device_get` in library code — a "
+                        "device→host transfer; return the array and "
+                        "let the caller decide when to sync"))
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr == "block_until_ready"):
+                if not suppressed("PT002", node.lineno):
+                    findings.append((
+                        "PT002", node.lineno,
+                        "`.block_until_ready()` in library code — "
+                        "serializes the dispatch pipeline; only a "
+                        "timing harness or an explicit synchronize() "
+                        "API should do this (noqa with justification)"))
+            elif (isinstance(f, ast.Name) and f.id in ("float", "bool")
+                  and len(node.args) == 1 and not node.keywords
+                  and _jax_rooted(node.args[0])):
+                if not suppressed("PT003", node.lineno):
+                    findings.append((
+                        "PT003", node.lineno,
+                        f"`{f.id}()` coercion of a jax expression — a "
+                        "blocking host pull per call (the GradScaler-"
+                        "per-param bug class); keep the value device-"
+                        "side or sync once, fused"))
+
     for node in ast.walk(tree):
         # ---- == None / != None ----------------------------------
         if isinstance(node, ast.Compare):
@@ -243,6 +299,7 @@ def lint_tree(root: Path, subdirs=("paddle_tpu", "tools")
         for p in sorted(base.rglob("*.py")):
             if "__pycache__" in p.parts:
                 continue
-            for rule, line, msg in lint_file(p):
+            for rule, line, msg in lint_file(
+                    p, host_sync_scope=(sub == "paddle_tpu")):
                 out.append((str(p.relative_to(root)), rule, line, msg))
     return out

@@ -64,6 +64,113 @@ def iter_jaxpr_eqns(jaxpr, path: Tuple = ()) -> Iterator[Tuple[Tuple,
                 sub, path + ((eqn.primitive.name, label),))
 
 
+# ---------------------------------------------------------------------------
+# jaxpr rewriting support (analysis/rewrite.py builds on these)
+# ---------------------------------------------------------------------------
+
+def producer_map(jaxpr) -> Dict[Any, Tuple[int, Any]]:
+    """var -> (eqn_index, eqn) for every var DEFINED at this level of
+    ``jaxpr`` (sub-jaxpr internals excluded: a pattern is a same-level
+    dataflow chain; values crossing a control-flow boundary are inputs,
+    not intermediates)."""
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    out: Dict[Any, Tuple[int, Any]] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for o in eqn.outvars:
+            out[o] = (i, eqn)
+    return out
+
+
+def var_use_sites(jaxpr) -> Dict[Any, List[int]]:
+    """var -> list of eqn indices consuming it at this level; an
+    appearance in ``jaxpr.outvars`` adds the sentinel ``-1``. The
+    exclusivity test rewrites need: a matched intermediate whose uses
+    are not all inside the match cannot be deleted with it."""
+    if isinstance(jaxpr, jax_core.ClosedJaxpr):
+        jaxpr = jaxpr.jaxpr
+    uses: Dict[Any, List[int]] = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for a in eqn.invars:
+            if not isinstance(a, jax_core.Literal):
+                uses.setdefault(a, []).append(i)
+    for o in jaxpr.outvars:
+        if not isinstance(o, jax_core.Literal):
+            uses.setdefault(o, []).append(-1)
+    return uses
+
+
+def eval_eqn(eqn, invals: List[Any]):
+    """Re-issue one equation on concrete/traced values exactly as
+    ``jax.core.eval_jaxpr`` would (same primitive, same params).
+    Returns the flat list of outputs."""
+    subfuns, bind_params = eqn.primitive.get_bind_params(eqn.params)
+    ans = eqn.primitive.bind(*subfuns, *invals, **bind_params)
+    return list(ans) if eqn.primitive.multiple_results else [ans]
+
+
+def bind_rewritten(eqn, run_body, invals: List[Any]) -> List[Any]:
+    """Re-issue a jaxpr-carrying equation with every body evaluated by
+    ``run_body(closed_jaxpr, *flat_args) -> flat_outs`` — the hook a
+    rewriter uses to splice replacements into scan/while/cond/pjit
+    bodies while the surrounding control flow is rebuilt 1:1 (same trip
+    counts, same carry structure, so numerics outside the rewritten
+    subgraphs are untouched). Raises ``NotImplementedError`` for
+    jaxpr-carrying primitives without a rebuild recipe (custom_vjp
+    bodies, shard_map, ...): the caller falls back to binding the eqn
+    unchanged, i.e. those bodies are opaque to rewriting."""
+    import jax
+    from jax import lax
+    prim = eqn.primitive.name
+    p = eqn.params
+    if prim == "scan":
+        nc, ncar = p["num_consts"], p["num_carry"]
+        body = p["jaxpr"]
+        consts = tuple(invals[:nc])
+        carry = tuple(invals[nc:nc + ncar])
+        xs = tuple(invals[nc + ncar:])
+
+        def f(c, x):
+            outs = run_body(body, *consts, *c, *(x or ()))
+            return tuple(outs[:ncar]), tuple(outs[ncar:])
+
+        carry_out, ys = lax.scan(
+            f, carry, xs if xs else None, length=p["length"],
+            reverse=p["reverse"], unroll=p.get("unroll", 1))
+        return list(carry_out) + list(ys)
+    if prim in ("pjit", "closed_call", "core_call"):
+        # inline: the rewritten whole-program is re-jitted by its
+        # caller anyway, so the inner jit boundary carries no value
+        return list(run_body(p["jaxpr"], *invals))
+    if prim == "cond":
+        branches = p["branches"]
+        idx, *ops = invals
+        fns = [(lambda b: lambda *a: tuple(run_body(b, *a)))(b)
+               for b in branches]
+        out = lax.switch(idx, fns, *ops)
+        return list(out)
+    if prim == "while":
+        cn, bn = p["cond_nconsts"], p["body_nconsts"]
+        cconsts = tuple(invals[:cn])
+        bconsts = tuple(invals[cn:cn + bn])
+        init = tuple(invals[cn + bn:])
+        out = lax.while_loop(
+            lambda c: run_body(p["cond_jaxpr"], *cconsts, *c)[0],
+            lambda c: tuple(run_body(p["body_jaxpr"], *bconsts, *c)),
+            init)
+        return list(out)
+    if prim in ("remat2", "checkpoint"):
+        body = p["jaxpr"]
+        closed = (body if isinstance(body, jax_core.ClosedJaxpr)
+                  else jax_core.ClosedJaxpr(body, ()))
+        fn = jax.checkpoint(lambda *a: tuple(run_body(closed, *a)),
+                            policy=p.get("policy"),
+                            prevent_cse=p.get("prevent_cse", True))
+        return list(fn(*invals))
+    raise NotImplementedError(
+        f"no rebuild recipe for jaxpr-carrying primitive {prim!r}")
+
+
 @dataclass
 class TraceResult:
     #: ordered top-level events:

@@ -42,7 +42,7 @@ def synchronize(device_id=None) -> None:
     """Block until pending device work finishes (paddle.device.synchronize).
     XLA's async dispatch drains via a tiny blocking transfer."""
     import jax.numpy as jnp
-    jnp.zeros(()).block_until_ready()
+    jnp.zeros(()).block_until_ready()  # noqa: PT002 — this IS the synchronize() API
 
 
 class cuda:

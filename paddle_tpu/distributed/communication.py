@@ -188,7 +188,7 @@ def wait(tensor, group: Optional[Group] = None, use_calc_stream: bool = True):
     """Stream-sync point (reference communication/wait.py). XLA has no
     user-visible streams; blocking on the value is the sync."""
     t = _as_tensor(tensor)
-    t._data.block_until_ready()
+    t._data.block_until_ready()  # noqa: PT002 — wait() IS the sync point
     return t
 
 
@@ -294,7 +294,7 @@ class _Work:
 
     def wait(self):
         if self._result is not None:
-            self._result._data.block_until_ready()
+            self._result._data.block_until_ready()  # noqa: PT002 — wait() semantics
         return True
 
     def is_completed(self):

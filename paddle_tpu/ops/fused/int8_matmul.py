@@ -31,11 +31,21 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["Int8Weight", "quantize_weight_per_channel",
-           "int8_weight_matmul"]
+           "int8_weight_matmul", "fused_impl"]
 
 
 def _default_impl() -> str:
     return os.environ.get("PADDLE_TPU_INT8_IMPL", "auto")
+
+
+def fused_impl() -> str:
+    """The FUSED implementation the current environment selects:
+    ``"pallas"`` when ``PADDLE_TPU_INT8_IMPL=pallas``, else ``"jnp"``.
+    The int8-epilogue rewrite pass (analysis/rewrite.py) resolves its
+    replacement through this so a rewrite can never route back to the
+    ``"unfused"`` baseline it is replacing (which would make the
+    rewriter non-idempotent)."""
+    return "pallas" if _default_impl() == "pallas" else "jnp"
 
 
 def quantize_weight_per_channel(w):
@@ -56,11 +66,20 @@ def quantize_weight_per_channel(w):
 def int8_weight_matmul(x, q, scale, impl: str = "auto"):
     """``x [..., in] @ dequant(q [in, out], scale [out]) -> [..., out]``
     in ``x.dtype``. ``impl``: "auto"/"jnp" (XLA fuses the dequant into
-    the matmul operand) or "pallas" (authored kernel; interpret mode
-    off-TPU)."""
-    if impl == "pallas" or (impl == "auto" and _default_impl() == "pallas"):
+    the matmul operand), "pallas" (authored kernel; interpret mode
+    off-TPU), or "unfused" — dequantize the FULL dense weight first and
+    matmul against it. The unfused form is the naive idiom the
+    int8-epilogue rewrite pass exists to eliminate (and the baseline of
+    the decode_profile rewrite A/B): it materialises the O(in*out)
+    dequant product the fused forms never pay for."""
+    resolved = _default_impl() if impl == "auto" else impl
+    if resolved == "pallas":
         from ..pallas.int8_matmul import int8_matmul_pallas
         return int8_matmul_pallas(x, q, scale)
+    if resolved == "unfused":
+        w = (q.astype(jnp.float32)
+             * scale[..., None, :]).astype(x.dtype)
+        return jnp.matmul(x, w)
     out = jnp.matmul(x, q.astype(x.dtype)) * scale.astype(jnp.float32)
     return out.astype(x.dtype)
 

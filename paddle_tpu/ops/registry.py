@@ -297,7 +297,7 @@ def _check_nan_inf(name, out):
     for arr in jax.tree_util.tree_leaves(out):
         if jnp.issubdtype(arr.dtype, jnp.floating) and not isinstance(
                 arr, jax.core.Tracer):
-            if not bool(jnp.isfinite(arr).all()):
+            if not bool(jnp.isfinite(arr).all()):  # noqa: PT003 — opt-in debug flag, sync is the feature
                 raise FloatingPointError(
                     f"NaN/Inf detected in output of op '{name}' "
                     "(FLAGS_check_nan_inf is on)")

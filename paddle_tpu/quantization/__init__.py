@@ -48,7 +48,9 @@ class FakeQuanterWithAbsMaxObserver(BaseQuanter):
 
     def forward(self, x):
         data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-        cur = float(jnp.maximum(jnp.max(jnp.abs(data)), 1e-8))
+        # the EMA scale stays a DEVICE scalar: a float() coercion here
+        # would host-sync every training forward (source_lint PT003)
+        cur = jnp.maximum(jnp.max(jnp.abs(data)), 1e-8)
         if self.training:
             if self._scale is None:
                 self._scale = cur
@@ -70,11 +72,14 @@ class AbsmaxObserver(BaseQuanter):
     def __init__(self, quant_bits: int = 8):
         super().__init__()
         self.quant_bits = quant_bits
-        self._scale = 0.0
+        self._scale = jnp.float32(0.0)
 
     def forward(self, x):
         data = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-        self._scale = max(self._scale, float(jnp.max(jnp.abs(data))))
+        # running max stays device-side (like ChannelWiseAbsmaxObserver)
+        # — no per-observation host sync
+        self._scale = jnp.maximum(self._scale,
+                                  jnp.max(jnp.abs(data)).astype(jnp.float32))
         return x
 
 

@@ -85,32 +85,44 @@ _JIT_CACHE: "OrderedDict" = OrderedDict()
 _JIT_CACHE_MAX = 8
 
 
-def _jit_step_fns(mod, cfg, attn_impl: str):
+def _jit_step_fns(mod, cfg, attn_impl: str, rewrites: bool = False):
     """Shared jitted prefill/decode per (model, config, impl): several
     engines over one config (tests, blue/green restarts) reuse the same
-    jit objects, so XLA's executable cache carries across instances."""
+    jit objects, so XLA's executable cache carries across instances.
+
+    ``rewrites=True`` routes every step function through the analysis
+    subsystem's verified rewrite passes (analysis/rewrite.py) before
+    jit: each jit trace pattern-matches the step's jaxpr and substitutes
+    the registered fused kernels (compile-time cost only; the exactness
+    pin in tests/test_rewrite.py proves greedy outputs stay
+    byte-identical to the unrewritten engine)."""
     import jax
-    key = (mod.__name__, id(cfg), attn_impl)
+    key = (mod.__name__, id(cfg), attn_impl, bool(rewrites))
     hit = _JIT_CACHE.get(key)
     if hit is not None and hit[0] is cfg:  # id() safe: cfg ref held
         _JIT_CACHE.move_to_end(key)
         return hit[1:]
+    if rewrites:
+        from ..analysis.rewrite import rewrite_callable as _rw
+    else:
+        def _rw(fn):
+            return fn
     # donate the pool arrays (args 4/5 of every step fn): the engine
     # rebinds the returned pools immediately, and without donation every
     # tick pays a full pool copy — measured 2-3x the whole step time on
     # the CPU mesh at bench shapes
-    pre = jax.jit(partial(mod.serving_prefill, cfg=cfg,
-                          attn_impl=attn_impl), donate_argnums=(4, 5))
-    dec = jax.jit(partial(mod.serving_decode_step, cfg=cfg,
-                          attn_impl=attn_impl), donate_argnums=(4, 5))
-    blk = jax.jit(partial(mod.serving_decode_block, cfg=cfg,
-                          attn_impl=attn_impl), donate_argnums=(4, 5),
+    pre = jax.jit(_rw(partial(mod.serving_prefill, cfg=cfg,
+                              attn_impl=attn_impl)), donate_argnums=(4, 5))
+    dec = jax.jit(_rw(partial(mod.serving_decode_step, cfg=cfg,
+                              attn_impl=attn_impl)), donate_argnums=(4, 5))
+    blk = jax.jit(_rw(partial(mod.serving_decode_block, cfg=cfg,
+                              attn_impl=attn_impl)), donate_argnums=(4, 5),
                   static_argnames=("num_steps",))
     # prefix_pages is STATIC: the gathered-prefix width is a shape (one
     # compile per distinct already-written page count — page-aligned
     # chunk boundaries keep the value set small)
-    chk = jax.jit(partial(mod.serving_prefill_chunk, cfg=cfg,
-                          attn_impl=attn_impl), donate_argnums=(4, 5),
+    chk = jax.jit(_rw(partial(mod.serving_prefill_chunk, cfg=cfg,
+                              attn_impl=attn_impl)), donate_argnums=(4, 5),
                   static_argnames=("prefix_pages",))
     _JIT_CACHE[key] = (cfg, pre, dec, blk, chk)
     if len(_JIT_CACHE) > _JIT_CACHE_MAX:
@@ -175,6 +187,11 @@ class ServingEngine:
     ``PADDLE_TPU_SERVING_CHECK_INVARIANTS`` env var (the test suite
     turns it on); cost is host-side only (<10% of a CPU-mesh tick,
     measured in docs/ANALYSIS.md).
+    rewrites: True routes every step function through the verified
+    jaxpr rewrite passes (analysis/rewrite.py — fused-kernel
+    substitution at jit-trace time, compile-time cost only). Greedy
+    outputs remain byte-identical to the unrewritten engine
+    (tests/test_rewrite.py exactness pin).
     """
 
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
@@ -188,7 +205,8 @@ class ServingEngine:
                  prefix_cache: bool = True,
                  prefill_chunk: Optional[int] = None,
                  admission_window: int = 0,
-                 check_invariants: Optional[bool] = None):
+                 check_invariants: Optional[bool] = None,
+                 rewrites: bool = False):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if prefill_chunk is not None:
@@ -290,7 +308,8 @@ class ServingEngine:
         import jax
         self._jnp = jax.numpy
         (self._prefill_jit, self._decode_jit, self._block_jit,
-         self._chunk_jit) = _jit_step_fns(self._mod, cfg, attn_impl)
+         self._chunk_jit) = _jit_step_fns(self._mod, cfg, attn_impl,
+                                          rewrites=rewrites)
         self._jax = jax
         # requests parked mid chunked-prefill, FIFO: one chunk advances
         # per tick so in-flight decode streams keep a bounded stall

@@ -153,10 +153,11 @@ def test_schedule_efficiency_measured_from_traced_program():
             state = init(jax.random.PRNGKey(0))
             batch = L.make_batch(cfg, batch_size=8, seq_len=16,
                                  mesh=hm.mesh)
+            from paddle_tpu.analysis.hbm import xla_cost_analysis
             jaxpr = jax.make_jaxpr(step.__wrapped__)(state, batch)
-            flops = float(jax.jit(
+            flops = float(xla_cost_analysis(jax.jit(
                 step.__wrapped__, donate_argnums=(0,)).lower(
-                state, batch).compile().cost_analysis()["flops"])
+                state, batch).compile())["flops"])
         return jaxpr, flops
 
     S = 2

@@ -19,6 +19,12 @@ runs, in seconds and with zero XLA compiles:
     collective-consistency trip counts) over the llama auto-parallel
     train step at the dp / dp×mp / pp-1F1B / zero1 geometries plus the
     1F1B stage-chunk group (analysis/training_graphs.py);
+  * the REWRITE suite (analysis/rewrite.py): every registered rewrite
+    pass applied to its flagship targets — the jnp-rmsnorm serving
+    graphs and the unfused-int8 decode step — with each expected
+    rewrite required to fire, the rewriter required to be idempotent,
+    and every fired site verified against its exactness contract
+    (bitwise / pinned tolerance) on concrete seeded inputs;
   * (--ci) the AST source lint over paddle_tpu/ + tools/
     (analysis/source_lint.py), plus `ruff check` when the binary is
     installed (the container image does not ship it; the AST subset
@@ -49,16 +55,18 @@ def run_graph_passes(models, limit, suite="all"):
     from paddle_tpu.analysis import (pp_stage_targets, run_passes,
                                      serving_targets, training_targets)
     targets = []
+    serving_pool = []
     if suite in ("all", "serving"):
         for m in models:
-            targets += serving_targets(m)
+            serving_pool += serving_targets(m)
+        targets += serving_pool
         targets += pp_stage_targets()
     if suite in ("all", "training"):
         targets += training_targets()
     passes = build_passes(limit)
     report = run_passes(passes, targets)
     hbm = next((p for p in passes if p.name == "hbm-peak"), None)
-    return report, (hbm.reports if hbm is not None else {})
+    return report, (hbm.reports if hbm is not None else {}), serving_pool
 
 
 def run_ruff(root):
@@ -78,7 +86,8 @@ def main(argv=None):
                     help="flagship models to lint (serving suite)")
     ap.add_argument("--limit", type=int, default=16,
                     help="recompile-hazard programs-per-bucket bound")
-    ap.add_argument("--suite", choices=["all", "serving", "training"],
+    ap.add_argument("--suite",
+                    choices=["all", "serving", "training", "rewrite"],
                     default="all")
     ap.add_argument("--ci", action="store_true",
                     help="also run the source lint (+ruff if installed)"
@@ -95,9 +104,23 @@ def main(argv=None):
     force_host_cpu_devices(8)
 
     t0 = time.time()
-    report, hbm = run_graph_passes(args.models, args.limit, args.suite)
+    report, hbm, serving_pool = run_graph_passes(
+        args.models, args.limit, args.suite)
+    rw_table = None
+    if args.suite in ("all", "rewrite"):
+        from paddle_tpu.analysis.rewrite import run_rewrite_suite
+        # reuse the lint suite's already-traced serving targets (same
+        # geometry) so --suite all traces each flagship program once
+        rw_findings, rw_table = run_rewrite_suite(
+            models=args.models,
+            serving_pool=serving_pool or None)
+        report.findings.extend(rw_findings)
+        report.ran.extend(
+            ("rewrite-suite", row["graph"]) for row in rw_table)
     ok = report.ok
     out = {"graph": report.to_dict()}
+    if rw_table is not None:
+        out["rewrite"] = rw_table
     out["hbm"] = [
         {"graph": name, "peak_bytes": est.peak_bytes,
          "input_bytes": est.args_bytes,

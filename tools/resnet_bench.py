@@ -104,7 +104,8 @@ def main():
     dt = (t[n1] - t[n0]) / (n1 - n0)
 
     try:
-        flops = float(comp.cost_analysis()["flops"])
+        from paddle_tpu.analysis.hbm import xla_cost_analysis
+        flops = float(xla_cost_analysis(comp)["flops"])
         source = "xla_cost_analysis"
     except Exception:
         flops = 3 * 2 * 4.089e9 * B
