@@ -37,7 +37,8 @@ from .kv_invariants import (KVInvariantError, Violation,
                             audit_defrag_plan, audit_engine,
                             audit_serving_state)
 from .recompile import (RecompileHazardPass, ServingGeometry,
-                        enumerate_chunk_programs)
+                        enumerate_chunk_programs,
+                        enumerate_tick_programs)
 from .rewrite import (FusedRmsNormPass, Int8EpilogueFusePass,
                       RewriteResult, VerifyOutcome, count_matches,
                       rewrite_callable, rewrite_jaxpr, rewrite_target,
@@ -63,7 +64,8 @@ __all__ = [
     "audit_serving_state", "check_stage_consistency",
     "collective_signature", "count_matches", "default_passes",
     "default_rewrites", "engine_geometry", "enumerate_chunk_programs",
-    "estimate_hbm_peak", "flagship_train_objects",
+    "enumerate_tick_programs", "estimate_hbm_peak",
+    "flagship_train_objects",
     "jit_donation_flags", "pp_stage_targets", "register_pass",
     "register_rewrite", "rewrite_callable", "rewrite_jaxpr",
     "rewrite_target", "rewrite_targets", "run_passes",

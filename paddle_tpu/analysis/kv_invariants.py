@@ -62,13 +62,20 @@ class Violation:
 
 
 class KVInvariantError(AssertionError):
-    """Raised by ``assert_ok`` paths; carries the full violation list."""
+    """Raised by ``assert_ok`` paths; carries the full violation list.
+    ``context`` (optional) names the engine state the audit ran
+    against — e.g. the serving geometry — so a violation report from a
+    dead engine is actionable without reproducing the run."""
 
-    def __init__(self, violations: List[Violation]):
+    def __init__(self, violations: List[Violation],
+                 context: str = ""):
         self.violations = violations
-        super().__init__(
-            "paged-KV invariant violation(s):\n  " +
-            "\n  ".join(str(v) for v in violations))
+        self.context = context
+        msg = ("paged-KV invariant violation(s):\n  " +
+               "\n  ".join(str(v) for v in violations))
+        if context:
+            msg += f"\n  [{context}]"
+        super().__init__(msg)
 
 
 def _row_list(row) -> List[int]:

@@ -1,32 +1,32 @@
 """Recompile-hazard pass: statically enumerate the program set a
 serving call site can produce.
 
-``serving_prefill_chunk`` takes ``prefix_pages`` as a STATIC argument
-— the gathered-prefix width is a shape — so every distinct value XLA
-sees is one more compile, and compiles land *inside the serving tick*
-(a multi-second stall per novel prefix length, the compile-storm
-failure mode the r8 attach quantum exists to prevent). Whether the
-quantum actually bounds the set is a function of pure host-side
-geometry: page size, slot budget, prompt buckets, attach quantum and
-chunk size. This pass enumerates the reachable set exactly and proves
-(or refutes) the ≤``limit``-programs-per-bucket invariant *before* any
-traffic runs.
+Two reachability models live here, matching the two engine designs:
 
-Reachability model (mirrors ``ServingEngine`` dispatch exactly):
+**Ragged one-program tick (r12+, ``geom.ragged``).** The engine's only
+step functions are ``serving_tick`` (decode tokens + prompt spans as
+one program; geometry rides in device arrays) and
+``serving_tick_block`` (the fused greedy decode block). The compiled-
+program key is the packed token width, and the reachable set is fixed
+by construction: width ``S + budget`` runs exactly one program (the
+mixed tick), width ``S`` at most two (the single-step sampling tick
+and the fused block). ``enumerate_tick_programs`` enumerates that set
+so the invariant — ≤ 2 programs per width bucket — is *proven* from
+engine dispatch, not asserted, and any future dispatch change that
+silently multiplies the set fails the pass (and warns at engine
+construction) before traffic does.
 
-* the engine calls the chunk program with width ``tb`` = the prefill
-  chunk (when chunking is on) or the suffix bucket (prefix-hit path),
-  and ``prefix_pages`` = (attached cached pages) + (chunks already
-  written) · (chunk pages);
-* attached pages are multiples of ``attach_quantum`` capped by the
-  match cap ``floor((n-1)/ps)`` (one suffix token always remains);
-* chunk starts are page-aligned multiples of the chunk size past the
-  attach point; every start must leave ≥ 1 prompt token.
-
-The compiled-program key is ``(tb, prefix_pages)``; the invariant is
-``|{prefix_pages}| ≤ limit`` per width bucket. Prefill/decode program
-counts (one per prompt bucket, one decode shape) are reported as INFO
-so the CLI shows the whole compile inventory.
+**Legacy bucketed dispatch (``ragged=False``).** The pre-r12
+``serving_prefill_chunk`` took ``prefix_pages`` as a STATIC argument —
+the gathered-prefix width was a shape — so every distinct value XLA
+saw was one more compile landing *inside the serving tick* (a multi-
+second stall per novel prefix length). ``enumerate_chunk_programs``
+walks that dispatch exactly (attach quanta on the chunk grid, page-
+aligned chunk starts, ≥ 1 suffix token) and proves or refutes the
+≤ ``limit``-programs-per-bucket invariant. It is retained both as the
+model for the still-exported bucketed step fns (offline callers,
+benches A/B-ing against the old path) and as the regression oracle the
+tests seed hazards through.
 """
 from __future__ import annotations
 
@@ -37,7 +37,7 @@ from .framework import (Finding, GraphTarget, LintPass, Severity,
                         register_pass)
 
 __all__ = ["ServingGeometry", "enumerate_chunk_programs",
-           "RecompileHazardPass"]
+           "enumerate_tick_programs", "RecompileHazardPass"]
 
 
 @dataclass
@@ -48,6 +48,11 @@ class ServingGeometry:
     buckets: List[int]          # prompt-length buckets (sorted)
     attach_quantum: int = 1     # 0/None = prefix cache off
     prefill_chunk: Optional[int] = None
+    # ragged one-program-tick engine (r12+): program widths are
+    # S / S+budget and the set below is reachable
+    ragged: bool = False
+    max_batch: int = 0
+    decode_block: int = 1
 
     @staticmethod
     def of_engine(engine) -> "ServingGeometry":
@@ -58,7 +63,10 @@ class ServingGeometry:
             buckets=list(engine._buckets),
             attach_quantum=(engine.prefix_cache.attach_quantum
                             if engine.prefix_cache is not None else 0),
-            prefill_chunk=engine._chunk)
+            prefill_chunk=engine._chunk,
+            ragged=True,
+            max_batch=engine.scheduler.max_batch,
+            decode_block=engine._decode_block)
 
 
 def _bucket(n: int, buckets) -> int:
@@ -68,11 +76,53 @@ def _bucket(n: int, buckets) -> int:
     return buckets[-1]
 
 
+def tick_budget(geom: ServingGeometry) -> int:
+    """The ragged engine's per-tick prefill token budget: the
+    prefill_chunk when set, else a whole max-length suffix (the same
+    arithmetic as ``ServingEngine.__init__``)."""
+    return (int(geom.prefill_chunk) if geom.prefill_chunk is not None
+            else int(geom.buckets[-1]))
+
+
+def enumerate_tick_programs(geom: ServingGeometry) -> Dict[int,
+                                                           Set[str]]:
+    """Exact reachable ``{packed_width: {program}}`` under the ragged
+    engine's dispatch (``ServingEngine._decode_tick``):
+
+    * ticks with pending prefill spans run ``serving_tick`` at packed
+      width ``max_batch + w`` where ``w`` is the smallest entry of the
+      width grid (prompt buckets capped at the budget, plus the budget
+      itself) covering the tick's span tokens — span count, span
+      offsets, prefix size and cache lengths are all device data.
+      Each width compiles with the fused greedy decode tail
+      (``decode_tail = decode_block-1``) when nobody samples, without
+      it otherwise: at most two compiles per width;
+    * pure-decode ticks run the fused greedy ``serving_tick_block`` at
+      width ``max_batch``, or — when a live request samples — the
+      single-step ``serving_tick`` at the same width.
+
+    Nothing else is reachable, whatever the traffic: the bound is
+    1-2 programs per width bucket by construction.
+    """
+    S = int(geom.max_batch)
+    k = int(geom.decode_block)
+    budget = tick_budget(geom)
+    grid = sorted({min(int(b), budget) for b in geom.buckets}
+                  | {budget})
+    mixed: Set[str] = {f"serving_tick[mixed,tail={k - 1}]"}
+    if k > 1:
+        mixed.add("serving_tick[mixed,tail=0]")     # sampling ticks
+    out: Dict[int, Set[str]] = {S + w: set(mixed) for w in grid}
+    out[S] = {"serving_tick[decode]", f"serving_tick_block[k={k}]"}
+    return out
+
+
 def enumerate_chunk_programs(geom: ServingGeometry) -> Dict[int,
                                                             Set[int]]:
     """Exact reachable ``{chunk_width: {prefix_pages}}`` under the
-    engine's dispatch rules. Empty when no code path can ever call the
-    chunk program (no cache and no chunking)."""
+    LEGACY bucketed dispatch rules (see module docstring). Empty when
+    no code path can ever call the chunk program (no cache and no
+    chunking)."""
     ps = geom.page_size
     q = geom.attach_quantum
     chunk = geom.prefill_chunk
@@ -115,29 +165,57 @@ def enumerate_chunk_programs(geom: ServingGeometry) -> Dict[int,
 class RecompileHazardPass(LintPass):
     """Runs on targets whose ``meta['geometry']`` is a
     :class:`ServingGeometry` (the CLI attaches the flagship engines');
-    jaxpr-free — the hazard is host-side dispatch, not graph content."""
+    jaxpr-free — the hazard is host-side dispatch, not graph content.
+
+    Ragged geometries are held to ``ragged_limit`` (the one-program-
+    tick invariant: ≤ 2 per width bucket); legacy bucketed geometries
+    to ``limit`` (≤ 16 static prefix_pages per chunk width)."""
 
     name = "recompile-hazard"
 
-    def __init__(self, limit: int = 16):
+    def __init__(self, limit: int = 16, ragged_limit: int = 2):
         self.limit = int(limit)
+        self.ragged_limit = int(ragged_limit)
+
+    def _run_ragged(self, target, geom) -> List[Finding]:
+        findings: List[Finding] = []
+        programs = enumerate_tick_programs(geom)
+        for width in sorted(programs):
+            progs = programs[width]
+            if len(progs) > self.ragged_limit:
+                findings.append(self.finding(
+                    target,
+                    f"tick width {width} reaches {len(progs)} distinct "
+                    f"programs ({sorted(progs)}) > limit "
+                    f"{self.ragged_limit}: each is an XLA compile "
+                    f"inside the serving tick — the one-program-tick "
+                    f"dispatch regressed"))
+        worst = max((len(v) for v in programs.values()), default=0)
+        inventory = {w: sorted(v) for w, v in sorted(programs.items())}
+        findings.append(self.finding(
+            target,
+            f"program inventory (ragged tick): {inventory} — proven "
+            f"bound {worst} programs/bucket (limit {self.ragged_limit})",
+            severity=Severity.INFO))
+        return findings
 
     def run(self, target: GraphTarget) -> List[Finding]:
         geom = target.meta.get("geometry")
         if geom is None:
             return []
+        if geom.ragged:
+            return self._run_ragged(target, geom)
         findings: List[Finding] = []
         programs = enumerate_chunk_programs(geom)
         total = sum(len(v) for v in programs.values())
         for width in sorted(programs):
             vals = programs[width]
             if len(vals) > self.limit:
-                lo, hi = min(vals), max(vals)
                 findings.append(self.finding(
                     target,
                     f"chunk-prefill width {width} reaches "
                     f"{len(vals)} distinct static prefix_pages values "
-                    f"(range {lo}..{hi}) > limit {self.limit}: each is "
+                    f"({sorted(vals)}) > limit {self.limit}: each is "
                     f"one XLA compile inside the serving tick — raise "
                     f"attach_quantum/prefill_chunk or shrink the "
                     f"prompt budget"))

@@ -18,7 +18,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional
 
 from .framework import GraphTarget, trace_graph
-from .recompile import ServingGeometry, enumerate_chunk_programs
+from .recompile import ServingGeometry
 
 __all__ = ["engine_geometry", "serving_targets", "pp_stage_targets",
            "rewrite_targets", "FLAGSHIP_MODELS"]
@@ -30,26 +30,28 @@ def engine_geometry(*, page_size: int, max_prompt_len: int,
                     max_new_tokens_cap: int,
                     prefill_chunk: Optional[int] = None,
                     prompt_buckets=None,
-                    prefix_cache: bool = True) -> ServingGeometry:
+                    prefix_cache: bool = True,
+                    max_batch: int = 8,
+                    decode_block: int = 1) -> ServingGeometry:
     """The ``ServingGeometry`` a ``ServingEngine(**same_kwargs)`` would
     run — the same arithmetic as the engine ctor, computable without
     building pools or starting workers (tests pin the two against each
-    other so this cannot drift)."""
+    other so this cannot drift). The r12 engine is RAGGED: prefix
+    attach is exact (quantum 1 — attach size is device data, not a
+    compile shape) and the program set is keyed by packed token width
+    (``enumerate_tick_programs``)."""
     from ..serving.engine import _default_buckets
     buckets = sorted(set(int(b) for b in (
         prompt_buckets or _default_buckets(max_prompt_len))))
     pages_per_slot = -(-(buckets[-1] + max_new_tokens_cap - 1)
                        // page_size)
-    quantum = max(1, -(-pages_per_slot // 16))
-    if prefill_chunk is not None:
-        # chunk ticks advance prefix_pages on the chunk grid, so the
-        # attach grid IS the chunk grid (see ServingEngine.__init__)
-        quantum = prefill_chunk // page_size
     return ServingGeometry(
         page_size=page_size, pages_per_slot=pages_per_slot,
         buckets=buckets,
-        attach_quantum=quantum if prefix_cache else 0,
-        prefill_chunk=prefill_chunk)
+        attach_quantum=1 if prefix_cache else 0,
+        prefill_chunk=prefill_chunk,
+        ragged=True, max_batch=int(max_batch),
+        decode_block=int(decode_block))
 
 
 def _get_model(name: str):
@@ -71,11 +73,12 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
                     max_new_tokens_cap: int = 16,
                     prefill_chunk: int = 8,
                     decode_block: int = 4) -> List[GraphTarget]:
-    """GraphTargets for one model's flagship serving programs:
-    ``serving_prefill_chunk`` (cold + max-prefix variants),
-    ``serving_decode_block`` (the fused greedy tick) and
-    ``generate_paged`` (the offline batched decode), plus a jaxpr-free
-    geometry target for the recompile-hazard pass."""
+    """GraphTargets for one model's flagship serving programs — the
+    r12 one-program-tick set: ``serving_tick`` at both reachable packed
+    widths (mixed prefill+decode and decode-only/sampling),
+    ``serving_tick_block`` (the fused greedy path) and
+    ``generate_paged`` (the offline batched decode), plus the engine
+    geometry riding the block target for the recompile-hazard pass."""
     import jax
     import jax.numpy as jnp
 
@@ -83,7 +86,8 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
     geom = engine_geometry(
         page_size=page_size, max_prompt_len=max_prompt_len,
         max_new_tokens_cap=max_new_tokens_cap,
-        prefill_chunk=prefill_chunk)
+        prefill_chunk=prefill_chunk, max_batch=slots,
+        decode_block=decode_block)
     pps = geom.pages_per_slot
     total_pages = slots * pps + 1
     meta: Dict[str, Any] = {}
@@ -105,23 +109,36 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
 
     targets: List[GraphTarget] = []
 
-    # --- chunk prefill: the two extreme static prefix_pages values ---
-    max_pp = max((max(v) for v in
-                  enumerate_chunk_programs(geom).values()), default=0)
-    for pp in sorted({0, max_pp}):
+    def tick_meta(T):
+        return {"tok_slot": sds((T,), i32), "tok_pos": sds((T,), i32),
+                "tok_page": sds((T,), i32), "tok_off": sds((T,), i32),
+                "tok_qoff": sds((T,), i32), "q_len": sds((slots,), i32),
+                "kv_len": sds((slots,), i32), "last": sds((slots,), i32),
+                "tables": sds((slots, pps), i32)}
+
+    # --- the ragged tick at both reachable widths ---------------------
+    # widths mirror enumerate_tick_programs: S+budget (mixed ticks) and
+    # S (decode-only sampling ticks). The mixed tick carries prefill,
+    # which legitimately returns one [S, V] logits row set per prompt
+    # completion — in_decode_loop stays False so the host-pull budget
+    # (whose hot-path guard is the block program below) does not charge
+    # it per step; the engine's greedy path pulls only the [S] argmax.
+    from .recompile import tick_budget
+    budget = tick_budget(geom)
+    for tag, T, tq in (("mixed", slots + budget, budget),
+                       ("decode", slots, 1)):
         targets.append(trace_graph(
-            f"{model}.serving_prefill_chunk[prefix_pages={pp}]",
-            mod.serving_prefill_chunk,
-            (params, sds((1, prefill_chunk), i32), sds((), i32),
-             sds((pps,), i32), kp, vp),
-            static_kwargs=dict(cfg=cfg, prefix_pages=pp,
-                               attn_impl="dense"),
-            compute_dtype=cfg.dtype, slots=1, meta=dict(meta)))
+            f"{model}.serving_tick[{tag}]",
+            mod.serving_tick,
+            (params, sds((T,), i32), tick_meta(T), kp, vp),
+            static_kwargs=dict(cfg=cfg, tq=tq, attn_impl="dense"),
+            compute_dtype=cfg.dtype, slots=slots,
+            donated_outputs=(2, 3), meta=dict(meta)))
 
     # --- fused greedy decode block: the per-tick hot program ---------
     targets.append(trace_graph(
-        f"{model}.serving_decode_block[k={decode_block}]",
-        mod.serving_decode_block,
+        f"{model}.serving_tick_block[k={decode_block}]",
+        mod.serving_tick_block,
         (params, sds((slots,), i32), sds((slots,), i32),
          sds((slots, pps), i32), kp, vp),
         static_kwargs=dict(cfg=cfg, num_steps=decode_block,
@@ -185,8 +202,8 @@ def rewrite_targets(models=("llama",), *, slots: int = 4,
         for t in pool:
             if not t.name.startswith(m + "."):
                 continue
-            if ("serving_decode_block" in t.name
-                    or "prefix_pages=0" in t.name):
+            if ("serving_tick_block" in t.name
+                    or "serving_tick[mixed]" in t.name):
                 t.meta["expect_rewrites"] = ("fused-rmsnorm",)
                 targets.append(t)
 

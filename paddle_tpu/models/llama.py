@@ -1249,6 +1249,177 @@ def serving_decode_block(params, tok, lengths, tables, k_pages, v_pages,
     return jnp.moveaxis(toks, 0, 1), kp_new, vp_new
 
 
+def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
+                 decode_tail: int = 0, attn_impl: str = "auto",
+                 _block_fn=None):
+    """ONE ragged serving tick: any mix of chunked prefills, warm-prefix
+    attaches and decode steps as a single static program.
+
+    The pre-r12 engine dispatched separate geometry-bucketed programs
+    (``serving_prefill`` per prompt bucket, ``serving_prefill_chunk``
+    per static prefix_pages value, ``serving_decode_step``); this one
+    step fn replaces all of them — sequence geometry rides in ``meta``
+    as DEVICE ARRAYS, so XLA compiles exactly one program per packed
+    width and the engine's compile-geometry quantization (chunk grids,
+    attach quanta) is gone at the root.
+
+    tokens ``[T]`` i32 — the tick's packed token stream: each live
+    slot's current decode token and/or a span of some prompt's next
+    uncached tokens, concatenated (padding tokens allowed anywhere).
+    meta — a dict of device arrays describing the packing:
+
+    * ``tok_slot [T]``: owning slot of each packed token (``S`` = a
+      padding token that must touch nothing real);
+    * ``tok_pos [T]``: the token's absolute sequence position;
+    * ``tok_page [T]`` / ``tok_off [T]``: the page id and in-page
+      offset its KV lands at (TRASH page for padding);
+    * ``tok_qoff [T]``: offset of the token inside its slot's span;
+    * ``q_len [S]``: span length per slot (0 = slot idle this tick);
+    * ``kv_len [S]``: keys visible at the END of the span (context +
+      the span itself);
+    * ``last [T-indexed scalar per slot] [S]``: packed index of each
+      slot's LAST span token — its hidden state feeds that slot's
+      logits row (idle slots may point anywhere; their row is junk the
+      host discards);
+    * ``tables [S, pps]``: the page-table rows.
+
+    ``tq`` (STATIC — one compile per value; the engine uses exactly
+    two: the prefill budget and 1) is the maximum span length, sizing
+    the kernel's slot-major query layout.
+
+    ``decode_tail`` (STATIC) fuses that many extra GREEDY decode steps
+    after the ragged pass — the multi-step scheduling lever that keeps
+    an admission tick producing a full decode block for in-flight
+    streams (the seed engine got this by running prefill + the fused
+    block as two programs; here the tail rides in the SAME program).
+    ``meta['tail_live'] [S]`` bool gates it: only tail-live slots
+    (decoding slots, plus spans that complete their prompt this tick)
+    advance — mid-prefill slots stay dead through the tail (q_len 0,
+    KV writes to the trash page).
+
+    Returns ``(toks, logits [S, V] f32, k_pages', v_pages')``:
+    ``toks`` is the in-graph greedy argmax of each slot's last-position
+    logits — ``[S]`` i32 when ``decode_tail == 0``, else
+    ``[S, 1+decode_tail]`` (the host pulls only these ints on greedy
+    ticks); ``logits`` is the RAGGED pass's (first step's) logits and
+    stays on device unless a sampling request actually fetches its row
+    (sampling ticks run ``decode_tail=0``).
+
+    Exactness: the span's KV is scattered into the pages FIRST, then
+    the ragged kernel attends over pages only, bottom-right causal —
+    so a prefix's KV is a function of the prefix tokens alone and
+    chunked/whole/warm prefills all produce the bits a single
+    whole-prompt pass would (tests pin greedy equality to
+    ``generate()`` in every cache state).
+    """
+    from ..ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention_packed)
+    block_fn = _block_fn if _block_fn is not None else _block
+    tq = int(tq)
+    S = meta["q_len"].shape[0]
+    tok_slot = meta["tok_slot"]
+    tok_qoff = meta["tok_qoff"]
+    h = params["embed"].astype(cfg.dtype)[tokens[None]]        # [1, T, D]
+    positions = meta["tok_pos"][None]
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        cell = {}
+
+        def attn_fn(q, k, v):
+            # 1) land the span's KV in the pages (padding -> trash page)
+            kp2 = kp.at[:, meta["tok_page"], meta["tok_off"]].set(
+                k[0].transpose(1, 0, 2).astype(kp.dtype))
+            vp2 = vp.at[:, meta["tok_page"], meta["tok_off"]].set(
+                v[0].transpose(1, 0, 2).astype(vp.dtype))
+            cell["kp"], cell["vp"] = kp2, vp2
+            # 2) one ragged launch over the pages (span KV included):
+            # the packed entry keeps score work proportional to the T
+            # real rows off-TPU and scatters to the kernel's slot-major
+            # layout on TPU
+            o = ragged_paged_attention_packed(
+                q[0], kp2, vp2, tok_slot, tok_qoff, meta["q_len"],
+                meta["kv_len"], meta["tables"], tq=tq, impl=attn_impl)
+            return o[None].astype(q.dtype)
+
+        h = block_fn(lp, h, positions, cfg, attn_fn)
+        return h, (cell["kp"], cell["vp"])
+
+    h, (kp_new, vp_new) = lax.scan(body, h, (params["layers"], k_pages,
+                                             v_pages))
+    h = rms_norm(h[0], params["final_norm"], cfg.rms_norm_eps)  # [T, D]
+    h_last = h[meta["last"]]                                    # [S, D]
+    logits = _mm(h_last, params["lm_head"]).astype(jnp.float32)
+    toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    decode_tail = int(decode_tail)
+    if not decode_tail:
+        return toks, logits, kp_new, vp_new
+
+    ps = k_pages.shape[-2]
+    pps = meta["tables"].shape[1]
+    b_idx = jnp.arange(S, dtype=jnp.int32)
+    zeros = jnp.zeros((S,), jnp.int32)
+    live = meta["tail_live"].astype(jnp.bool_)
+
+    def step(carry, _):
+        tok, lens, kp, vp = carry
+        slot = lens // ps
+        # rows out of pages (retiring overruns), dead all-TRASH rows
+        # and tail-dead (mid-prefill) slots land on the trash page,
+        # exactly like write_token_pages
+        ok = live & (slot < pps)
+        page = jnp.where(
+            ok, meta["tables"][b_idx, jnp.minimum(slot, pps - 1)], 0)
+        m = dict(tok_slot=jnp.where(live, b_idx, S).astype(jnp.int32),
+                 tok_pos=lens, tok_page=page.astype(jnp.int32),
+                 tok_off=jnp.where(ok, lens % ps, 0).astype(jnp.int32),
+                 tok_qoff=zeros, q_len=live.astype(jnp.int32),
+                 kv_len=lens + 1, last=b_idx, tables=meta["tables"])
+        nxt, _, kp, vp = serving_tick(params, tok, m, kp, vp, cfg,
+                                      tq=1, attn_impl=attn_impl,
+                                      _block_fn=_block_fn)
+        return (nxt, lens + 1, kp, vp), nxt
+
+    (_, _, kp_new, vp_new), tail = lax.scan(
+        step, (toks, meta["kv_len"], kp_new, vp_new), None,
+        length=decode_tail)
+    toks = jnp.concatenate([toks[:, None], jnp.moveaxis(tail, 0, 1)],
+                           axis=1)                    # [S, 1+tail]
+    return toks, logits, kp_new, vp_new
+
+
+def serving_tick_block(params, tok, lengths, tables, k_pages, v_pages,
+                       cfg, num_steps: int, attn_impl: str = "auto",
+                       _block_fn=None):
+    """``num_steps`` fused GREEDY decode ticks built on the ragged tick
+    (the multi-step scheduling lever — same contract as the retired
+    ``serving_decode_block``: in-graph argmax, tokens match single-step
+    decode exactly, dead slots write to and read from the trash page).
+    tok/lengths ``[S]`` i32, tables ``[S, pps]``. Returns
+    ``(toks [S, num_steps] i32, k_pages', v_pages')``."""
+    S = tok.shape[0]
+    pps = tables.shape[1]
+    ps = k_pages.shape[-2]
+    b_idx = jnp.arange(S, dtype=jnp.int32)
+    slot = lengths // ps
+    # rows out of pages (retiring overruns) and dead all-TRASH rows
+    # land on the trash page, exactly like write_token_pages
+    page = jnp.where(slot < pps,
+                     tables[b_idx, jnp.minimum(slot, pps - 1)], 0)
+    meta = dict(tok_slot=b_idx, tok_pos=lengths, tok_page=page,
+                tok_off=lengths % ps, tok_qoff=jnp.zeros((S,), jnp.int32),
+                q_len=jnp.ones((S,), jnp.int32), kv_len=lengths + 1,
+                last=b_idx, tables=tables,
+                tail_live=jnp.ones((S,), jnp.bool_))
+    toks, _, kp_new, vp_new = serving_tick(
+        params, tok, meta, k_pages, v_pages, cfg, tq=1,
+        decode_tail=num_steps - 1, attn_impl=attn_impl,
+        _block_fn=_block_fn)
+    if num_steps == 1:
+        toks = toks[:, None]
+    return toks, kp_new, vp_new
+
+
 def make_batch(cfg: LlamaConfig, batch_size: int, seq_len: int, mesh: Mesh,
                key=None):
     """Synthetic next-token batch, dp-sharded."""

@@ -428,3 +428,19 @@ def serving_decode_block(params, tok, lengths, tables, k_pages, v_pages,
     from .llama import serving_decode_block as _impl
     return _impl(params, tok, lengths, tables, k_pages, v_pages, cfg,
                  num_steps, attn_impl=attn_impl, _block_fn=_decode_block)
+
+
+def serving_tick(params, tokens, meta, k_pages, v_pages, cfg,
+                 tq: int = 1, decode_tail: int = 0,
+                 attn_impl: str = "auto"):
+    from .llama import serving_tick as _impl
+    return _impl(params, tokens, meta, k_pages, v_pages, cfg, tq=tq,
+                 decode_tail=decode_tail, attn_impl=attn_impl,
+                 _block_fn=_decode_block)
+
+
+def serving_tick_block(params, tok, lengths, tables, k_pages, v_pages,
+                       cfg, num_steps: int, attn_impl: str = "auto"):
+    from .llama import serving_tick_block as _impl
+    return _impl(params, tok, lengths, tables, k_pages, v_pages, cfg,
+                 num_steps, attn_impl=attn_impl, _block_fn=_decode_block)

@@ -1,0 +1,348 @@
+"""Authored ragged paged-attention Pallas kernel (one-launch serving tick).
+
+Counterpart of the TPU serving kernel described in "Ragged Paged
+Attention: A High-Performance and Flexible LLM Inference Kernel for
+TPU" (PAPERS.md, arxiv 2604.15464) and of the reference's fused
+block_multihead_attention path: ONE kernel launch computes attention
+for a mixed batch of variable-length sequences — ragged prefill spans
+(bottom-right causal within each sequence) and decode steps (q_len=1)
+in the same grid — over per-slot page tables. Sequence geometry is
+DATA, not shape: ``(q_len, kv_len, page_table)`` ride in as device
+arrays (scalar-prefetched into SMEM), so any mix of chunked prefills,
+warm-prefix attaches and decodes is one static XLA program. This is
+what lets the serving engine drop its compile-geometry quantization
+(chunk-width buckets, attach quanta) at the root.
+
+Layout contract:
+
+* ``q``: ``[S, Tq, H, Dh]`` — slot-major padded query spans. Slot
+  ``s`` owns rows ``0..q_len[s]-1``; rows past ``q_len[s]`` (and whole
+  slots with ``q_len[s] == 0``) are padding the kernel never reads
+  into real outputs.
+* ``k_pages``/``v_pages``: ``[Hkv, total_pages, page_size, Dh]`` — the
+  shared serving pools. The span's OWN fresh KV must already be
+  written into the pages (the step fn scatters before attending, like
+  ``serving_decode_step``), so the kernel is purely paged: no separate
+  current-chunk operand, no gathered-prefix concat.
+* ``kv_len[s]`` counts every key visible at the END of slot ``s``'s
+  span (context + the span itself); query row ``t`` attends key
+  positions ``0 .. kv_len[s]-q_len[s]+t`` — the bottom-right causal
+  mask that makes a chunked prefill bitwise-equal to a whole-prompt
+  one.
+* ``tables``: ``[S, pages_per_slot]`` int32; entries past the covered
+  range may be TRASH (0) — the kernel walks only
+  ``ceil(kv_len/page_size)`` entries, so HBM traffic scales with the
+  tokens actually cached, not the table width.
+
+Grid ``(S, Hkv)``: each program DMAs its slot's valid pages into VMEM
+scratch (all copies started, then awaited — pages overlap in flight),
+computes the full masked score block ``[G·Tq, KV_max]`` in f32 and a
+ONE-SHOT softmax. The one-shot formulation (not an online-softmax
+accumulator) is deliberate: it makes the kernel bitwise-equal to the
+dense-gather reference below, which is the verification story the
+engine's exactness bar rests on (tests/test_ragged_attention.py). At
+serving shapes ``KV_max = pages_per_slot · page_size`` fits VMEM
+comfortably; a production long-context variant would tile KV with the
+flash combine at the cost of the bitwise pin.
+
+Off-TPU the kernel runs in interpreter mode (CPU-testable, like the
+int8/flash kernels); ``impl="dense"`` selects the reference gather
+formulation with identical semantics — ``impl="auto"`` uses the kernel
+on TPU and the reference elsewhere.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["ragged_paged_attention", "ragged_paged_attention_reference",
+           "ragged_paged_attention_packed"]
+
+_MASK = -1e30  # matches the repo's dense-attention mask value
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def _attend(qs, ks, vs, q_len, kv_len, tq: int):
+    """One (slot, kv-head) attention block — the single source of the
+    math, shared verbatim by the kernel body and the reference (the
+    bitwise-equality pin compares two call sites of THIS function, not
+    two formulations).
+
+    qs ``[G*Tq, Dh]`` (pre-scaled, rows ordered (g, t)); ks/vs
+    ``[KV_max, Dh]`` — positions >= kv_len may hold garbage (stale
+    kernel scratch / trash-page contents) and are zeroed here so a NaN
+    in dead space can never leak through a 0-weight product.
+    Returns ``[G*Tq, Dh]`` in vs.dtype.
+    """
+    kv_max = ks.shape[0]
+    kmask = jax.lax.broadcasted_iota(jnp.int32, (kv_max, 1), 0) < kv_len
+    ks = jnp.where(kmask, ks, 0)
+    vs = jnp.where(kmask, vs, 0)
+    # scores dot in the operand dtype, f32 only from the softmax on —
+    # the repo-wide attention convention the dtype-drift pass enforces
+    # (a preferred_element_type=f32 here reads as a silently widened
+    # GEMM on bf16-origin data)
+    s = jax.lax.dot_general(qs, ks,
+                            (((1,), (1,)), ((), ()))).astype(jnp.float32)
+    t = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) % tq
+    k_idx = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    # bottom-right causal: row t sees keys 0 .. (kv_len - q_len) + t;
+    # rows past q_len (span padding) are fully masked
+    mask = (t < q_len) & (k_idx <= (kv_len - q_len) + t)
+    s = jnp.where(mask, s, _MASK)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jax.lax.dot_general(p.astype(vs.dtype), vs,
+                            (((1,), (0,)), ((), ())))
+    # fully-masked rows (padding, empty slots): l == 0 -> emit 0, not NaN
+    return (o / jnp.where(l > 0, l, 1.0).astype(o.dtype)).astype(vs.dtype)
+
+
+def _kernel(qlen_ref, kvlen_ref, tab_ref, q_ref, kp_ref, vp_ref, o_ref,
+            k_scr, v_scr, sems, *, pps: int, page_size: int, tq: int):
+    s = pl.program_id(0)
+    h = pl.program_id(1)
+    qn = qlen_ref[s]
+    kn = kvlen_ref[s]
+    n_pages = pl.cdiv(kn, page_size)
+
+    def dma(p, pages_ref, scr, lane):
+        page = tab_ref[s * pps + p]
+        return pltpu.make_async_copy(pages_ref.at[h, page], scr.at[p],
+                                     sems.at[lane, p])
+
+    # start every valid page's K and V copy, then await them — the
+    # copies overlap in flight; a dead slot (qn == 0) moves no bytes
+    for p in range(pps):
+        @pl.when((qn > 0) & (p < n_pages))
+        def _(p=p):
+            dma(p, kp_ref, k_scr, 0).start()
+            dma(p, vp_ref, v_scr, 1).start()
+    for p in range(pps):
+        @pl.when((qn > 0) & (p < n_pages))
+        def _(p=p):
+            dma(p, kp_ref, k_scr, 0).wait()
+            dma(p, vp_ref, v_scr, 1).wait()
+
+    @pl.when(qn > 0)
+    def _():
+        kv_max = pps * page_size
+        dh = k_scr.shape[-1]
+        ks = k_scr[...].reshape(kv_max, dh)
+        vs = v_scr[...].reshape(kv_max, dh)
+        o_ref[...] = _attend(q_ref[...], ks, vs, qn, kn, tq)
+
+    @pl.when(qn == 0)
+    def _():
+        # dead slot: emit defined zeros (the reference's fully-masked
+        # rows), not stale output-buffer contents — the bitwise pin
+        # covers empty slots too
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tq", "g", "interpret"))
+def _pallas_impl(qs, k_pages, v_pages, q_len, kv_len, tables, tq, g,
+                 interpret):
+    """qs ``[S, Hkv, G*Tq, Dh]`` pre-scaled; returns the same shape."""
+    S, Hkv, GT, Dh = qs.shape
+    pps = tables.shape[1]
+    page_size = k_pages.shape[2]
+    kernel = functools.partial(_kernel, pps=pps, page_size=page_size,
+                               tq=tq)
+    block = pl.BlockSpec((None, None, GT, Dh),
+                         lambda s, h, *_: (s, h, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(S, Hkv),
+            in_specs=[
+                block,
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=block,
+            scratch_shapes=[
+                pltpu.VMEM((pps, page_size, Dh), k_pages.dtype),
+                pltpu.VMEM((pps, page_size, Dh), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, pps)),
+            ]),
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        out_shape=jax.ShapeDtypeStruct(qs.shape, k_pages.dtype),
+        interpret=interpret,
+    )(q_len, kv_len, tables.reshape(-1), qs, k_pages, v_pages)
+
+
+def _reference_impl(qs, k_pages, v_pages, q_len, kv_len, tables, tq, g):
+    """Dense-gather reference with identical semantics: per slot,
+    gather the table's pages and run the SAME ``_attend`` block per kv
+    head. vmapped over (slot, head) — proven bitwise-equal to the
+    kernel's sequential grid by tests/test_ragged_attention.py."""
+    S, Hkv, GT, Dh = qs.shape
+    pps = tables.shape[1]
+    ps = k_pages.shape[2]
+
+    def per_slot(q_s, qn, kn, tab):
+        ks = k_pages[:, tab].reshape(Hkv, pps * ps, Dh)
+        vs = v_pages[:, tab].reshape(Hkv, pps * ps, Dh)
+        return jax.vmap(
+            lambda qh, kh, vh: _attend(qh, kh, vh, qn, kn, tq)
+        )(q_s, ks, vs)
+
+    return jax.vmap(per_slot)(qs, q_len, kv_len, tables)
+
+
+def ragged_paged_attention(q, k_pages, v_pages, q_len, kv_len, tables,
+                           sm_scale=None, impl: str = "auto"):
+    """One-launch attention for a mixed ragged batch over paged KV.
+
+    q: ``[S, Tq, H, Dh]`` slot-major query spans (see module
+    docstring); k_pages/v_pages: ``[Hkv, P, page_size, Dh]``;
+    q_len/kv_len: i32 ``[S]``; tables: i32 ``[S, pages_per_slot]``.
+    Returns ``[S, Tq, H, Dh]`` in q.dtype.
+
+    impl: "auto" (pallas kernel on TPU, dense-gather reference
+    elsewhere), "pallas" (strict — interpreter mode off-TPU), "dense".
+    """
+    if impl not in ("auto", "pallas", "dense"):
+        raise ValueError(f"impl must be auto|pallas|dense, got {impl!r}")
+    S, Tq, H, Dh = q.shape
+    Hkv = k_pages.shape[0]
+    if H % Hkv:
+        raise ValueError(f"H={H} not a multiple of Hkv={Hkv}")
+    G = H // Hkv
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(Dh))
+    q_len = jnp.asarray(q_len, jnp.int32)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    tables = jnp.asarray(tables, jnp.int32)
+    # [S, Tq, H, Dh] -> [S, Hkv, G*Tq, Dh], rows (g, t)-ordered — the
+    # head axis is kv-head-major (H = Hkv*G), matching the GQA reshape
+    # every other kernel in the repo uses
+    qs = (q * sm_scale).astype(q.dtype)
+    qs = qs.reshape(S, Tq, Hkv, G, Dh).transpose(0, 2, 3, 1, 4)
+    qs = qs.reshape(S, Hkv, G * Tq, Dh)
+    use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu())
+    if use_pallas:
+        out = _pallas_impl(qs, k_pages, v_pages, q_len, kv_len, tables,
+                           tq=Tq, g=G, interpret=not _on_tpu())
+    else:
+        out = _reference_impl(qs, k_pages, v_pages, q_len, kv_len,
+                              tables, tq=Tq, g=G)
+    out = out.reshape(S, Hkv, G, Tq, Dh).transpose(0, 3, 1, 2, 4)
+    return out.reshape(S, Tq, H, Dh).astype(q.dtype)
+
+
+def ragged_paged_attention_reference(q, k_pages, v_pages, q_len, kv_len,
+                                     tables, sm_scale=None):
+    """The dense-gather formulation, directly (tests reach it via
+    ``impl="dense"`` too)."""
+    return ragged_paged_attention(q, k_pages, v_pages, q_len, kv_len,
+                                  tables, sm_scale=sm_scale, impl="dense")
+
+
+def _packed_impl(q, k_pages, v_pages, tok_slot, tok_qoff, q_len, kv_len,
+                 tables, sm_scale):
+    """Work-proportional PACKED formulation: attention computed
+    directly on the tick's token stream — score work scales with the
+    ``T`` real rows, not the ``S × Tq`` slot-major padding the kernel's
+    block layout needs (6-7x less at serving shapes, which is why the
+    engine's CPU ticks route here). Same math, same masks, same
+    reduction axes/order as ``_attend`` — proven bitwise-equal to the
+    slot-major reference by tests/test_ragged_attention.py."""
+    T, H, Dh = q.shape
+    S, pps = tables.shape
+    Hkv, _, ps, _ = k_pages.shape
+    G = H // Hkv
+    KV = pps * ps
+    qs = (q * sm_scale).astype(q.dtype).reshape(T, Hkv, G, Dh)
+    # ONE per-token page gather, via the (tiny) [T, pps] table-row
+    # gather — gathering [Hkv, S, KV, Dh] per slot and then re-indexing
+    # [:, tok_slot] would copy the gathered block a second time
+    # (padding rows — slot sentinel S — clamp to slot 0 and are fully
+    # masked below)
+    sl = jnp.minimum(tok_slot, S - 1)
+    tabs_t = tables[sl]                                     # [T, pps]
+    ks = k_pages[:, tabs_t].reshape(Hkv, T, KV, Dh)
+    vs = v_pages[:, tabs_t].reshape(Hkv, T, KV, Dh)
+    kmask = (jax.lax.broadcasted_iota(jnp.int32, (T, KV), 1)
+             < kv_len[sl][:, None])                         # [T, KV]
+    # K needs no pre-zeroing: every garbage position's score is
+    # REPLACED by _MASK below (jnp.where takes the other branch even
+    # for NaN), and live positions only dot rows < kv_len. V keeps the
+    # zeroing — it is the NaN barrier for garbage rows (p is exactly 0
+    # there, but 0 * NaN would still poison the weighted sum)
+    vs = jnp.where(kmask[None, :, :, None], vs, 0)
+    s = jnp.einsum("tkgd,ktsd->tkgs", qs, ks).astype(jnp.float32)
+    # bottom-right causal per token: row qoff sees keys
+    # 0 .. (kv_len - q_len) + qoff of ITS slot; padding rows (slot
+    # sentinel, or qoff >= q_len) are fully masked
+    k_idx = jax.lax.broadcasted_iota(jnp.int32, (T, KV), 1)
+    hi = (kv_len[sl] - q_len[sl] + tok_qoff)[:, None]
+    mask = ((tok_slot < S)[:, None] & (tok_qoff < q_len[sl])[:, None]
+            & (k_idx <= hi))                                # [T, KV]
+    m4 = mask[:, None, None, :]
+    s = jnp.where(m4, s, _MASK)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    p = jnp.where(m4, p, 0.0)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("tkgs,ktsd->tkgd", p.astype(vs.dtype), vs)
+    o = o / jnp.where(l > 0, l, 1.0).astype(o.dtype)
+    return o.reshape(T, H, Dh).astype(q.dtype)
+
+
+def ragged_paged_attention_packed(q, k_pages, v_pages, tok_slot, tok_qoff,
+                                  q_len, kv_len, tables, tq: int,
+                                  sm_scale=None, impl: str = "auto"):
+    """Packed-layout entry for the serving tick: ``q [T, H, Dh]`` is
+    the tick's token stream with per-token owner/offset metadata
+    (``tok_slot [T]`` — ``S`` = padding sentinel; ``tok_qoff [T]``).
+    Returns ``[T, H, Dh]`` (padding rows zero).
+
+    impl: "auto" — the work-proportional packed formulation off-TPU,
+    the Pallas kernel (scatter to the slot-major layout at the
+    boundary) on TPU; "pallas"/"dense" force the slot-major kernel /
+    reference; "packed" forces the packed formulation.
+    """
+    if impl not in ("auto", "pallas", "dense", "packed"):
+        raise ValueError(
+            f"impl must be auto|pallas|dense|packed, got {impl!r}")
+    T, H, Dh = q.shape
+    S = tables.shape[0]
+    if sm_scale is None:
+        sm_scale = 1.0 / float(np.sqrt(Dh))
+    tok_slot = jnp.asarray(tok_slot, jnp.int32)
+    tok_qoff = jnp.asarray(tok_qoff, jnp.int32)
+    q_len = jnp.asarray(q_len, jnp.int32)
+    kv_len = jnp.asarray(kv_len, jnp.int32)
+    tables = jnp.asarray(tables, jnp.int32)
+    if impl == "packed" or (impl == "auto" and not _on_tpu()):
+        return _packed_impl(q, k_pages, v_pages, tok_slot, tok_qoff,
+                            q_len, kv_len, tables, sm_scale)
+    # slot-major boundary: scatter the stream into the kernel's
+    # [S, Tq] layout (row S+1 absorbs padding tokens), run the kernel,
+    # gather back (padding reads the zero row)
+    qs = jnp.zeros((S + 1, int(tq), H, Dh), q.dtype)
+    qs = qs.at[tok_slot, tok_qoff].set(q)
+    o = ragged_paged_attention(qs[:S], k_pages, v_pages, q_len, kv_len,
+                               tables, sm_scale=sm_scale, impl=impl)
+    o = jnp.concatenate([o, jnp.zeros((1,) + o.shape[1:], o.dtype)],
+                        axis=0)
+    return o[tok_slot, tok_qoff].astype(q.dtype)

@@ -11,18 +11,24 @@ behind it); this engine batches per STEP:
     ``max_batch``-wide decode batch (admission is page-budget-aware —
     see serving/scheduler.py);
   - admission first attaches the longest PREFIX-CACHED page-aligned
-    span of the prompt (serving/prefix_cache.py — refcounted KV page
-    reuse across requests: system prompts and few-shot headers are
-    computed once) and prefills only the uncached suffix;
-  - the suffix is prefilled immediately (one jitted prefill per
-    prompt-length bucket, batch 1) writing its KV into the request's
-    own pages of a SHARED per-layer page pool — or, with
-    ``prefill_chunk=N``, in fixed-size page-aligned chunks interleaved
-    one-per-tick with decode, so a long prompt never stalls in-flight
-    streams for a whole prefill;
-  - every engine tick runs ONE jitted decode step for all slots —
-    live or dead — so the decode program has a single stable shape and
-    XLA compiles it exactly once;
+    span of the prompt EXACTLY — any page count (serving/
+    prefix_cache.py — refcounted KV page reuse across requests:
+    system prompts and few-shot headers are computed once) — and only
+    the uncached suffix is ever computed;
+  - every engine tick is ONE jitted ragged program
+    (``models/*.serving_tick`` over the ragged-paged-attention Pallas
+    kernel): each live slot's decode token AND up to a per-tick token
+    budget of pending prompt spans run in the same launch, with
+    sequence geometry (span lengths, cache lengths, page tables)
+    carried as device arrays. Prompt length, chunk position and
+    attached-prefix size are DATA, not compile shapes — the pre-r12
+    geometry quantization (prompt buckets, chunk grids, attach quanta)
+    is gone and the recompile-hazard pass proves the whole engine
+    compiles 1-2 programs per packed width;
+  - ``prefill_chunk=N`` caps the per-tick prefill token budget (its
+    scheduling role — bounded inter-token stall for in-flight streams
+    while long prompts are absorbed); it no longer affects what
+    compiles;
   - sequences retire at EOS / max_new_tokens / deadline / cancel and
     their pages return to the pool the same tick, so the next queued
     request starts without waiting for the rest of the batch.
@@ -86,9 +92,16 @@ _JIT_CACHE_MAX = 8
 
 
 def _jit_step_fns(mod, cfg, attn_impl: str, rewrites: bool = False):
-    """Shared jitted prefill/decode per (model, config, impl): several
+    """Shared jitted tick/block per (model, config, impl): several
     engines over one config (tests, blue/green restarts) reuse the same
     jit objects, so XLA's executable cache carries across instances.
+
+    Exactly TWO step functions serve everything (the one-program-tick
+    design, ISSUE r12): ``serving_tick`` — any mix of decode tokens and
+    prompt spans as one ragged program (one compile per packed width;
+    widths come from the engine's small width grid — see
+    ``ServingEngine._w_grid``) — and ``serving_tick_block`` — the
+    fused multi-step greedy decode path.
 
     ``rewrites=True`` routes every step function through the analysis
     subsystem's verified rewrite passes (analysis/rewrite.py) before
@@ -97,9 +110,14 @@ def _jit_step_fns(mod, cfg, attn_impl: str, rewrites: bool = False):
     pin in tests/test_rewrite.py proves greedy outputs stay
     byte-identical to the unrewritten engine)."""
     import jax
-    key = (mod.__name__, id(cfg), attn_impl, bool(rewrites))
+    # content key (repr of a dataclass config is deterministic and
+    # covers every field): benches and tests that rebuild an identical
+    # config per run — the common restart shape — reuse the traced jit
+    # objects instead of paying a full re-trace + lowering per engine
+    key = (mod.__name__, type(cfg).__name__, repr(cfg), attn_impl,
+           bool(rewrites))
     hit = _JIT_CACHE.get(key)
-    if hit is not None and hit[0] is cfg:  # id() safe: cfg ref held
+    if hit is not None:
         _JIT_CACHE.move_to_end(key)
         return hit[1:]
     if rewrites:
@@ -107,27 +125,21 @@ def _jit_step_fns(mod, cfg, attn_impl: str, rewrites: bool = False):
     else:
         def _rw(fn):
             return fn
-    # donate the pool arrays (args 4/5 of every step fn): the engine
-    # rebinds the returned pools immediately, and without donation every
-    # tick pays a full pool copy — measured 2-3x the whole step time on
-    # the CPU mesh at bench shapes
-    pre = jax.jit(_rw(partial(mod.serving_prefill, cfg=cfg,
-                              attn_impl=attn_impl)), donate_argnums=(4, 5))
-    dec = jax.jit(_rw(partial(mod.serving_decode_step, cfg=cfg,
-                              attn_impl=attn_impl)), donate_argnums=(4, 5))
-    blk = jax.jit(_rw(partial(mod.serving_decode_block, cfg=cfg,
+    # donate the pool arrays: the engine rebinds the returned pools
+    # immediately, and without donation every tick pays a full pool
+    # copy — measured 2-3x the whole step time on the CPU mesh at
+    # bench shapes
+    tick = jax.jit(_rw(partial(mod.serving_tick, cfg=cfg,
+                               attn_impl=attn_impl)),
+                   donate_argnums=(3, 4),
+                   static_argnames=("tq", "decode_tail"))
+    blk = jax.jit(_rw(partial(mod.serving_tick_block, cfg=cfg,
                               attn_impl=attn_impl)), donate_argnums=(4, 5),
                   static_argnames=("num_steps",))
-    # prefix_pages is STATIC: the gathered-prefix width is a shape (one
-    # compile per distinct already-written page count — page-aligned
-    # chunk boundaries keep the value set small)
-    chk = jax.jit(_rw(partial(mod.serving_prefill_chunk, cfg=cfg,
-                              attn_impl=attn_impl)), donate_argnums=(4, 5),
-                  static_argnames=("prefix_pages",))
-    _JIT_CACHE[key] = (cfg, pre, dec, blk, chk)
+    _JIT_CACHE[key] = (cfg, tick, blk)
     if len(_JIT_CACHE) > _JIT_CACHE_MAX:
         _JIT_CACHE.popitem(last=False)
-    return pre, dec, blk, chk
+    return tick, blk
 
 
 def _default_buckets(max_prompt_len: int):
@@ -169,14 +181,17 @@ class ServingEngine:
     (weight-only quant is a params transform, not a decode-path fork).
     prefix_cache: True (default) keeps full prompt-KV pages registered
     across requests (refcounted; LRU-evicted under page pressure) so a
-    shared prompt prefix is prefilled once — greedy outputs stay
+    shared prompt prefix is prefilled once — and attached EXACTLY: any
+    cached page count, no attach quantum (prefix size is data to the
+    ragged tick, not a compile shape). Greedy outputs stay
     byte-identical to ``generate()`` whether a prefix was cached,
-    partially cached, or cold (the chunk program's math is bitwise
-    equal to the whole-prompt program's; tests/test_prefix_cache.py).
-    prefill_chunk: None (default) prefills a whole suffix at admission;
-    N (a multiple of page_size) caps per-tick prefill work at one
-    N-token chunk, interleaved with decode ticks (bounded inter-token
-    stall for in-flight streams while long prompts are absorbed).
+    partially cached, or cold (tests/test_prefix_cache.py).
+    prefill_chunk: per-tick prefill token budget. None (default)
+    absorbs a whole suffix in its admission tick; N caps per-tick
+    prefill work at N prompt tokens, interleaved with decode in the
+    SAME ragged program (bounded inter-token stall for in-flight
+    streams while long prompts are absorbed). Purely a scheduling
+    knob — any positive value compiles the same two programs.
     admission_window: 0 (default) = strict-FIFO admission; N lets up to
     N queued requests overtake a head whose page budget does not fit.
     check_invariants: True runs the paged-KV invariant checker
@@ -211,10 +226,9 @@ class ServingEngine:
             raise ValueError("max_batch must be >= 1")
         if prefill_chunk is not None:
             prefill_chunk = int(prefill_chunk)
-            if prefill_chunk < page_size or prefill_chunk % page_size:
-                raise ValueError(
-                    f"prefill_chunk must be a positive multiple of "
-                    f"page_size ({page_size}), got {prefill_chunk}")
+            if prefill_chunk < 1:
+                raise ValueError(f"prefill_chunk must be >= 1, got "
+                                 f"{prefill_chunk}")
         if quantization not in (None, "none", "int8"):
             raise ValueError(f"quantization must be None/'none'/'int8', "
                              f"got {quantization!r}")
@@ -246,51 +260,54 @@ class ServingEngine:
         if total_pages is None:
             total_pages = max_batch * pages_per_slot + 1
         self.pool = PagePool(total_pages=total_pages, page_size=page_size)
-        # attach granularity: prefix_pages is a STATIC dim of the chunk
-        # program, so unrestricted attach counts would compile one
-        # program per distinct cached-prefix length; quantizing to
-        # multiples of ceil(pps/16) bounds the attach value set at
-        # <= 16 while giving up at most quantum-1 pages of reuse.
-        # Under chunked prefill the chunk ticks themselves advance
-        # prefix_pages in chunk-page steps, so chunk programs reach
-        # every multiple of chunk_pages REGARDLESS of attach quantum —
-        # an attach grid off the chunk grid only multiplies the union
-        # {attach + k*chunk_pages} toward ~pages_per_slot values (the
-        # pre-r9 hazard at prefix_ab geometry: 38 programs where <= 16
-        # was claimed), while a coarser grid than chunk_pages gives up
-        # reuse for nothing. The optimum is exactly the chunk grid;
-        # the residual bound is then user-controlled by the chunk size
-        # (ceil(max_prompt/prefill_chunk) programs) and checked below.
-        quantum = max(1, -(-pages_per_slot // 16))
-        if prefill_chunk is not None:
-            quantum = prefill_chunk // page_size
-        self.prefix_cache = PrefixCache(
-            self.pool, attach_quantum=quantum) if prefix_cache else None
+        # EXACT prefix attach (attach_quantum=1): cached-prefix size is
+        # carried to the ragged tick as data, so any page count costs
+        # zero extra compiles — the r8-r11 attach-quantum compile-
+        # geometry machinery is deleted at the root (ISSUE r12)
+        self.prefix_cache = PrefixCache(self.pool) if prefix_cache \
+            else None
         self._chunk = prefill_chunk
-        # statically prove the chunk-program bound for THIS geometry
-        # (the recompile-hazard lint pass, analysis/recompile.py): a
-        # too-small chunk against a big prompt budget means one XLA
-        # compile per chunk start, landing inside serving ticks — warn
-        # at construction instead of stalling under traffic
-        if prefill_chunk is not None or self.prefix_cache is not None:
-            from ..analysis.recompile import (ServingGeometry,
-                                              enumerate_chunk_programs)
-            programs = enumerate_chunk_programs(ServingGeometry(
-                page_size=page_size, pages_per_slot=pages_per_slot,
-                buckets=list(self._buckets),
-                attach_quantum=quantum if self.prefix_cache is not None
-                else 0,
-                prefill_chunk=prefill_chunk))
-            worst = max((len(v) for v in programs.values()), default=0)
-            if worst > 16:
-                import warnings
-                warnings.warn(
-                    f"serving geometry reaches {worst} distinct "
-                    f"chunk-prefill programs in one width bucket "
-                    f"(> 16): each is an XLA compile inside a serving "
-                    f"tick. Raise prefill_chunk (or shrink "
-                    f"max_prompt_len) — see docs/ANALYSIS.md "
-                    f"recompile-hazard.", stacklevel=2)
+        # per-tick prefill token budget: prefill_chunk's surviving
+        # (scheduling) role. None = absorb a whole suffix in one tick.
+        self._budget = int(prefill_chunk) if prefill_chunk is not None \
+            else max_bucket
+        # packed-width grid: a spans tick runs at the smallest width
+        # covering its ACTUAL span tokens (a warm attach whose suffix
+        # is 40 tokens must not pay the 256-wide cold program). This
+        # pads the program like any jit bucket pad — geometry stays
+        # data (span offsets, prefix sizes, cache lengths), so it has
+        # no exactness role, unlike the deleted chunk/attach quanta.
+        self._w_grid = sorted({min(b, self._budget)
+                               for b in self._buckets} | {self._budget})
+        # statically prove the one-program-tick invariant for THIS
+        # geometry (the recompile-hazard pass, analysis/recompile.py):
+        # the ragged engine reaches exactly {serving_tick@S+w (w in the
+        # width grid)} and {serving_tick@S, serving_tick_block[k]} —
+        # 1-2 programs per packed-width bucket. The enumeration runs
+        # here so any future
+        # dispatch change that silently multiplies the program set
+        # warns at construction instead of stalling under traffic; the
+        # warning names the offending program set.
+        from ..analysis.recompile import (ServingGeometry,
+                                          enumerate_tick_programs)
+        programs = enumerate_tick_programs(ServingGeometry(
+            page_size=page_size, pages_per_slot=pages_per_slot,
+            buckets=list(self._buckets),
+            attach_quantum=1 if self.prefix_cache is not None else 0,
+            prefill_chunk=prefill_chunk, ragged=True,
+            max_batch=max_batch, decode_block=self._decode_block))
+        worst = max((len(v) for v in programs.values()), default=0)
+        if worst > 2:
+            import warnings
+            warnings.warn(
+                f"serving geometry (page_size={page_size}, "
+                f"buckets={self._buckets}, "
+                f"prefill_chunk={prefill_chunk}, "
+                f"decode_block={self._decode_block}) reaches {worst} "
+                f"distinct tick programs in one width bucket (> 2): "
+                f"{ {w: sorted(v) for w, v in sorted(programs.items())} }"
+                f" — each is an XLA compile inside a serving tick; see "
+                f"docs/ANALYSIS.md recompile-hazard.", stacklevel=2)
         if check_invariants is None:
             check_invariants = os.environ.get(
                 "PADDLE_TPU_SERVING_CHECK_INVARIANTS", ""
@@ -307,9 +324,8 @@ class ServingEngine:
         self._kp, self._vp = pools["k_pages"], pools["v_pages"]
         import jax
         self._jnp = jax.numpy
-        (self._prefill_jit, self._decode_jit, self._block_jit,
-         self._chunk_jit) = _jit_step_fns(self._mod, cfg, attn_impl,
-                                          rewrites=rewrites)
+        self._tick_jit, self._block_jit = _jit_step_fns(
+            self._mod, cfg, attn_impl, rewrites=rewrites)
         self._jax = jax
         # requests parked mid chunked-prefill, FIFO: one chunk advances
         # per tick so in-flight decode streams keep a bounded stall
@@ -415,6 +431,17 @@ class ServingEngine:
                 self.pool, self.scheduler, self.prefix_cache,
                 prefill_queue=tuple(self._prefill_q))
 
+    def _geometry_desc(self) -> str:
+        """One-line engine geometry for diagnostics: every raise and
+        warning that names a violation also names the geometry that
+        produced it, so reports from dead engines are actionable."""
+        return (f"engine geometry: page_size={self.pool.page_size} "
+                f"pages_per_slot={self.scheduler.pages_per_slot} "
+                f"max_batch={self.scheduler.max_batch} "
+                f"buckets={self._buckets} width_grid={self._w_grid} "
+                f"prefill_chunk={self._chunk} "
+                f"decode_block={self._decode_block}")
+
     def _audit_or_raise(self) -> None:
         """Per-tick debug-mode check (caller holds the tick lock)."""
         from ..analysis.kv_invariants import (KVInvariantError,
@@ -424,7 +451,8 @@ class ServingEngine:
             prefill_queue=tuple(self._prefill_q))
         if violations:
             self.metrics.inc("invariant_violations", len(violations))
-            raise KVInvariantError(violations)
+            raise KVInvariantError(violations,
+                                   context=self._geometry_desc())
 
     def defragment(self) -> int:
         """Compact live pages to the pool's low indices (the paged-KV
@@ -444,7 +472,8 @@ class ServingEngine:
                 bad = audit_defrag_plan(plan, self.pool, self.scheduler,
                                         self.prefix_cache)
                 if bad:
-                    raise KVInvariantError(bad)
+                    raise KVInvariantError(
+                        bad, context=self._geometry_desc())
             self._kp, self._vp, tables = apply_defrag(
                 plan, self._kp, self._vp, self.scheduler.tables)
             # np.array (not asarray): the jnp result is a zero-copy
@@ -494,77 +523,77 @@ class ServingEngine:
         self.metrics.inc({COMPLETED: "completed", CANCELLED: "cancelled",
                           TIMED_OUT: "timed_out"}[state])
 
-    def _bucket(self, n: int) -> int:
-        for b in self._buckets:
-            if n <= b:
-                return b
-        raise AssertionError("submit() enforces the max bucket")
+    def _emit_greedy(self, slot: int, req: Request, toks_row,
+                     j0: int, j1: int) -> None:
+        """Emit ``toks_row[j0:j1]`` (fused greedy block/tail tokens)
+        for (slot, req), retiring at the first completion — remaining
+        block tokens are discarded (they landed on the trash page)."""
+        for j in range(j0, j1):
+            t = int(toks_row[j])
+            self._cur_tok[slot] = t
+            if self._emit(slot, req, t):
+                self._retire(slot, COMPLETED)
+                break
 
     # ----------------------------------------------------------- prefill ----
-    def _start_prefill(self, slot: int, req: Request) -> None:
-        """Admission-time dispatch: whole-prompt prefill, single
-        suffix-only chunk (prefix-cache hit), or park the slot and feed
-        the suffix through per-tick chunks."""
+    def _park(self, slot: int, req: Request) -> None:
+        """Admission: every request's uncached suffix is absorbed by the
+        per-tick ragged program — park the slot until its prompt is
+        fully cached. The real table row moves onto the request and the
+        scheduler row goes all-TRASH (length stays 0): the parked slot
+        is DEAD to the fused block program (its writes land on the
+        trash page) while each tick's ragged metadata addresses the
+        stashed real row directly."""
         if req.cached_len:
             self.metrics.inc("prefix_hits")
             self.metrics.inc("prefix_hit_tokens", req.cached_len)
             self.metrics.inc("prefix_pages_saved", len(req.prefix_nodes))
         elif self.prefix_cache is not None:
             self.metrics.inc("prefix_misses")
-        suffix = req.prompt.size - req.cached_len
-        if self._chunk is None and not req.cached_len:
-            self._prefill(slot, req)  # pre-r8 whole-prompt program
-        elif self._chunk is None or suffix <= self._chunk:
-            logits = self._run_chunk(slot, req)
-            self._finish_prefill(slot, req, logits)
-        else:
-            req.prefilling = True
-            req.chunk_done = 0
-            # park as a DEAD slot for the shared decode program: the
-            # real row moves onto the request and the scheduler row goes
-            # all-TRASH (length stays 0), so per-tick decode writes AND
-            # reads hit only the trash page — the proven dead-slot path.
-            # (A past-the-table length sentinel would bound the write
-            # side but the TPU pallas kernel's page loop walks
-            # ceil(length/block) table entries with no clamp, reading
-            # past the row.)
-            req.table_row = self.scheduler.tables[slot].copy()
-            self.scheduler.tables[slot, :] = PagePool.TRASH
-            self._prefill_q.append((slot, req))
+        req.prefilling = True
+        req.chunk_done = 0
+        req.table_row = self.scheduler.tables[slot].copy()
+        self.scheduler.tables[slot, :] = PagePool.TRASH
+        self._prefill_q.append((slot, req))
 
-    def _run_chunk(self, slot: int, req: Request) -> np.ndarray:
-        """One serving_prefill_chunk call for the next uncached span;
-        returns the chunk's last-valid-position logits (meaningful only
-        when this was the final chunk)."""
-        n = req.prompt.size
-        start = req.cached_len + req.chunk_done  # page-aligned
-        tb = self._chunk if self._chunk is not None \
-            else self._bucket(n - start)
-        take = min(n - start, tb)
-        padded = np.zeros((1, tb), np.int32)
-        padded[0, :take] = req.prompt[start:start + take]
-        row = self.scheduler.effective_row(slot)
-        jnp = self._jnp
-        with RecordEvent("serving.prefill_chunk"):
-            logits, self._kp, self._vp = self._chunk_jit(
-                self._params, jnp.asarray(padded), jnp.int32(take),
-                jnp.asarray(row), self._kp, self._vp,
-                prefix_pages=start // self.pool.page_size)
-            logits = np.asarray(logits)
-        req.chunk_done += take
-        self.metrics.inc("prefill_chunks")
-        return logits
+    def _collect_spans(self):
+        """The tick's prefill work: FIFO over parked requests, capped at
+        the per-tick token budget. Returns [(slot, req, start, take)];
+        advances no state (the tick driver does, after the program
+        ran). A later request only gets budget once every earlier one's
+        span completed its prompt, so finishing spans are always a
+        prefix of the queue."""
+        while self._prefill_q:          # drop entries retired by sweeps
+            slot, req = self._prefill_q[0]
+            if self.scheduler.slots[slot] is req and req.prefilling:
+                break
+            self._prefill_q.popleft()
+        spans, left = [], self._budget
+        for slot, req in self._prefill_q:
+            if left <= 0:
+                break
+            if self.scheduler.slots[slot] is not req or not req.prefilling:
+                continue
+            remaining = req.prompt.size - req.cached_len - req.chunk_done
+            take = min(remaining, left)
+            if take <= 0:
+                continue
+            spans.append((slot, req, req.cached_len + req.chunk_done,
+                          take))
+            left -= take
+            if take < remaining:
+                break                   # budget exhausted mid-prompt
+        return spans
 
-    def _finish_prefill(self, slot: int, req: Request,
-                        logits: np.ndarray) -> None:
-        """Common prefill tail: register the prompt's full pages in the
-        prefix cache, join the decode batch, sample the first token."""
+    def _finish_prefill(self, slot: int, req: Request, tok: int) -> None:
+        """Common prefill tail: re-install the real row, register the
+        prompt's full pages in the prefix cache, join the decode batch,
+        emit the first sampled token."""
         n = req.prompt.size
         self.metrics.inc("prefills")
         req.prefilling = False
-        if req.table_row is not None:    # was parked: re-install the row
-            self.scheduler.tables[slot, :] = req.table_row
-            req.table_row = None
+        self.scheduler.tables[slot, :] = req.table_row
+        req.table_row = None
         if self.prefix_cache is not None:
             new_full = n // self.pool.page_size - len(req.prefix_nodes)
             if new_full > 0:
@@ -573,84 +602,163 @@ class ServingEngine:
                 req.prefix_nodes = req.prefix_nodes + adopted
                 req.pages = dup + req.pages[new_full:]
         self.scheduler.lengths[slot] = n
-        tok = self._sample(slot, req, logits)
         self._cur_tok[slot] = tok
         if self._emit(slot, req, tok):
             self._retire(slot, COMPLETED)
 
-    def _prefill_tick(self) -> bool:
-        """Advance the oldest parked request by ONE chunk (the bounded
-        per-tick prefill budget). True when any prefill work ran."""
-        while self._prefill_q:
-            slot, req = self._prefill_q[0]
-            if self.scheduler.slots[slot] is not req or not req.prefilling:
-                self._prefill_q.popleft()  # retired by a sweep
+    # -------------------------------------------------------------- tick ----
+    def _ragged_tick(self, live, spans, tail: int = 0) -> None:
+        """ONE serving_tick call covering every live slot's decode token
+        plus the collected prompt spans. Geometry is data: the program
+        compiles once per packed width (S when no prefill work is
+        pending, S + the smallest width-grid entry covering the span
+        tokens otherwise). ``tail`` (> 0 only when every
+        participating request is greedy) fuses that many extra decode
+        steps into the same program for tail-live slots — decoding
+        slots plus spans COMPLETING their prompt this tick — so an
+        admission tick still produces a full decode block for in-flight
+        streams (mid-prefill slots sit the tail out on the trash
+        page)."""
+        jnp = self._jnp
+        S = self.scheduler.max_batch
+        ps = self.pool.page_size
+        pps = self.scheduler.pages_per_slot
+        span_tok = sum(take for _, _, _, take in spans)
+        width = next((w for w in self._w_grid if w >= span_tok),
+                     self._budget) if spans else 0
+        T = S + width
+        tq = max(width, 1)
+        tok = np.zeros((T,), np.int32)
+        tok_slot = np.full((T,), S, np.int32)   # S = padding sentinel
+        tok_pos = np.zeros((T,), np.int32)
+        tok_qoff = np.zeros((T,), np.int32)
+        q_len = np.zeros((S,), np.int32)
+        kv_len = np.zeros((S,), np.int32)
+        last = np.zeros((S,), np.int32)
+        tail_live = np.zeros((S,), bool)
+        tabs = np.stack([self.scheduler.effective_row(s)
+                         for s in range(S)]).astype(np.int32)
+        for slot, req in live:
+            tok[slot] = self._cur_tok[slot]
+            tok_slot[slot] = slot
+            tok_pos[slot] = self.scheduler.lengths[slot]
+            q_len[slot] = 1
+            kv_len[slot] = self.scheduler.lengths[slot] + 1
+            last[slot] = slot
+            tail_live[slot] = True
+        idx = S
+        for slot, req, start, take in spans:
+            tok[idx:idx + take] = req.prompt[start:start + take]
+            tok_slot[idx:idx + take] = slot
+            tok_pos[idx:idx + take] = np.arange(start, start + take)
+            tok_qoff[idx:idx + take] = np.arange(take)
+            q_len[slot] = take
+            kv_len[slot] = start + take
+            last[slot] = idx + take - 1
+            tail_live[slot] = start + take >= req.prompt.size
+            idx += take
+        if not tail_live.any():
+            tail = 0    # nobody would advance — skip the tail variant
+        # page/offset per packed token (padding -> trash page)
+        real = tok_slot < S
+        page_i = np.minimum(tok_pos // ps, pps - 1)
+        tok_page = np.where(
+            real & (tok_pos // ps < pps),
+            tabs[np.minimum(tok_slot, S - 1), page_i], PagePool.TRASH)
+        tok_off = np.where(real, tok_pos % ps, 0).astype(np.int32)
+        meta = dict(tok_slot=jnp.asarray(tok_slot),
+                    tok_pos=jnp.asarray(tok_pos),
+                    tok_page=jnp.asarray(tok_page.astype(np.int32)),
+                    tok_off=jnp.asarray(tok_off),
+                    tok_qoff=jnp.asarray(tok_qoff),
+                    q_len=jnp.asarray(q_len), kv_len=jnp.asarray(kv_len),
+                    last=jnp.asarray(last), tables=jnp.asarray(tabs),
+                    tail_live=jnp.asarray(tail_live))
+        t0 = time.perf_counter()
+        with RecordEvent("serving.tick"):
+            toks_d, logits_d, self._kp, self._vp = self._tick_jit(
+                self._params, jnp.asarray(tok), meta, self._kp, self._vp,
+                tq=tq, decode_tail=tail)
+            # [S] (tail=0) or [S, 1+tail] i32 — the only eager pull
+            toks = np.asarray(toks_d)
+        if toks.ndim == 1:
+            toks = toks[:, None]
+        if live:
+            self.metrics.inc("decode_steps", 1 + tail)
+            self.metrics.observe("decode_step_s",
+                                 (time.perf_counter() - t0) / (1 + tail))
+
+        def next_tok(slot, req):
+            if req.temperature == 0.0:
+                return int(toks[slot, 0])  # in-graph argmax
+            return self._sample(slot, req, np.asarray(logits_d[slot]))
+
+        for slot, req in live:
+            self.scheduler.lengths[slot] += 1 + tail
+            t = next_tok(slot, req)
+            self._cur_tok[slot] = t
+            if self._emit(slot, req, t):
+                self._retire(slot, COMPLETED)
                 continue
-            logits = self._run_chunk(slot, req)
+            self._emit_greedy(slot, req, toks[slot], 1, 1 + tail)
+        for slot, req, start, take in spans:
+            req.chunk_done += take
+            self.metrics.inc("prefill_chunks")
             if req.cached_len + req.chunk_done >= req.prompt.size:
-                self._prefill_q.popleft()
-                self._finish_prefill(slot, req, logits)
-            return True
-        return False
+                if self._prefill_q and self._prefill_q[0][1] is req:
+                    self._prefill_q.popleft()
+                self._finish_prefill(slot, req, next_tok(slot, req))
+                if tail and self.scheduler.slots[slot] is req:
+                    # the completing slot rode the tail too: its first
+                    # 1+tail greedy tokens landed in this same program
+                    self.scheduler.lengths[slot] += tail
+                    self._emit_greedy(slot, req, toks[slot], 1, 1 + tail)
 
-    def _prefill(self, slot: int, req: Request) -> None:
-        n = req.prompt.size
-        tb = self._bucket(n)
-        padded = np.zeros((1, tb), np.int32)
-        padded[0, :n] = req.prompt
+    def _block_tick(self, live) -> None:
+        """Fast path when no prefill work is pending and every live
+        request is greedy: ``num_steps`` fused decode ticks in one
+        program — sampling is in-graph argmax, so the device→host pull
+        is [S, k] i32 tokens instead of [S, V] f32 logits. Fused ticks
+        always run the FULL block — capping at the remaining tokens
+        would compile one program per distinct cap; at worst K-1 cheap
+        steps run past the last retirement and their tokens are
+        discarded (budget overruns land on the trash page)."""
         jnp = self._jnp
-        with RecordEvent("serving.prefill"):
-            logits, self._kp, self._vp = self._prefill_jit(
-                self._params, jnp.asarray(padded), jnp.int32(n),
-                jnp.asarray(self.scheduler.tables[slot]), self._kp,
-                self._vp)
-            logits = np.asarray(logits)
-        self._finish_prefill(slot, req, logits)
-
-    def _decode_tick(self) -> None:
-        jnp = self._jnp
-        live = self.scheduler.live()
-        # step-tail fusion (docs/PERF.md decode notes): all-greedy ticks
-        # run the block program even at k=1 — sampling is in-graph
-        # argmax, so the device→host pull is [S, k] i32 tokens instead
-        # of [S, V] f32 logits (V·4 bytes/slot/step through the
-        # tunnelled runtime). Tokens are bit-identical (same f32 logits,
-        # same argmax); only a live sampling request forces the
-        # logits-to-host path. Fused ticks always run the FULL block —
-        # capping at the remaining tokens would compile one program per
-        # distinct cap; at worst K-1 cheap steps run past the last
-        # retirement and their tokens are discarded (budget overruns
-        # land on the trash page).
-        fused = all(r.temperature == 0.0 for _, r in live)
-        k = self._decode_block if fused else 1
+        k = self._decode_block
         t0 = time.perf_counter()
         with RecordEvent("serving.decode_step"):
-            if fused:
-                toks, self._kp, self._vp = self._block_jit(
-                    self._params, jnp.asarray(self._cur_tok),
-                    jnp.asarray(self.scheduler.lengths),
-                    jnp.asarray(self.scheduler.tables), self._kp,
-                    self._vp, num_steps=k)
-                toks = np.asarray(toks)    # [S, k] greedy tokens
-            else:
-                logits, self._kp, self._vp = self._decode_jit(
-                    self._params, jnp.asarray(self._cur_tok),
-                    jnp.asarray(self.scheduler.lengths),
-                    jnp.asarray(self.scheduler.tables), self._kp,
-                    self._vp)
-                toks = np.asarray(logits)  # [S, V]: sampled below
+            toks, self._kp, self._vp = self._block_jit(
+                self._params, jnp.asarray(self._cur_tok),
+                jnp.asarray(self.scheduler.lengths),
+                jnp.asarray(self.scheduler.tables), self._kp,
+                self._vp, num_steps=k)
+            toks = np.asarray(toks)        # [S, k] greedy tokens
         self.metrics.inc("decode_steps", k)
         self.metrics.observe("decode_step_s",
                              (time.perf_counter() - t0) / k)
         for slot, req in live:
             self.scheduler.lengths[slot] += k  # block's KV just landed
-            for j in range(k):
-                tok = (int(toks[slot, j]) if fused
-                       else self._sample(slot, req, toks[slot]))
-                self._cur_tok[slot] = tok
-                if self._emit(slot, req, tok):
-                    self._retire(slot, COMPLETED)
-                    break
+            self._emit_greedy(slot, req, toks[slot], 0, k)
+
+    def _decode_tick(self, live, spans) -> None:
+        """Tick dispatch: the fused greedy block when the tick is pure
+        decode, else the ragged one-program tick (with the fused greedy
+        decode tail when nobody riding it samples). Only live decoders
+        and spans COMPLETING their prompt this tick gate the tail —
+        mid-prefill spans sit it out on the trash page regardless
+        (``tail_live``), so a parked sampling request must not throttle
+        in-flight greedy streams to one token per tick for the length
+        of its prefill."""
+        greedy_live = all(r.temperature == 0.0 for _, r in live)
+        if not spans and greedy_live and live:
+            self._block_tick(live)
+        else:
+            greedy_completing = all(
+                r.temperature == 0.0 for _, r, start, take in spans
+                if start + take >= r.prompt.size)
+            tail = (self._decode_block - 1
+                    if greedy_live and greedy_completing else 0)
+            self._ragged_tick(live, spans, tail)
 
     def _sweep(self, now: float) -> None:
         """Apply cancellations + deadlines to queued and occupied
@@ -680,8 +788,8 @@ class ServingEngine:
                         self.metrics.inc("admitted")
                         self.metrics.observe("queue_wait_s",
                                              req.admit_t - req.submit_t)
-                        self._start_prefill(slot, req)
-                    chunked = self._prefill_tick()
+                        self._park(slot, req)
+                    spans = self._collect_spans()
                     live = self.scheduler.live()
                     self.metrics.observe("batch_occupancy",
                                          self.scheduler.occupancy)
@@ -689,19 +797,22 @@ class ServingEngine:
                                          self.pool.utilization)
                     self.metrics.observe("chunk_queue_depth",
                                          len(self._prefill_q))
-                    ticked = bool(live) or chunked or bool(admitted)
-                    if live:
+                    ticked = bool(live) or bool(spans)
+                    if ticked:
                         # inter-decode-tick stall: everything since the
-                        # last tick ended (admission prefills, chunks,
-                        # host work) shows up as this gap — the latency
-                        # in-flight streams actually feel
+                        # last tick ended (host work, metadata builds)
+                        # shows up as this gap — the latency in-flight
+                        # streams actually feel. Prefill spans now ride
+                        # INSIDE the tick, budget-bounded, instead of
+                        # stalling between ticks.
                         t = time.perf_counter()
-                        if self._last_decode_t is not None:
+                        if live and self._last_decode_t is not None:
                             self.metrics.observe(
                                 "decode_stall_s",
                                 t - self._last_decode_t)
-                        self._decode_tick()
-                        self._last_decode_t = time.perf_counter()
+                        self._decode_tick(live, spans)
+                        self._last_decode_t = (time.perf_counter()
+                                               if live else None)
                     else:
                         self._last_decode_t = None
                     if ticked and self._check_invariants:

@@ -266,17 +266,32 @@ def test_recompile_enumeration_matches_live_engine_geometry(params):
               prefill_chunk=8)
     with ServingEngine(params, CFG, max_batch=2, **kw) as eng:
         live = ServingGeometry.of_engine(eng)
-    assert engine_geometry(**kw) == live
+    assert engine_geometry(max_batch=2, **kw) == live
+    assert live.ragged and live.attach_quantum == 1
 
 
 def test_recompile_pass_proves_flagship_bound_and_flags_hazard():
+    """The ragged engine's program set is 1-2 per packed-width bucket
+    BY CONSTRUCTION; the legacy bucketed model (still the oracle for
+    the retained bucketed step fns) keeps flagging its hazard class,
+    now with the offending value set spelled out."""
+    from paddle_tpu.analysis import enumerate_tick_programs
     good = engine_geometry(page_size=4, max_prompt_len=16,
-                           max_new_tokens_cap=16, prefill_chunk=8)
-    progs = enumerate_chunk_programs(good)
-    assert progs and all(len(v) <= 16 for v in progs.values())
+                           max_new_tokens_cap=16, prefill_chunk=8,
+                           max_batch=4, decode_block=4)
+    progs = enumerate_tick_programs(good)
+    assert progs and all(len(v) <= 2 for v in progs.values())
+    # both reachable widths are enumerated: S and S+budget
+    assert set(progs) == {4, 12}
+    t_good = trace_graph("geom", lambda x: x, (sds((1,), jnp.float32),),
+                         meta={"geometry": good})
+    found = RecompileHazardPass().run(t_good)
+    assert not _errors(found)
+    assert any("proven bound" in f.message for f in found)
 
-    # seeded hazard: quantum 1 with a large prompt/slot budget — the
-    # pre-r9 failure mode (attach grid off the chunk grid)
+    # seeded hazard through the LEGACY model: quantum 1 with a large
+    # prompt/slot budget — the pre-r9 failure mode (attach grid off
+    # the chunk grid); the error now carries the offending value set
     bad = ServingGeometry(page_size=8, pages_per_slot=40,
                           buckets=[32, 64, 128, 256],
                           attach_quantum=1, prefill_chunk=32)
@@ -286,39 +301,72 @@ def test_recompile_pass_proves_flagship_bound_and_flags_hazard():
                     meta={"geometry": bad})
     errs = _errors(RecompileHazardPass().run(t))
     assert errs and "prefix_pages" in errs[0].message
+    worst = max(over.values(), key=len)
+    assert str(sorted(worst)) in errs[0].message  # offending set named
 
 
-def test_engine_warns_on_unbounded_chunk_program_set(params):
-    """A too-small chunk against a big prompt budget means one compile
-    per chunk start inside serving ticks — the ctor must say so at
-    construction, not stall under traffic."""
+def test_engine_geometry_hazard_died_with_quantization(params):
+    """The pre-r12 compile-storm geometry (tiny chunk against a big
+    prompt budget — 38 programs where ≤16 was claimed) now compiles
+    the SAME two programs as any other geometry: the ctor enumeration
+    stays silent because the hazard is gone at the root, not because
+    the check was dropped."""
     import warnings
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         eng = ServingEngine(params, CFG, max_batch=1, page_size=4,
                             max_prompt_len=128, max_new_tokens_cap=4,
                             prefill_chunk=4, check_invariants=False)
+        geom = ServingGeometry.of_engine(eng)
         eng.close()
-    assert any("chunk-prefill programs" in str(x.message) for x in w)
-    # sane geometry: no warning
-    with warnings.catch_warnings(record=True) as w:
-        warnings.simplefilter("always")
-        eng = ServingEngine(params, CFG, max_batch=1, page_size=4,
-                            max_prompt_len=16, max_new_tokens_cap=4,
-                            prefill_chunk=8, check_invariants=False)
-        eng.close()
-    assert not [x for x in w
-                if "chunk-prefill programs" in str(x.message)]
+    assert not [x for x in w if "tick programs" in str(x.message)]
+    from paddle_tpu.analysis import enumerate_tick_programs
+    progs = enumerate_tick_programs(geom)
+    assert all(len(v) <= 2 for v in progs.values())
+    # the legacy dispatch model confirms this geometry WAS the hazard
+    legacy = ServingGeometry(
+        page_size=geom.page_size, pages_per_slot=geom.pages_per_slot,
+        buckets=geom.buckets, attach_quantum=1, prefill_chunk=4)
+    assert any(len(v) > 16
+               for v in enumerate_chunk_programs(legacy).values())
 
 
-def test_chunked_attach_quantum_sits_on_chunk_grid(params):
-    """The r9 fix: with prefill_chunk=N the attach quantum is a
-    multiple of N/page_size, so chunk starts stay on one grid."""
+def test_graph_lint_json_reports_serving_program_set(capsys):
+    """graph_lint --json (and therefore --ci --json) carries the
+    serving-suite program-set proof: per-width inventory plus the
+    programs-per-bucket bound CI consumers gate on."""
+    import importlib.util
+    import json as _json
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "graph_lint.py")
+    spec = importlib.util.spec_from_file_location("graph_lint", path)
+    gl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gl)
+    rc = gl.main(["--suite", "serving", "--json"])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 0
+    sp = out["serving_programs"]
+    assert sp["programs_per_bucket"] <= 2
+    assert sp["total"] >= 2
+    assert all(len(progs) <= 2 for progs in sp["widths"].values())
+
+
+def test_prefix_attach_is_exact(params):
+    """r12: attach quantum is gone — the engine attaches EVERY cached
+    full page (cap floor((n-1)/ps) only), whatever the chunk size."""
     with ServingEngine(params, CFG, max_batch=2, page_size=4,
                        max_prompt_len=16, max_new_tokens_cap=16,
                        prefill_chunk=8) as eng:
-        q = eng.prefix_cache.attach_quantum
-        assert q % (8 // 4) == 0
+        assert eng.prefix_cache.attach_quantum == 1
+        prompt = np.arange(1, 16, dtype=np.int32)      # 15 tokens
+        eng.submit(prompt, 4).result(timeout=300)
+        eng.submit(prompt, 4).result(timeout=300)
+        c = eng.stats()["counters"]
+    # floor(14/4) = 3 pages = 12 tokens attach — the r8-r11 quantum
+    # (chunk grid: 2 pages) would have attached only 2
+    assert c["prefix_pages_saved"] == 3
+    assert c["prefix_hit_tokens"] == 12
 
 
 # ---------------------------------------------------------------------------
@@ -493,8 +541,12 @@ def test_per_tick_checker_fails_engine_on_live_corruption(params):
             nodes = eng.prefix_cache.nodes()
             assert nodes
             nodes[0].refs += 3      # corruption the next tick must see
-        with pytest.raises(KVInvariantError):
+        with pytest.raises(KVInvariantError) as exc:
             h.result(timeout=300)
+        # the raise names the engine geometry that produced it, so a
+        # report from a dead engine is actionable without a repro
+        assert "engine geometry:" in str(exc.value)
+        assert "page_size=" in str(exc.value)
     finally:
         eng.close(drain=False)
 

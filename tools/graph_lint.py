@@ -9,12 +9,13 @@ runs, in seconds and with zero XLA compiles:
 
   * the jaxpr lint passes (dtype-drift, host-sync,
     collective-consistency) over the flagship llama + qwen2_moe
-    serving programs (`serving_prefill_chunk` at the extreme static
-    prefix_pages values, the fused `serving_decode_block` tick,
+    serving programs (the r12 one-program tick: `serving_tick` at its
+    mixed and decode widths, the fused `serving_tick_block`,
     `generate_paged`) and the llama pp stage chunks;
   * the recompile-hazard pass over the flagship engine geometry —
-    statically proving the ≤16-programs-per-bucket chunk-prefill
-    invariant;
+    statically proving the ≤2-programs-per-packed-width one-program-
+    tick invariant (`--json` carries the inventory as
+    `serving_programs`);
   * the TRAINING passes (sharding-lint, donation-audit, hbm-peak,
     collective-consistency trip counts) over the llama auto-parallel
     train step at the dp / dp×mp / pp-1F1B / zero1 geometries plus the
@@ -119,6 +120,25 @@ def main(argv=None):
             ("rewrite-suite", row["graph"]) for row in rw_table)
     ok = report.ok
     out = {"graph": report.to_dict()}
+    if args.suite in ("all", "serving"):
+        # the serving-suite program-set proof, machine-readable: the
+        # exact tick-program inventory the recompile-hazard pass
+        # enumerated for the flagship engine geometry (--ci consumers
+        # gate on programs_per_bucket <= 2)
+        from paddle_tpu.analysis.recompile import enumerate_tick_programs
+        geom = next((t.meta["geometry"] for t in serving_pool
+                     if t.meta.get("geometry") is not None
+                     and getattr(t.meta["geometry"], "ragged", False)),
+                    None)
+        if geom is not None:
+            programs = enumerate_tick_programs(geom)
+            out["serving_programs"] = {
+                "programs_per_bucket": max(
+                    (len(v) for v in programs.values()), default=0),
+                "total": sum(len(v) for v in programs.values()),
+                "widths": {str(w): sorted(v)
+                           for w, v in sorted(programs.items())},
+            }
     if rw_table is not None:
         out["rewrite"] = rw_table
     out["hbm"] = [
