@@ -37,7 +37,8 @@ from .framework import (Finding, GraphTarget, LintPass, Severity,
                         register_pass)
 
 __all__ = ["ServingGeometry", "enumerate_chunk_programs",
-           "enumerate_tick_programs", "RecompileHazardPass"]
+           "enumerate_tick_programs", "program_inventory",
+           "RecompileHazardPass"]
 
 
 @dataclass
@@ -115,6 +116,24 @@ def enumerate_tick_programs(geom: ServingGeometry) -> Dict[int,
     out: Dict[int, Set[str]] = {S + w: set(mixed) for w in grid}
     out[S] = {"serving_tick[decode]", f"serving_tick_block[k={k}]"}
     return out
+
+
+def program_inventory(geom: ServingGeometry) -> Dict[str, object]:
+    """The one schema for "what programs may this engine compile":
+    ``{programs_per_bucket, total, widths: {str(width): [program]}}``.
+    Shared by ``graph_lint --json`` (``serving_programs`` and the
+    ``observability`` block), the engine-ctor warning, and the runtime
+    recompile sentinel (observability/sentinel.py) — the static proof
+    and the runtime alarm carry the SAME inventory, so a CI consumer
+    and a production postmortem can be diffed field for field."""
+    programs = enumerate_tick_programs(geom)
+    return {
+        "programs_per_bucket": max(
+            (len(v) for v in programs.values()), default=0),
+        "total": sum(len(v) for v in programs.values()),
+        "widths": {str(w): sorted(v)
+                   for w, v in sorted(programs.items())},
+    }
 
 
 def enumerate_chunk_programs(geom: ServingGeometry) -> Dict[int,

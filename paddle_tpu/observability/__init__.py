@@ -1,0 +1,53 @@
+"""paddle_tpu.observability — runtime evidence for the serving stack.
+
+The static-analysis subsystem (paddle_tpu/analysis/) *proves* serving
+invariants offline: the recompile pass enumerates the reachable tick
+program set, the KV checker audits page ownership, the HBM estimator
+bounds peaks. This package is the runtime half (ISSUE r13): the same
+invariants *watched while serving*, and the evidence shipped with every
+anomaly instead of reconstructed after it.
+
+    SpanTracer       — thread-safe bounded-ring span tracer; Chrome-
+                       trace/Perfetto export, one track per engine
+                       phase + one per serving slot (tracer.py)
+    FlightRecorder   — last-N-ticks ring + JSON postmortem dumped
+                       automatically on KVInvariantError / engine-loop
+                       crash (flight.py)
+    RecompileSentinel— jax.monitoring compile listener: any XLA compile
+                       after warmup becomes a labeled WARN metric, a
+                       named span and a RecompileWarning, cross-checked
+                       against the static program inventory
+                       (sentinel.py)
+
+Wired through ``serving.ServingEngine`` (``trace=``, ``flight_ticks=``,
+``recompile_sentinel=`` ctor knobs; on by default — measured overhead
+≤3% of tick wall, pinned by test) and surfaced by
+``tools/serving_bench.py --trace`` / ``--check-invariants`` and
+``graph_lint --json``'s ``observability`` block. See
+docs/OBSERVABILITY.md.
+"""
+from .flight import FlightRecorder, default_flight_dir  # noqa: F401
+from .sentinel import (COMPILE_EVENT, RECOMPILES_METRIC,  # noqa: F401
+                       RecompileSentinel, RecompileWarning)
+from .tracer import Span, SpanTracer, current_span  # noqa: F401
+
+__all__ = ["SpanTracer", "Span", "current_span", "FlightRecorder",
+           "default_flight_dir", "RecompileSentinel", "RecompileWarning",
+           "COMPILE_EVENT", "RECOMPILES_METRIC", "bridge_record_events"]
+
+
+def bridge_record_events(tracer: SpanTracer, track: str = "profiler"):
+    """Mirror every closing ``profiler.RecordEvent`` span into
+    ``tracer`` on one ``track`` — device-trace annotations and the
+    serving engine's own spans then read in the same Perfetto export.
+    Returns a zero-arg detach callable."""
+    from .. import profiler
+
+    def _sink(name, t0_s, t1_s):
+        tracer.add(name, track, t0_s, t1_s)
+
+    profiler.add_span_sink(_sink)
+
+    def detach():
+        profiler.remove_span_sink(_sink)
+    return detach

@@ -1,0 +1,189 @@
+"""Live recompile sentinel: the static ≤2-programs proof as an alarm.
+
+The recompile-hazard pass (analysis/recompile.py) *proves* at engine
+construction that the ragged serving dispatch reaches 1-2 programs per
+packed-width bucket. That proof is about reachable dispatch — it cannot
+see a mis-sized warmup, a config drift between blue/green restarts, or
+a jax upgrade quietly changing a trace key. Those failures all present
+the same way in production: an XLA compile *inside a serving tick*, a
+multi-second stall the p99 histogram only reports after the fact.
+
+The sentinel watches the real thing: ``jax.monitoring``'s
+``/jax/core/compile/backend_compile_duration`` event fires on every
+executable materialization in the process (including persistent-cache
+hits — a cache hit is still a program this process had not warmed, so
+it still counts; measured on jax 0.4.37). One module-level listener is
+registered once and dispatches to every live sentinel:
+
+* before ``arm()`` (warmup), compiles are counted but expected;
+* after ``arm()``, every compile is an alarm: a labeled WARN metric
+  (``recompiles{during=...}``), a span on the ``sentinel`` track named
+  after the innermost open span it interrupted ("compile during
+  serving.tick"), and a ``RecompileWarning``.
+
+``report()`` carries the engine's *expected* program inventory
+(``analysis.recompile.program_inventory`` — the same schema
+``graph_lint --json`` emits in its ``observability`` block), so the
+static and runtime views of "what should ever compile here" are one
+diffable document.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+import weakref
+from collections import deque
+from typing import Optional
+
+from .tracer import current_span
+
+__all__ = ["RecompileSentinel", "RecompileWarning", "COMPILE_EVENT",
+           "RECOMPILES_METRIC"]
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+# the prometheus series the sentinel's alarms land in
+# (ServingMetrics.expose: <prefix>_<counter>_total); graph_lint --json
+# names the same string in its observability block so CI consumers and
+# scrape configs share one source of truth
+RECOMPILES_METRIC = "paddle_serving_recompiles_total"
+
+
+class RecompileWarning(UserWarning):
+    """A post-warmup XLA compile was observed by a RecompileSentinel."""
+
+
+_installed = False
+_install_lock = threading.Lock()
+# live sentinels; weak so an abandoned engine cannot leak through the
+# process-wide listener
+_active: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _on_event_duration(event: str, duration: float, **_kw) -> None:
+    if event != COMPILE_EVENT:
+        return
+    for s in list(_active):
+        s._on_compile(duration)
+
+
+def _install_listener() -> None:
+    global _installed
+    with _install_lock:
+        if _installed:
+            return
+        from jax import monitoring
+        monitoring.register_event_duration_secs_listener(
+            _on_event_duration)
+        _installed = True
+
+
+class RecompileSentinel:
+    """Count and name every XLA compile; alarm on any after ``arm()``.
+
+        s = RecompileSentinel(expected=program_inventory(geom),
+                              tracer=tr, metrics=m, label="serving")
+        ... warmup traffic ...
+        s.arm()                      # warmup done: compiles now WARN
+        ... serve ...
+        s.report()["post_warmup_compiles"]   # 0 when clean
+
+    The listener fires on whichever thread ran the jit call, so the
+    event is named after that thread's innermost open tracer span —
+    for the serving engine that is the tick span that stalled.
+    ``close()`` detaches the sentinel (the process-wide listener stays,
+    dispatching to whoever remains).
+
+    Scope note: compile events are PROCESS-wide. A sentinel on an
+    otherwise-idle serving process attributes every post-warmup compile
+    to serving (the intent); co-resident non-serving jax work shows up
+    too and is distinguishable by its ``during`` span name.
+    """
+
+    def __init__(self, *, expected: Optional[dict] = None,
+                 tracer=None, metrics=None, label: str = "serving",
+                 max_events: int = 256):
+        self.expected = expected
+        self.label = label
+        self._tracer = tracer
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._armed_at: Optional[float] = None
+        self.warmup_compiles = 0
+        self.post_warmup_compiles = 0
+        self.events: "deque[dict]" = deque(maxlen=int(max_events))
+        self._closed = False
+        _install_listener()
+        _active.add(self)
+
+    # ------------------------------------------------------------ state ----
+    @property
+    def armed(self) -> bool:
+        return self._armed_at is not None
+
+    @property
+    def clean(self) -> bool:
+        """True when no compile has been seen since ``arm()``."""
+        return self.post_warmup_compiles == 0
+
+    def arm(self) -> None:
+        """Declare warmup complete: every later compile is an alarm.
+        Idempotent (re-arming does not forgive earlier alarms)."""
+        with self._lock:
+            if self._armed_at is None:
+                self._armed_at = time.monotonic()
+
+    def close(self) -> None:
+        """Stop observing (engine shutdown)."""
+        self._closed = True
+        _active.discard(self)
+
+    # --------------------------------------------------------- listener ----
+    def _on_compile(self, duration: float) -> None:
+        if self._closed:
+            return
+        during = current_span()
+        now = time.monotonic()
+        with self._lock:
+            armed = self._armed_at is not None
+            ev = {"t_s": now, "compile_s": float(duration),
+                  "during": during,
+                  "phase": "post_warmup" if armed else "warmup"}
+            self.events.append(ev)
+            if not armed:
+                self.warmup_compiles += 1
+                return
+            self.post_warmup_compiles += 1
+        name = f"compile during {during}" if during else \
+            "compile (no active span)"
+        if self._metrics is not None:
+            try:
+                self._metrics.inc("recompiles")
+                self._metrics.inc_labeled(
+                    "recompiles", during=during or "idle")
+            except Exception:
+                pass
+        if self._tracer is not None:
+            self._tracer.add(name, "sentinel", now - duration, now,
+                             compile_s=round(float(duration), 6))
+        warnings.warn(
+            f"[{self.label}] post-warmup XLA compile "
+            f"({duration * 1e3:.1f} ms) — {name}; the one-program-tick "
+            f"warmup did not cover this program (see "
+            f"docs/OBSERVABILITY.md recompile sentinel)",
+            RecompileWarning, stacklevel=2)
+
+    # ------------------------------------------------------------ export ----
+    def report(self) -> dict:
+        """Plain-dict sentinel state: counts, recent events, the
+        expected static program inventory, and ``clean``."""
+        with self._lock:
+            return {
+                "label": self.label,
+                "armed": self._armed_at is not None,
+                "warmup_compiles": self.warmup_compiles,
+                "post_warmup_compiles": self.post_warmup_compiles,
+                "clean": self.post_warmup_compiles == 0,
+                "expected_programs": self.expected,
+                "events": list(self.events),
+            }

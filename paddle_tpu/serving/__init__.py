@@ -14,9 +14,14 @@ shape (PAPERS.md).
     PrefixCache     — refcounted cross-request KV page reuse
                       (serving/prefix_cache.py)
     RequestHandle   — per-request token stream / blocking result
-    ServingMetrics  — counters + latency histograms (serving/metrics.py)
+    ServingMetrics  — counters + latency histograms + Prometheus text
+                      exposition (serving/metrics.py)
 
-See docs/SERVING.md for architecture, knobs, and metrics.
+Runtime observability (span tracer, flight-recorder postmortems, the
+live recompile sentinel) lives in paddle_tpu/observability/ and is
+wired through the engine's ``trace=`` / ``flight_ticks=`` /
+``recompile_sentinel=`` knobs. See docs/SERVING.md for architecture
+and docs/OBSERVABILITY.md for the span taxonomy and postmortem format.
 """
 from .engine import ServingEngine  # noqa: F401
 from .metrics import Histogram, ServingMetrics  # noqa: F401

@@ -42,6 +42,16 @@ Tokens stream to callers through per-request iterators
 (``RequestHandle``); ``close()`` drains gracefully. Counters and
 latency histograms live in serving/metrics.py; prefill/decode spans are
 ``profiler.RecordEvent``-annotated so they land in device traces.
+
+Runtime observability (ISSUE r13, paddle_tpu/observability/): every
+tick records engine-phase and per-slot lifecycle spans into a bounded
+ring (``export_trace(path)`` -> Perfetto), the flight recorder keeps
+the last N ticks + state snapshots and dumps a JSON postmortem
+automatically when a ``KVInvariantError`` or engine-loop crash kills
+the worker, and the recompile sentinel turns any post-warmup XLA
+compile into a named WARN metric + ``RecompileWarning`` — the runtime
+alarm form of the static ≤2-programs-per-bucket recompile proof. See
+docs/OBSERVABILITY.md.
 """
 from __future__ import annotations
 
@@ -56,6 +66,7 @@ import numpy as np
 from collections import deque
 
 from ..inference.paged_kv import PagePool, apply_defrag
+from ..observability import FlightRecorder, RecompileSentinel, SpanTracer
 from ..profiler import RecordEvent
 from .metrics import ServingMetrics
 from .prefix_cache import PrefixCache
@@ -63,6 +74,13 @@ from .scheduler import (CANCELLED, COMPLETED, REJECTED, TIMED_OUT,
                         Request, RequestHandle, Scheduler)
 
 __all__ = ["ServingEngine"]
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
 
 
 def _resolve_model(model, cfg):
@@ -207,6 +225,28 @@ class ServingEngine:
     substitution at jit-trace time, compile-time cost only). Greedy
     outputs remain byte-identical to the unrewritten engine
     (tests/test_rewrite.py exactness pin).
+    trace: span tracing (observability/tracer.py): per-tick engine
+    phase spans (admission / prefill+decode tick / defrag / invariant
+    audit) and per-request lifecycle spans (queue -> prefill chunks ->
+    decode ticks -> retire) on one track per slot, ring-bounded,
+    exportable as Perfetto JSON via ``export_trace(path)``. Default
+    from ``PADDLE_TPU_SERVING_TRACE`` (on when unset); measured
+    overhead ≤3% of tick wall (docs/OBSERVABILITY.md), so it stays on
+    in production.
+    flight_ticks / flight_dir: the flight recorder keeps the last N
+    tick records + state snapshots; on ``KVInvariantError`` or any
+    unhandled engine-loop exception a JSON postmortem (recent ticks,
+    span window, metrics, scheduler/pool/prefix state, the violation
+    list, expected program inventory) is written under ``flight_dir``
+    (default ``PADDLE_TPU_FLIGHT_DIR`` or ``<tmp>/paddle_tpu_flight``)
+    and the path lands in ``self.postmortem_path``.
+    recompile_sentinel: watch ``jax.monitoring`` compile events at
+    runtime (observability/sentinel.py): after ``arm_sentinel()``
+    declares warmup done, ANY XLA compile raises a named
+    ``RecompileWarning``, increments the labeled ``recompiles`` metric
+    and records a sentinel span — the runtime alarm form of the static
+    ≤2-programs-per-bucket proof. Default from
+    ``PADDLE_TPU_SERVING_SENTINEL`` (on when unset).
     """
 
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
@@ -221,7 +261,12 @@ class ServingEngine:
                  prefill_chunk: Optional[int] = None,
                  admission_window: int = 0,
                  check_invariants: Optional[bool] = None,
-                 rewrites: bool = False):
+                 rewrites: bool = False,
+                 trace: Optional[bool] = None,
+                 trace_capacity: int = 65536,
+                 flight_ticks: int = 64,
+                 flight_dir: Optional[str] = None,
+                 recompile_sentinel: Optional[bool] = None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if prefill_chunk is not None:
@@ -289,14 +334,19 @@ class ServingEngine:
         # warns at construction instead of stalling under traffic; the
         # warning names the offending program set.
         from ..analysis.recompile import (ServingGeometry,
-                                          enumerate_tick_programs)
-        programs = enumerate_tick_programs(ServingGeometry(
+                                          program_inventory)
+        geom = ServingGeometry(
             page_size=page_size, pages_per_slot=pages_per_slot,
             buckets=list(self._buckets),
             attach_quantum=1 if self.prefix_cache is not None else 0,
             prefill_chunk=prefill_chunk, ragged=True,
-            max_batch=max_batch, decode_block=self._decode_block))
-        worst = max((len(v) for v in programs.values()), default=0)
+            max_batch=max_batch, decode_block=self._decode_block)
+        # the static proof's inventory, kept on the engine: the
+        # recompile sentinel reports it as "expected", the flight
+        # recorder ships it with every postmortem, and graph_lint
+        # --json emits the identical schema — one diffable document
+        self.program_inventory = program_inventory(geom)
+        worst = self.program_inventory["programs_per_bucket"]
         if worst > 2:
             import warnings
             warnings.warn(
@@ -305,13 +355,12 @@ class ServingEngine:
                 f"prefill_chunk={prefill_chunk}, "
                 f"decode_block={self._decode_block}) reaches {worst} "
                 f"distinct tick programs in one width bucket (> 2): "
-                f"{ {w: sorted(v) for w, v in sorted(programs.items())} }"
+                f"{self.program_inventory['widths']}"
                 f" — each is an XLA compile inside a serving tick; see "
                 f"docs/ANALYSIS.md recompile-hazard.", stacklevel=2)
         if check_invariants is None:
-            check_invariants = os.environ.get(
-                "PADDLE_TPU_SERVING_CHECK_INVARIANTS", ""
-            ).strip().lower() in ("1", "true", "yes", "on")
+            check_invariants = _env_flag(
+                "PADDLE_TPU_SERVING_CHECK_INVARIANTS", False)
         self._check_invariants = bool(check_invariants)
         self.scheduler = Scheduler(
             max_batch=max_batch, pages_per_slot=pages_per_slot,
@@ -319,6 +368,22 @@ class ServingEngine:
             max_prompt_len=max_bucket, prefix_cache=self.prefix_cache,
             admission_window=admission_window)
         self.metrics = ServingMetrics()
+        # ------------------------------------------- observability ----
+        if trace is None:
+            trace = _env_flag("PADDLE_TPU_SERVING_TRACE", True)
+        self.tracer = SpanTracer(capacity=trace_capacity,
+                                 enabled=bool(trace))
+        self.flight = FlightRecorder(capacity=flight_ticks)
+        self._flight_dir = flight_dir
+        self.postmortem_path: Optional[str] = None
+        if recompile_sentinel is None:
+            recompile_sentinel = _env_flag("PADDLE_TPU_SERVING_SENTINEL",
+                                           True)
+        self.sentinel = RecompileSentinel(
+            expected=self.program_inventory, tracer=self.tracer,
+            metrics=self.metrics, label="serving-engine") \
+            if recompile_sentinel else None
+        self._tick_no = 0
 
         pools = self._mod.init_serving_pages(cfg, total_pages, page_size)
         self._kp, self._vp = pools["k_pages"], pools["v_pages"]
@@ -397,11 +462,15 @@ class ServingEngine:
         queued + running request first; drain=False cancels them."""
         with self._cond:
             if self._dead is not None and not self._worker.is_alive():
+                if self.sentinel is not None:
+                    self.sentinel.close()
                 return
             self._closing = True
             self._drain = drain
             self._cond.notify_all()
         self._worker.join()
+        if self.sentinel is not None:
+            self.sentinel.close()
 
     def __enter__(self):
         return self
@@ -409,18 +478,69 @@ class ServingEngine:
     def __exit__(self, *exc):
         self.close()
 
-    def stats(self) -> dict:
-        """Plain-dict metrics snapshot (+ live pool/queue gauges)."""
-        snap = self.metrics.snapshot()
-        snap["gauges"] = {
+    def _gauges(self) -> dict:
+        """Live pool/queue gauges. Caller must hold ``_tick_lock``:
+        occupancy / utilization / prefix stats walk structures the
+        engine loop mutates mid-tick (slot list, free list, trie), so
+        an unlocked read can see a torn view or a dict resized under
+        iteration. The metrics lock alone is NOT enough — the loop
+        only holds it inside inc()/observe(), not while it mutates the
+        scheduler."""
+        g = {
             "queued": self.scheduler.queued(),
             "occupancy": self.scheduler.occupancy,
             "page_utilization": self.pool.utilization,
             "free_pages": self.pool.free_pages,
         }
         if self.prefix_cache is not None:
-            snap["gauges"]["prefix_cache"] = self.prefix_cache.stats()
+            g["prefix_cache"] = self.prefix_cache.stats()
+        return g
+
+    def snapshot(self) -> dict:
+        """Plain-dict metrics snapshot (+ live pool/queue gauges).
+        Safe to call from any thread concurrently with the engine
+        loop: counters/histograms are copied under the metrics lock
+        and gauges are read under the tick lock (serialized against
+        the loop's scheduler/pool mutations — see ``_gauges``)."""
+        snap = self.metrics.snapshot()
+        with self._tick_lock:
+            snap["gauges"] = self._gauges()
         return snap
+
+    def stats(self) -> dict:
+        """Alias of :meth:`snapshot` (the pre-r13 name)."""
+        return self.snapshot()
+
+    def expose(self) -> str:
+        """Prometheus text exposition of counters + histograms + live
+        gauges (``ServingMetrics.expose`` — dependency-free; serve it
+        from any HTTP handler). Thread-safe like :meth:`snapshot`."""
+        with self._tick_lock:
+            g = self._gauges()
+        flat = {}
+        for k, v in g.items():
+            if isinstance(v, dict):
+                flat.update({f"{k}_{kk}": vv for kk, vv in v.items()
+                             if isinstance(vv, (int, float))})
+            elif isinstance(v, (int, float)):
+                flat[k] = v
+        return self.metrics.expose(gauges=flat)
+
+    def export_trace(self, path: str) -> str:
+        """Write the span tracer's ring as Perfetto-loadable
+        Chrome-trace JSON (one track per engine phase + per slot);
+        returns ``path``."""
+        return self.tracer.export(path)
+
+    def arm_sentinel(self) -> None:
+        """Declare warmup complete: from now on, ANY XLA compile in
+        this process raises ``RecompileWarning`` and increments the
+        labeled ``recompiles`` counter (no-op when the sentinel is
+        disabled). Call after traffic has touched every width-grid
+        entry — ``tools/serving_bench.py`` does this after its warmup
+        pass."""
+        if self.sentinel is not None:
+            self.sentinel.arm()
 
     def audit(self):
         """Standalone paged-KV invariant audit (serialized against
@@ -446,9 +566,10 @@ class ServingEngine:
         """Per-tick debug-mode check (caller holds the tick lock)."""
         from ..analysis.kv_invariants import (KVInvariantError,
                                               audit_serving_state)
-        violations = audit_serving_state(
-            self.pool, self.scheduler, self.prefix_cache,
-            prefill_queue=tuple(self._prefill_q))
+        with self.tracer.span("serving.audit", track="engine.audit"):
+            violations = audit_serving_state(
+                self.pool, self.scheduler, self.prefix_cache,
+                prefill_queue=tuple(self._prefill_q))
         if violations:
             self.metrics.inc("invariant_violations", len(violations))
             raise KVInvariantError(violations,
@@ -459,7 +580,8 @@ class ServingEngine:
         defrag hook): rewrites the pool arrays + every live slot's table
         row, then commits the plan to the allocator. Returns the number
         of pages moved. Safe mid-generation (serialized against ticks)."""
-        with self._tick_lock:
+        with self._tick_lock, \
+                self.tracer.span("serving.defrag", track="engine.defrag"):
             plan = self.pool.defrag_plan()
             if not plan:
                 return 0
@@ -484,8 +606,86 @@ class ServingEngine:
                 self.prefix_cache.remap(plan)  # cached-node page ids
             self.pool.commit_defrag(plan)
             if self._check_invariants:
-                self._audit_or_raise()
+                try:
+                    self._audit_or_raise()
+                except BaseException as e:
+                    # defrag corrupted state: the caller gets the
+                    # raise, the postmortem gets the geometry + plan
+                    try:
+                        self._write_postmortem(e)
+                    except Exception:
+                        pass    # a failing dump must not mask the error
+                    raise
             return len(plan)
+
+    # ----------------------------------------------------- observability ----
+    def _record_tick(self, t0: float, t1: float, live, spans,
+                     admitted: int) -> None:
+        """Per-tick evidence (caller holds the tick lock): slot-track
+        spans for each live decoder and prefill span, plus one compact
+        flight-recorder record with the tick's geometry and the live
+        pool/queue gauges. Requests may have retired inside the tick —
+        only ids are used, never slot re-reads."""
+        tick = self._tick_no
+        self._tick_no += 1
+        if self.tracer.enabled:
+            for slot, req in live:
+                self.tracer.add("decode", f"slot{slot}", t0, t1,
+                                req=req.id, tick=tick)
+            for slot, req, start, take in spans:
+                self.tracer.add("prefill.chunk", f"slot{slot}", t0, t1,
+                                req=req.id, tick=tick, start=int(start),
+                                tokens=int(take))
+        self.flight.record_tick(
+            tick=tick, t_mono_s=round(t0, 6), dur_s=round(t1 - t0, 6),
+            live=len(live), prefill_spans=len(spans),
+            span_tokens=int(sum(t for _, _, _, t in spans)),
+            admitted=int(admitted), queued=self.scheduler.queued(),
+            occupancy=self.scheduler.occupancy,
+            free_pages=self.pool.free_pages,
+            prefill_queue_depth=len(self._prefill_q))
+
+    def _write_postmortem(self, e: BaseException) -> str:
+        """Dump the flight-recorder postmortem: the error (with the
+        KV-invariant violation list when that is the killer), engine
+        geometry + expected program inventory, the last-N tick records,
+        the span-tracer window, a metrics snapshot, and the scheduler/
+        PagePool/PrefixCache state at death. Returns the path written
+        (also kept in ``self.postmortem_path``)."""
+        slots = []
+        for slot, req in enumerate(self.scheduler.slots):
+            if req is None:
+                continue
+            slots.append({
+                "slot": slot, "req": req.id, "state": req.state,
+                "length": int(self.scheduler.lengths[slot]),
+                "prefilling": bool(req.prefilling),
+                "chunk_done": int(req.chunk_done),
+                "cached_len": int(req.cached_len),
+                "private_pages": len(req.pages),
+                "prefix_pages": len(req.prefix_nodes),
+                "row": self.scheduler.effective_row(slot).tolist(),
+            })
+        state = {
+            "slots": slots,
+            "queued": self.scheduler.queued(),
+            "prefill_queue": [req.id for _, req in self._prefill_q],
+            "pool": {"total_pages": self.pool.total_pages,
+                     "page_size": self.pool.page_size,
+                     "free_pages": self.pool.free_pages},
+        }
+        if self.prefix_cache is not None:
+            state["prefix_cache"] = self.prefix_cache.stats()
+        spans = [s.to_dict() for s in self.tracer.spans()] \
+            if self.tracer.enabled else None
+        self.postmortem_path = self.flight.dump(
+            dir=self._flight_dir, error=e,
+            geometry=self._geometry_desc(),
+            programs=self.program_inventory, state=state, spans=spans,
+            metrics=self.metrics.snapshot(),
+            sentinel=(self.sentinel.report()
+                      if self.sentinel is not None else None))
+        return self.postmortem_path
 
     # ------------------------------------------------------------ worker ----
     def _sample(self, slot: int, req: Request, logits_row: np.ndarray) -> int:
@@ -506,6 +706,11 @@ class ServingEngine:
         if req.first_token_t is None:
             req.first_token_t = now
             self.metrics.observe("ttft_s", now - req.submit_t)
+            # retroactive span on the SAME timestamps as the metric
+            # observation: the exported TTFT span and the ttft_s
+            # histogram reconcile exactly (same monotonic clock)
+            self.tracer.add("ttft", f"slot{slot}", req.submit_t, now,
+                            req=req.id)
         req.tokens.append(tok)
         req.stream.put(tok)
         self._produced[slot] += 1
@@ -516,12 +721,16 @@ class ServingEngine:
         return bool(done)
 
     def _retire(self, slot: int, state: str) -> None:
-        self.scheduler.retire(slot, state)
+        req = self.scheduler.retire(slot, state)
         self._cur_tok[slot] = 0
         self._produced[slot] = 0
         self._keys[slot] = None
         self.metrics.inc({COMPLETED: "completed", CANCELLED: "cancelled",
                           TIMED_OUT: "timed_out"}[state])
+        # whole-lifecycle span, submit -> retirement, on the slot track
+        self.tracer.add("request", f"slot{slot}", req.submit_t,
+                        req.finish_t, req=req.id, state=state,
+                        tokens=len(req.tokens))
 
     def _emit_greedy(self, slot: int, req: Request, toks_row,
                      j0: int, j1: int) -> None:
@@ -675,7 +884,11 @@ class ServingEngine:
                     last=jnp.asarray(last), tables=jnp.asarray(tabs),
                     tail_live=jnp.asarray(tail_live))
         t0 = time.perf_counter()
-        with RecordEvent("serving.tick"):
+        with RecordEvent("serving.tick"), \
+                self.tracer.span("serving.tick", track="engine.decode",
+                                 tick=self._tick_no, width=int(width),
+                                 live=len(live), span_tokens=int(span_tok),
+                                 tail=int(tail)):
             toks_d, logits_d, self._kp, self._vp = self._tick_jit(
                 self._params, jnp.asarray(tok), meta, self._kp, self._vp,
                 tq=tq, decode_tail=tail)
@@ -726,7 +939,10 @@ class ServingEngine:
         jnp = self._jnp
         k = self._decode_block
         t0 = time.perf_counter()
-        with RecordEvent("serving.decode_step"):
+        with RecordEvent("serving.decode_step"), \
+                self.tracer.span("serving.tick", track="engine.decode",
+                                 tick=self._tick_no, kind="block",
+                                 live=len(live), steps=k):
             toks, self._kp, self._vp = self._block_jit(
                 self._params, jnp.asarray(self._cur_tok),
                 jnp.asarray(self.scheduler.lengths),
@@ -782,12 +998,28 @@ class ServingEngine:
                     self._sweep(now)
                     if self._closing and not self._drain:
                         break
+                    t_adm = time.monotonic()
                     with RecordEvent("serving.admit"):
                         admitted = self.scheduler.admit()
+                    if admitted:
+                        # recorded only when work happened: an idle
+                        # engine polls admission every 50ms and must
+                        # not slowly flush real spans out of the ring
+                        self.tracer.add("serving.admission",
+                                        "engine.admission", t_adm,
+                                        time.monotonic(),
+                                        admitted=len(admitted))
                     for slot, req in admitted:
                         self.metrics.inc("admitted")
                         self.metrics.observe("queue_wait_s",
                                              req.admit_t - req.submit_t)
+                        # queue-wait span, retroactive on the request's
+                        # own submit/admit stamps (== the observation)
+                        self.tracer.add("queue", f"slot{slot}",
+                                        req.submit_t, req.admit_t,
+                                        req=req.id,
+                                        prompt=int(req.prompt.size),
+                                        cached=int(req.cached_len))
                         self._park(slot, req)
                     spans = self._collect_spans()
                     live = self.scheduler.live()
@@ -810,9 +1042,13 @@ class ServingEngine:
                             self.metrics.observe(
                                 "decode_stall_s",
                                 t - self._last_decode_t)
+                        t_tick0 = time.monotonic()
                         self._decode_tick(live, spans)
+                        t_tick1 = time.monotonic()
                         self._last_decode_t = (time.perf_counter()
                                                if live else None)
+                        self._record_tick(t_tick0, t_tick1, live, spans,
+                                          len(admitted))
                     else:
                         self._last_decode_t = None
                     if ticked and self._check_invariants:
@@ -832,6 +1068,17 @@ class ServingEngine:
                     self._cond.wait(timeout=0.05)
         except BaseException as e:  # fail every caller, then surface
             self._dead = e
+            try:
+                # the postmortem snapshots PRE-failure state, so it
+                # must be written before _fail_all retires everything —
+                # and under the tick lock (released when the raise
+                # unwound the with-block): a caller blocked in
+                # defragment() must not rewrite pool/rows/trie while
+                # the dump walks them
+                with self._tick_lock:
+                    self._write_postmortem(e)
+            except Exception:
+                pass        # a failing dump must not mask the error
             self._fail_all(e)
             raise
         finally:

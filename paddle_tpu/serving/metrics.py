@@ -4,14 +4,17 @@ Reference capability: the inference product's serving monitors
 (request/batch counters the AnalysisPredictor frontends export). The
 engine records every observation here; ``snapshot()`` returns a plain
 dict so any exporter (logging, JSON endpoint, test assertion) can
-consume it without a metrics dependency. Host spans additionally ride
-``profiler.RecordEvent`` (engine.py), so prefill/decode ticks show up
-in device traces and ``profiler.host_statistics()``.
+consume it without a metrics dependency, and ``expose()`` renders the
+same state as dependency-free Prometheus text exposition for a real
+scrape endpoint. Host spans additionally ride ``profiler.RecordEvent``
+and the observability span tracer (engine.py), so prefill/decode ticks
+show up in device traces, ``profiler.host_statistics()`` and Perfetto
+exports.
 """
 from __future__ import annotations
 
 import threading
-from typing import Dict
+from typing import Dict, Optional, Tuple
 
 import numpy as np
 
@@ -19,11 +22,25 @@ __all__ = ["Histogram", "ServingMetrics"]
 
 
 class Histogram:
-    """Bounded-reservoir histogram: exact percentiles over the last
-    ``cap`` observations (serving runs are minutes, not months — a
-    65k-deep window is exact in practice and keeps summary() trivial).
-    The window is a deque(maxlen): O(1) per observation on the decode
-    hot path, not an O(cap) list memmove once the window fills."""
+    """Windowed-reservoir histogram over the last ``cap`` observations.
+
+    Two kinds of statistics coexist, with different windows:
+
+    * **lifetime** — ``count`` and ``mean`` come from running
+      ``_count``/``_sum`` totals over EVERY observation ever made;
+    * **windowed** — ``window_mean``, ``p50``, ``p99`` and ``max`` are
+      computed over only the last ``cap`` observations (the deque
+      window; exact until the stream exceeds ``cap``, then a sliding
+      recent view).
+
+    Serving runs are minutes, not months, so a 65k-deep window is exact
+    in practice — but once it wraps, lifetime ``mean`` and windowed
+    percentiles describe DIFFERENT populations, which is why
+    ``summary()`` reports both means explicitly instead of mixing them
+    (the pre-r13 bug: a lifetime mean sat next to windowed percentiles
+    with nothing marking the split). The window is a deque(maxlen):
+    O(1) per observation on the decode hot path, not an O(cap) list
+    memmove once the window fills."""
 
     def __init__(self, cap: int = 65536):
         from collections import deque
@@ -37,16 +54,31 @@ class Histogram:
         self._sum += v
         self._vals.append(v)
 
+    @property
+    def lifetime_sum(self) -> float:
+        return self._sum
+
     def summary(self) -> Dict[str, float]:
+        """``count``/``mean`` are lifetime; ``window_count``/
+        ``window_mean``/``p50``/``p99``/``max`` cover only the last
+        ``cap`` observations (see class docstring)."""
         if not self._vals:
-            return {"count": 0, "mean": 0.0, "p50": 0.0, "p99": 0.0,
+            return {"count": 0, "mean": 0.0, "window_count": 0,
+                    "window_mean": 0.0, "p50": 0.0, "p99": 0.0,
                     "max": 0.0}
         a = np.asarray(self._vals)
         return {"count": self._count,
                 "mean": self._sum / self._count,
+                "window_count": int(a.size),
+                "window_mean": float(a.mean()),
                 "p50": float(np.percentile(a, 50)),
                 "p99": float(np.percentile(a, 99)),
                 "max": float(a.max())}
+
+
+def _prom_escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace("\n", r"\n") \
+                 .replace('"', r'\"')
 
 
 class ServingMetrics:
@@ -57,7 +89,15 @@ class ServingMetrics:
     decode_steps, tokens_out), prefix-cache effectiveness (prefix_hits /
     prefix_misses per admission, prefix_hit_tokens — prompt tokens NOT
     recomputed, prefix_pages_saved — pages attached instead of
-    allocated).
+    allocated), invariant_violations, recompiles (post-warmup XLA
+    compiles the recompile sentinel observed).
+    Labeled counters (``inc_labeled``): the same monotonic semantics
+    with a small label set — e.g. ``recompiles{during="serving.tick"}``
+    names WHAT a post-warmup compile interrupted. Kept separate from
+    the flat counters (no dependency, no cardinality surprises:
+    callers own their label values), and exposed as their own
+    ``*_breakdown_total`` Prometheus family so aggregating either
+    family never double-counts.
     Histograms: queue_wait_s (submit -> admission), ttft_s (submit ->
     first token), decode_step_s (one engine tick), decode_stall_s (gap
     between consecutive decode ticks while streams are live — the
@@ -65,14 +105,17 @@ class ServingMetrics:
     admission shows up here as one huge stall), batch_occupancy (live
     slots / max_batch per tick), page_utilization (used / allocatable
     pages, sampled per tick), chunk_queue_depth (requests mid
-    chunked-prefill, sampled per tick).
+    chunked-prefill, sampled per tick). Histogram summaries report the
+    lifetime mean AND the windowed mean/percentiles separately — see
+    :class:`Histogram`.
     """
 
     COUNTERS = ("submitted", "admitted", "completed", "cancelled",
                 "timed_out", "rejected", "prefills", "prefill_chunks",
                 "decode_steps", "tokens_out", "prefix_hits",
                 "prefix_misses", "prefix_hit_tokens",
-                "prefix_pages_saved", "invariant_violations")
+                "prefix_pages_saved", "invariant_violations",
+                "recompiles")
     HISTOGRAMS = ("queue_wait_s", "ttft_s", "decode_step_s",
                   "decode_stall_s", "batch_occupancy",
                   "page_utilization", "chunk_queue_depth")
@@ -81,19 +124,91 @@ class ServingMetrics:
         self._lock = threading.Lock()
         self.counters = {k: 0 for k in self.COUNTERS}
         self.histograms = {k: Histogram() for k in self.HISTOGRAMS}
+        # name -> {tuple(sorted(label items)) -> count}
+        self.labeled: Dict[str, Dict[Tuple[Tuple[str, str], ...], int]] \
+            = {}
 
     def inc(self, name: str, n: int = 1) -> None:
         with self._lock:
             self.counters[name] += n
+
+    def inc_labeled(self, name: str, n: int = 1, **labels) -> None:
+        """Monotonic labeled counter, e.g.
+        ``inc_labeled("recompiles", during="serving.tick")``."""
+        key = tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+        with self._lock:
+            series = self.labeled.setdefault(name, {})
+            series[key] = series.get(key, 0) + n
 
     def observe(self, name: str, v: float) -> None:
         with self._lock:
             self.histograms[name].observe(v)
 
     def snapshot(self) -> Dict[str, Dict]:
-        """Plain-dict export: {'counters': {...}, 'histograms':
-        {name: {count, mean, p50, p99, max}}}."""
+        """Plain-dict export: {'counters': {...}, 'labeled': {name:
+        [{labels, value}]}, 'histograms': {name: {count, mean,
+        window_count, window_mean, p50, p99, max}}}."""
         with self._lock:
             return {"counters": dict(self.counters),
+                    "labeled": {
+                        name: [{"labels": dict(key), "value": v}
+                               for key, v in sorted(series.items())]
+                        for name, series in self.labeled.items()},
                     "histograms": {k: h.summary()
                                    for k, h in self.histograms.items()}}
+
+    # -------------------------------------------------- prometheus text ----
+    def expose(self, prefix: str = "paddle_serving",
+               gauges: Optional[Dict[str, float]] = None) -> str:
+        """Dependency-free Prometheus text exposition (format 0.0.4).
+
+        Flat counters become ``<prefix>_<name>_total``; labeled
+        counters become their OWN family
+        ``<prefix>_<name>_breakdown_total`` — never samples of the
+        flat family, because mixing an unlabeled total with labeled
+        slices of the same quantity in one family makes
+        ``sum(rate(...))`` double-count (and mixing empty/non-empty
+        label sets violates the Prometheus data model). Histograms
+        become summaries — ``{quantile="0.5"|"0.99"}`` windowed
+        quantiles plus LIFETIME ``_sum``/``_count`` (the Prometheus
+        summary contract: _sum/_count are monotonic lifetime series a
+        scraper can rate(); quantiles are the recent window).
+        ``gauges`` (optional {name: value}) are emitted as
+        ``<prefix>_<name>`` gauge samples — the engine passes its live
+        pool/queue gauges. A gauge whose name collides with a
+        histogram family (e.g. the live ``page_utilization`` gauge vs
+        the per-tick ``page_utilization`` histogram) is emitted as
+        ``<prefix>_<name>_now``: one metric family must not carry two
+        TYPEs, or the whole scrape is rejected.
+        """
+        with self._lock:
+            counters = dict(self.counters)
+            labeled = {n: dict(s) for n, s in self.labeled.items()}
+            hists = {k: (h.summary(), h.lifetime_sum)
+                     for k, h in self.histograms.items()}
+        lines = []
+        for name, v in sorted(counters.items()):
+            metric = f"{prefix}_{name}_total"
+            lines.append(f"# TYPE {metric} counter")
+            lines.append(f"{metric} {v}")
+        for name, series in sorted(labeled.items()):
+            metric = f"{prefix}_{name}_breakdown_total"
+            lines.append(f"# TYPE {metric} counter")
+            for key, lv in sorted(series.items()):
+                lbl = ",".join(
+                    f'{k}="{_prom_escape(val)}"' for k, val in key)
+                lines.append(f"{metric}{{{lbl}}} {lv}")
+        for name, (s, life_sum) in sorted(hists.items()):
+            metric = f"{prefix}_{name}"
+            lines.append(f"# TYPE {metric} summary")
+            lines.append(f'{metric}{{quantile="0.5"}} {s["p50"]:.9g}')
+            lines.append(f'{metric}{{quantile="0.99"}} {s["p99"]:.9g}')
+            lines.append(f"{metric}_sum {life_sum:.9g}")
+            lines.append(f"{metric}_count {s['count']}")
+        for name, v in sorted((gauges or {}).items()):
+            if name in hists:
+                name = f"{name}_now"    # family collision (docstring)
+            metric = f"{prefix}_{name}"
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {float(v):.9g}")
+        return "\n".join(lines) + "\n"

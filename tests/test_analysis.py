@@ -350,6 +350,12 @@ def test_graph_lint_json_reports_serving_program_set(capsys):
     assert sp["programs_per_bucket"] <= 2
     assert sp["total"] >= 2
     assert all(len(progs) <= 2 for progs in sp["widths"].values())
+    # r13: the observability block carries the SAME inventory dict the
+    # runtime recompile sentinel reports as expected_programs — static
+    # and runtime views share one schema
+    sent = out["observability"]["sentinel"]
+    assert sent["expected_programs"] == sp
+    assert sent["metric"] == "paddle_serving_recompiles_total"
 
 
 def test_prefix_attach_is_exact(params):

@@ -283,7 +283,10 @@ def test_metrics_snapshot_shape(params):
     assert c["tokens_out"] == 4
     for name in ("queue_wait_s", "ttft_s", "decode_step_s",
                  "batch_occupancy", "page_utilization"):
-        assert set(h[name]) == {"count", "mean", "p50", "p99", "max"}
+        # lifetime (count/mean) AND windowed (window_*/percentiles)
+        # stats are reported separately — see Histogram docstring
+        assert set(h[name]) == {"count", "mean", "window_count",
+                                "window_mean", "p50", "p99", "max"}
     assert h["ttft_s"]["count"] == 1
     assert 0 < h["batch_occupancy"]["max"] <= 1.0
     assert snap["gauges"]["free_pages"] == eng.pool.free_pages

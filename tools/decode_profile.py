@@ -44,14 +44,21 @@ ragged tick (``serving_tick_block`` at num_steps=1) and the legacy
 variant (the r11 trace-cache lesson) — reporting XLA flops/bytes per
 step and the slope-timed ratio.
 
+``trace=out.json`` records one observability span per measured section
+(per-variant whole-step / tail / embed slope chains, the rewrite and
+ragged A/B arms) and exports them as Perfetto-loadable Chrome-trace
+JSON — the same exporter ``serving_bench --trace`` uses, so a profile
+session and a serving run read in the same UI.
+
 Usage:
   python tools/decode_profile.py [flagship|deep|mid|tiny] [int8] [json]
-      [rewrites] [ragged] [bw=819e9] [steps=64]
+      [rewrites] [ragged] [trace=out.json] [bw=819e9] [steps=64]
 
 ``flagship`` is the 1.72B bench model (TPU-sized; expect minutes per
 chain on CPU); ``mid`` (0.17B) profiles the same shape story at
 CPU-friendly cost. Default: mid off-TPU, flagship on TPU.
 """
+import contextlib
 import json
 import os
 import sys
@@ -66,6 +73,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from paddle_tpu.models import llama as L
 from paddle_tpu.quantization.decode import (decode_weight_bytes,
                                             quantize_for_decode)
+
+# module-level so the measured helpers can annotate their sections
+# without threading a tracer through every signature; None = no-op
+_TRACER = None
+
+
+def _span(name, **args):
+    if _TRACER is None:
+        return contextlib.nullcontext()
+    return _TRACER.span(name, track="decode_profile", **args)
+
 
 PRESETS = {
     # bench.py flagship: the 1.72B decode whose 176.7 tok/s (BENCH_r05)
@@ -126,7 +144,8 @@ def profile(params, cfg, steps, prompt_len=32):
         out = gens[n](params, prompt)
         int(out[0, -1])  # host read: the only reliable sync everywhere
 
-    step_s = slope(run_gen, n0, n1)
+    with _span("step_slope"):
+        step_s = slope(run_gen, n0, n1)
 
     # tail: final_norm + lm_head + greedy sample, jitted alone on a
     # captured hidden state (chained via a data dependency so the chain
@@ -150,7 +169,8 @@ def profile(params, cfg, steps, prompt_len=32):
     def run_tail(n):
         int(np.asarray(tails[n](params, h))[-1, 0])
 
-    tail_s = slope(run_tail, n0, n1)
+    with _span("tail_slope"):
+        tail_s = slope(run_tail, n0, n1)
 
     # embed lookup in isolation (chained through an index dependency)
     def embed_n(p, n):
@@ -168,7 +188,8 @@ def profile(params, cfg, steps, prompt_len=32):
     def run_embed(n):
         float(np.asarray(embeds[n](params))[-1])
 
-    embed_s = slope(run_embed, n0, n1)
+    with _span("embed_slope"):
+        embed_s = slope(run_embed, n0, n1)
 
     # XLA's own accounting of ONE decode step (prefilled cache, T=1)
     cost = {}
@@ -271,7 +292,9 @@ def rewrite_ab(params, cfg, steps, prompt_len=32):
                     t, c = jitted(qparams, t, c)
                 int(np.asarray(t)[0, 0])
 
-            ms = slope(run, n0, n1) * 1e3
+            with _span(f"rewrite_ab.{impl}" + (
+                    ".rewritten" if wrap is not None else "")):
+                ms = slope(run, n0, n1) * 1e3
         finally:
             if prev is None:
                 os.environ.pop("PADDLE_TPU_INT8_IMPL", None)
@@ -355,7 +378,8 @@ def ragged_step_ab(params, cfg, steps, S=8, ctx=48, page_size=16):
                 tok, lens, kp, vp = jitted(params, tok, lens, kp, vp)
             int(np.asarray(tok)[0])
 
-        ms = slope(run, n0, n1) * 1e3
+        with _span(f"ragged_ab.{mk.__name__}"):
+            ms = slope(run, n0, n1) * 1e3
         return {"step_ms": round(ms, 4),
                 "xla_flops": float(ca.get("flops", -1)),
                 "xla_bytes_accessed": float(ca.get("bytes accessed", -1))}
@@ -384,6 +408,12 @@ def main():
                if f.startswith("bw=")), 819e9)  # v5e HBM
     steps = next((int(f.split("=")[1]) for f in flags
                   if f.startswith("steps=")), 64)
+    trace_path = next((f.split("=", 1)[1] for f in flags
+                       if f.startswith("trace=")), None)
+    if trace_path:
+        global _TRACER
+        from paddle_tpu.observability import SpanTracer
+        _TRACER = SpanTracer()
     on_tpu = jax.default_backend() == "tpu"
     cfg = L.LlamaConfig(
         max_position_embeddings=4096,
@@ -400,7 +430,8 @@ def main():
            "hbm_bw_gbs": bw / 1e9, "steps": steps}
     seq = 32 + steps // 2  # mean cache length over the run
     for tag, p in variants:
-        prof = profile(p, cfg, steps)
+        with _span(f"profile.{tag}"):
+            prof = profile(p, cfg, steps)
         wbytes = decode_weight_bytes(p)
         tbytes = wbytes + kv_bytes_per_step(cfg, seq)
         ceiling = bw / tbytes
@@ -416,9 +447,13 @@ def main():
         out["int8_speedup"] = round(
             out["int8"]["tok_per_s"] / out["fp"]["tok_per_s"], 4)
     if "rewrites" in flags:
-        out["rewrite_ab"] = rewrite_ab(params, cfg, steps)
+        with _span("rewrite_ab"):
+            out["rewrite_ab"] = rewrite_ab(params, cfg, steps)
     if "ragged" in flags:
-        out["ragged_step_ab"] = ragged_step_ab(params, cfg, steps)
+        with _span("ragged_step_ab"):
+            out["ragged_step_ab"] = ragged_step_ab(params, cfg, steps)
+    if trace_path:
+        out["trace"] = _TRACER.export(trace_path)
 
     if "json" in flags:
         print(json.dumps(out))

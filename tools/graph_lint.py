@@ -125,20 +125,29 @@ def main(argv=None):
         # exact tick-program inventory the recompile-hazard pass
         # enumerated for the flagship engine geometry (--ci consumers
         # gate on programs_per_bucket <= 2)
-        from paddle_tpu.analysis.recompile import enumerate_tick_programs
+        from paddle_tpu.analysis.recompile import program_inventory
         geom = next((t.meta["geometry"] for t in serving_pool
                      if t.meta.get("geometry") is not None
                      and getattr(t.meta["geometry"], "ragged", False)),
                     None)
         if geom is not None:
-            programs = enumerate_tick_programs(geom)
-            out["serving_programs"] = {
-                "programs_per_bucket": max(
-                    (len(v) for v in programs.values()), default=0),
-                "total": sum(len(v) for v in programs.values()),
-                "widths": {str(w): sorted(v)
-                           for w, v in sorted(programs.items())},
-            }
+            inventory = program_inventory(geom)
+            out["serving_programs"] = inventory
+            # the runtime-observability contract: the recompile
+            # sentinel (observability/sentinel.py) reports this SAME
+            # inventory dict as `expected_programs` at runtime, so the
+            # static (CI) and runtime (postmortem / sentinel report)
+            # views of "what may ever compile" are one schema a
+            # consumer can diff field for field
+            from paddle_tpu.observability import (COMPILE_EVENT,
+                                                  RECOMPILES_METRIC)
+            out["observability"] = {
+                "sentinel": {
+                    "expected_programs": inventory,
+                    "compile_event": COMPILE_EVENT,
+                    "metric": RECOMPILES_METRIC,
+                    "schema": "paddle_tpu.program_inventory/1",
+                }}
     if rw_table is not None:
         out["rewrite"] = rw_table
     out["hbm"] = [

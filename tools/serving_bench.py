@@ -199,7 +199,20 @@ class Bench:
 
     def run_engine(self, trace):
         a = self.args
-        eng = self._mk_engine()
+        # explicit flags must win over fleet-wide env defaults: --trace
+        # with PADDLE_TPU_SERVING_TRACE=0 would export zero spans, and
+        # --check-invariants with PADDLE_TPU_SERVING_SENTINEL=0 would
+        # silently skip the sentinel gate it documents
+        over = {}
+        if a.trace:
+            over["trace"] = True
+        if a.check_invariants:
+            over["recompile_sentinel"] = True
+        eng = self._mk_engine(**over)
+        # warmup (bench.warmup) already compiled every width-grid entry
+        # and the fused block; from here any compile is a warmed-run
+        # regression the sentinel must name
+        eng.arm_sentinel()
         t0 = time.perf_counter()
         handles = []
         for arrival, prompt, mnt in trace:
@@ -210,6 +223,10 @@ class Bench:
         outs = [h.result(timeout=600) for h in handles]
         wall = time.perf_counter() - t0
         snap = eng.stats()
+        sentinel = (eng.sentinel.report() if eng.sentinel is not None
+                    else None)
+        if a.trace:
+            eng.export_trace(a.trace)
         if a.check_invariants:
             # final standalone audit on top of the per-tick checks —
             # the post-drain state (page leaks) is only visible here
@@ -219,6 +236,21 @@ class Bench:
                 raise SystemExit(
                     "serving_bench --check-invariants: "
                     + "; ".join(str(v) for v in violations))
+            # --check-invariants also gates on a CLEAN recompile
+            # sentinel: a post-warmup compile means the static
+            # program-set proof and the runtime program set diverged
+            if sentinel is not None and not sentinel["clean"]:
+                eng.close()
+                raise SystemExit(
+                    "serving_bench --check-invariants: recompile "
+                    f"sentinel tripped — "
+                    f"{sentinel['post_warmup_compiles']} post-warmup "
+                    f"XLA compile(s): "
+                    + "; ".join(
+                        f"during={e['during']} "
+                        f"({e['compile_s'] * 1e3:.0f} ms)"
+                        for e in sentinel["events"]
+                        if e["phase"] == "post_warmup"))
         eng.close()
         useful = sum(len(o) for o in outs)
         ttfts = [h.ttft_s for h in handles]
@@ -235,7 +267,62 @@ class Bench:
         st = snap["histograms"]["decode_stall_s"]
         if st["count"]:
             out["decode_stall_max_ms"] = round(st["max"] * 1e3, 1)
+        if sentinel is not None:
+            out["sentinel"] = {
+                "clean": sentinel["clean"],
+                "post_warmup_compiles":
+                    sentinel["post_warmup_compiles"]}
+        if a.trace:
+            out["trace"] = a.trace
         return out
+
+    def run_trace_overhead(self, trace, reps=6):
+        """Measured cost of span tracing (ISSUE r13 acceptance): the
+        same unpaced flood replayed through engines that differ ONLY
+        in ``trace=`` — interleaved traced/untraced repeats so
+        co-tenant CPU drift hits both arms, best-of-``reps`` per arm,
+        per-tick wall = replay wall / engine ticks. The slow test pins
+        ``overhead_ratio`` ≤ 1.03 (docs/OBSERVABILITY.md). Invariant
+        checking and the sentinel are OFF in both arms (their host
+        work would mask the tracer's)."""
+        kw = dict(check_invariants=False, recompile_sentinel=False)
+        # pay every compile before either timed arm
+        eng = self._mk_engine(trace=False, **kw)
+        rng = np.random.RandomState(self.args.seed + 4)
+        for b in self.buckets:
+            p = rng.randint(0, 256, (b,)).astype(np.int32)
+            eng.submit(p, self.mnt_cap).result(timeout=600)
+        eng.close()
+
+        def replay_once(traced):
+            eng = self._mk_engine(trace=traced, **kw)
+            t0 = time.perf_counter()
+            handles = [eng.submit(prompt, mnt)
+                       for _, prompt, mnt in trace]
+            for h in handles:
+                h.result(timeout=600)
+            wall = time.perf_counter() - t0
+            ticks = eng._tick_no
+            spans = len(eng.tracer.spans()) + eng.tracer.dropped
+            eng.close()
+            return wall / max(ticks, 1), spans
+
+        per_tick = {True: [], False: []}
+        spans_traced = 0
+        for _ in range(reps):
+            for traced in (True, False):
+                t, n = replay_once(traced)
+                per_tick[traced].append(t)
+                if traced:
+                    spans_traced = max(spans_traced, n)
+        t_on, t_off = min(per_tick[True]), min(per_tick[False])
+        return {"mode": "trace_overhead",
+                "tick_ms_traced": round(t_on * 1e3, 4),
+                "tick_ms_untraced": round(t_off * 1e3, 4),
+                "overhead_ratio": round(t_on / t_off, 4),
+                "spans_recorded": int(spans_traced),
+                "reps": reps,
+                "within_3pct": bool(t_on / t_off <= 1.03)}
 
     # -------------------------------------------- prefix / chunk A-Bs ----
     def _ab_geometry(self):
@@ -692,12 +779,17 @@ def main(argv=None):
     ap.add_argument("--check-invariants", action="store_true",
                     help="run the paged-KV invariant checker "
                          "(analysis/kv_invariants.py) after every "
-                         "engine tick + a final audit; any violation "
-                         "exits non-zero")
+                         "engine tick + a final audit, AND require a "
+                         "clean recompile sentinel (any post-warmup "
+                         "XLA compile exits non-zero)")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="export the engine run's span timeline as "
+                         "Perfetto-loadable Chrome-trace JSON (one "
+                         "track per engine phase + per slot)")
     ap.add_argument("--modes", nargs="+",
                     default=["sequential", "batcher", "engine"],
                     help="any of: sequential batcher engine prefix_ab "
-                         "ragged_ab")
+                         "ragged_ab trace_overhead")
     args = ap.parse_args(argv)
     if (args.shared_prefix and args.shared_prefix >= args.max_prompt
             and any(m != "prefix_ab" for m in args.modes)):
