@@ -24,11 +24,14 @@ from typing import Dict, List, Optional
 from .framework import GraphTarget
 
 __all__ = ["TRAIN_GEOMETRIES", "training_targets", "train_step_target",
-           "train_stage_targets", "flagship_train_objects"]
+           "train_stage_targets", "flagship_train_objects",
+           "schedule_inventory"]
 
 #: name -> mesh degrees + schedule knobs. The acceptance geometries:
-#: plain dp, dp x mp(tp), pp (1F1B + interleaved VPP), and
-#: dp-zero-sharded optimizer state.
+#: plain dp, dp x mp(tp), pp (lockstep 1F1B + interleaved VPP),
+#: dp-zero-sharded optimizer state, and the rank-asymmetric schedules
+#: (pipeline_async: classic per-rank 1F1B at pp=4, ZB-H1 W-deferral at
+#: pp=2 with M NOT divisible by pp — the ragged-microbatch case).
 TRAIN_GEOMETRIES: Dict[str, Dict] = {
     "dp":      dict(dp=2, tp=1, pp=1, vpp=1, microbatches=1,
                     zero_stage=0),
@@ -36,9 +39,21 @@ TRAIN_GEOMETRIES: Dict[str, Dict] = {
                     zero_stage=0),
     "pp_1f1b": dict(dp=1, tp=1, pp=2, vpp=2, microbatches=4,
                     zero_stage=0),
+    "pp2_zb":  dict(dp=1, tp=1, pp=2, vpp=1, microbatches=5,
+                    zero_stage=0, schedule="zb"),
+    "pp4_async": dict(dp=1, tp=1, pp=4, vpp=1, microbatches=8,
+                      zero_stage=0, schedule="1f1b_async"),
     "zero1":   dict(dp=4, tp=2, pp=1, vpp=1, microbatches=1,
                     zero_stage=1),
 }
+
+from ..parallel.pipeline_async import PP_SCHEDULES
+
+#: cfg.pp_schedule -> the schedule-model name schedule_ticks /
+#: schedule_efficiency speak (the legacy traced form is "lockstep");
+#: derived from the one exported mapping so it cannot drift from the
+#: executor dispatch in models/llama.py
+PP_SCHEDULE_MODEL = {k: model for k, (model, _) in PP_SCHEDULES.items()}
 
 
 def _train_cfg(g: Dict, dtype=None):
@@ -47,7 +62,7 @@ def _train_cfg(g: Dict, dtype=None):
               pp_stages=g["pp"], vpp_chunks=g["vpp"],
               num_microbatches=g["microbatches"])
     if g["pp"] > 1:
-        kw["pp_schedule"] = "1f1b"
+        kw["pp_schedule"] = g.get("schedule", "1f1b")
     if dtype is not None:
         kw["dtype"] = dtype
     return L.LlamaConfig.tiny(**kw)
@@ -90,7 +105,8 @@ def _flat_call_site(state, batch, state_specs, batch_specs):
     return labels, classes, flat_specs, donated
 
 
-def train_step_target(geometry: str = "dp", *, batch_size: int = 4,
+def train_step_target(geometry: str = "dp", *,
+                      batch_size: Optional[int] = None,
                       seq_len: int = 8, dtype=None,
                       hbm_budget_bytes: Optional[int] = None
                       ) -> GraphTarget:
@@ -114,6 +130,16 @@ def train_step_target(geometry: str = "dp", *, batch_size: int = 4,
     state = _abstract_state(cfg, mesh, optimizer, g["zero_stage"])
     state_specs = L.train_state_specs(cfg, mesh, optimizer,
                                       g["zero_stage"])
+    if batch_size is None:
+        # default: the smallest batch >= 4 that splits into the
+        # geometry's M microbatches (pp2_zb runs M=5 — the
+        # M-not-divisible-by-pp case — so a fixed 4 wouldn't divide)
+        M = g["microbatches"]
+        batch_size = M * max(1, -(-4 // M))
+    elif batch_size % g["microbatches"]:
+        raise ValueError(
+            f"batch_size={batch_size} does not split into geometry "
+            f"{geometry!r}'s {g['microbatches']} microbatches")
     sds = jax.ShapeDtypeStruct
     batch = {"tokens": sds((batch_size, seq_len), jnp.int32),
              "labels": sds((batch_size, seq_len), jnp.int32)}
@@ -134,8 +160,10 @@ def train_step_target(geometry: str = "dp", *, batch_size: int = 4,
         zero_stage=g["zero_stage"], train_geometry=geometry,
     )
     if g["pp"] > 1:
+        meta["pp_schedule"] = cfg.pp_schedule
         meta["expected_scan_trips"] = schedule_ticks(
-            g["pp"], g["microbatches"], g["vpp"])
+            g["pp"], g["microbatches"], g["vpp"],
+            schedule=PP_SCHEDULE_MODEL[cfg.pp_schedule])
     if hbm_budget_bytes is not None:
         meta["hbm_budget_bytes"] = int(hbm_budget_bytes)
     return GraphTarget(
@@ -242,3 +270,49 @@ def flagship_train_objects(dtype=None, batch_size: int = 4,
                   mesh_axes=dict(hm.mesh.shape), zero_stage=zero_stage,
                   train_geometry="flagship-1dev"))
     return target, step_fn, state, batch
+
+
+def schedule_inventory(geometries=None) -> Dict:
+    """The pipeline-schedule trip/phase inventory for every pp>1
+    flagship geometry — the training-schedule counterpart of the
+    serving ``program_inventory``: one diffable dict that
+    ``graph_lint --json`` emits as ``pipeline_schedules`` so CI
+    consumers can pin the schedule shape (tick counts, per-op-kind
+    rank-tick counts, modeled efficiency) field for field.
+
+    Pure host arithmetic (the validated schedule builder / closed
+    forms) — no tracing, no compiles.
+    """
+    from ..parallel.pipeline_1f1b import (schedule_efficiency,
+                                          schedule_ticks)
+    out: Dict = {"schema": "paddle_tpu.schedule_inventory/1",
+                 "geometries": {}}
+    for name in (geometries or TRAIN_GEOMETRIES):
+        g = TRAIN_GEOMETRIES[name]
+        if g["pp"] <= 1:
+            continue
+        cfg_sched = g.get("schedule", "1f1b")
+        model = PP_SCHEDULE_MODEL[cfg_sched]
+        S, M, V = g["pp"], g["microbatches"], g["vpp"]
+        ticks = schedule_ticks(S, M, V, schedule=model)
+        entry = {
+            "pp": S, "vpp": V, "microbatches": M,
+            "pp_schedule": cfg_sched, "schedule_model": model,
+            "ticks": ticks,
+            "efficiency": round(schedule_efficiency(
+                S, M, V, schedule=model), 6),
+        }
+        if model == "lockstep":
+            # every tick runs all S*V slots; useful slot-ticks = M per
+            # slot — the masked fill/drain is the phase inventory
+            entry["phases"] = {
+                "slots": S * V, "useful_slot_ticks": M * S * V,
+                "masked_slot_ticks": (ticks - M) * S * V}
+        else:
+            from ..parallel.pipeline_async import build_schedule
+            sched = build_schedule(S, M, V, model)
+            entry["phases"] = sched.op_counts()
+            entry["saved_ring_depth"] = {"acts": sched.depth_x,
+                                         "cotangents": sched.depth_c}
+        out["geometries"][name] = entry
+    return out

@@ -51,8 +51,14 @@ class LlamaConfig:
     num_microbatches: int = 1
     # "gpipe": autodiff through the SPMD pipeline (pipeline_spmd) — all
     # forwards then all backwards, O(M) live microbatch activations.
-    # "1f1b": explicit fused fwd+bwd schedule (pipeline_1f1b) — O(S)
-    # live activations, matching pipeline_parallel.py:565.
+    # "1f1b": explicit fused fwd+bwd LOCKSTEP schedule (pipeline_1f1b)
+    # — O(S) live activations, matching pipeline_parallel.py:565, but
+    # every tick runs every slot (fill/drain = masked work).
+    # "1f1b_async": rank-asymmetric 1F1B (pipeline_async) — shard_map
+    # body branching on stage index, reference per-rank bubble
+    # 1-(S-1)/(VM+S-1); requires dp=tp=1.
+    # "zb": ZB-H1-style W-deferral on top of 1f1b_async
+    # (pipeline_zero_bubble.py counterpart); V=1, dp=tp=1.
     pp_schedule: str = "gpipe"
     # interleaved VPP: chunks per device under the 1f1b schedule
     # (pipeline_parallel.py:1372 round-robin model partition)
@@ -537,14 +543,25 @@ def loss_fn(params, batch, cfg: LlamaConfig, mesh: Optional[Mesh] = None):
     return fused_softmax_cross_entropy(logits, labels).mean()
 
 
+from ..parallel.pipeline_async import PP_SCHEDULES
+
+#: cfg.pp_schedule -> pipeline_async executor variant
+ASYNC_PP_SCHEDULES = {k: var for k, (_, var) in PP_SCHEDULES.items()
+                      if var is not None}
+
+
 def grads_1f1b(params, batch, cfg: LlamaConfig, mesh: Mesh):
-    """(loss, grads) via the explicit 1F1B / interleaved-VPP schedule
-    (parallel/pipeline_1f1b.py). Embedding forward+pullback bracket the
-    pipeline; the loss head (final norm + lm_head + fused CE) runs
-    per-microbatch as each one exits the last stage."""
+    """(loss, grads) via an explicit fused fwd+bwd pipeline schedule:
+    the lockstep 1F1B / interleaved-VPP scan (parallel/pipeline_1f1b.py,
+    ``pp_schedule="1f1b"``) or a rank-asymmetric schedule
+    (parallel/pipeline_async.py, ``"1f1b_async"`` / ``"zb"`` — same
+    numerics, reference per-rank bubble). Embedding forward+pullback
+    bracket the pipeline; the loss head (final norm + lm_head + fused
+    CE) runs per-microbatch as each one exits the last stage."""
     from ..ops.fused import fused_softmax_cross_entropy
     from ..parallel.pipeline_1f1b import (pipeline_train_1f1b,
                                           split_chunks_round_robin)
+    from ..parallel.pipeline_async import pipeline_train_async
     S, V, M = cfg.pp_stages, cfg.vpp_chunks, cfg.num_microbatches
     tokens, labels = batch["tokens"], batch["labels"]
     tp_on = mesh is not None and mesh.shape.get("tp", 1) > 1
@@ -570,9 +587,15 @@ def grads_1f1b(params, batch, cfg: LlamaConfig, mesh: Mesh):
         params["layers"], cfg.num_hidden_layers, S, V)
     head_params = {"final_norm": params["final_norm"],
                    "lm_head": params["lm_head"]}
-    loss, gchunks, ghead, dx = pipeline_train_1f1b(
-        stage_fn, head_fn, chunks, head_params, x_mb, labels_mb,
-        num_stages=S, virtual_chunks=V, mesh=mesh, mb_spec=mb_spec)
+    if cfg.pp_schedule in ASYNC_PP_SCHEDULES:
+        loss, gchunks, ghead, dx = pipeline_train_async(
+            stage_fn, head_fn, chunks, head_params, x_mb, labels_mb,
+            num_stages=S, virtual_chunks=V,
+            variant=ASYNC_PP_SCHEDULES[cfg.pp_schedule], mesh=mesh)
+    else:
+        loss, gchunks, ghead, dx = pipeline_train_1f1b(
+            stage_fn, head_fn, chunks, head_params, x_mb, labels_mb,
+            num_stages=S, virtual_chunks=V, mesh=mesh, mb_spec=mb_spec)
     glayers = jax.tree_util.tree_map(
         lambda g, p: g.reshape(p.shape), gchunks, params["layers"])
     (dembed,) = embed_pull(dx)
@@ -665,10 +688,12 @@ def make_train_step(cfg: LlamaConfig, mesh: Mesh, optimizer=None,
     if zero_stage not in (0, 1, 2, 3):
         raise ValueError(f"zero_stage must be 0..3, got {zero_stage}")
 
-    use_1f1b = cfg.pp_stages > 1 and cfg.pp_schedule == "1f1b"
-    if cfg.pp_schedule not in ("gpipe", "1f1b"):
-        raise ValueError(f"pp_schedule must be 'gpipe' or '1f1b', "
-                         f"got {cfg.pp_schedule!r}")
+    use_1f1b = cfg.pp_stages > 1 and cfg.pp_schedule in PP_SCHEDULES
+    if cfg.pp_schedule not in ("gpipe",) + tuple(PP_SCHEDULES):
+        raise ValueError(
+            f"pp_schedule must be one of "
+            f"{('gpipe',) + tuple(PP_SCHEDULES)}, got "
+            f"{cfg.pp_schedule!r}")
 
     def init_fn(key):
         specs = train_state_specs(cfg, mesh, optimizer, zero_stage)

@@ -14,6 +14,17 @@ from .mesh import (
     P,
 )
 from .pipeline_spmd import pipeline_spmd, stack_stage_params
+from .pipeline_1f1b import (
+    pipeline_train_1f1b,
+    schedule_efficiency,
+    schedule_ticks,
+    split_chunks_round_robin,
+)
+from .pipeline_async import (
+    Schedule,
+    build_schedule,
+    pipeline_train_async,
+)
 from .context_parallel import (
     ring_attention,
     ulysses_attention,

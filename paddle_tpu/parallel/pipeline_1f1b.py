@@ -68,15 +68,31 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 
 def schedule_ticks(num_stages: int, num_microbatches: int,
-                   virtual_chunks: int = 1) -> int:
-    """Trip count of the 1F1B schedule scan: ``M + 2·S·V - 1`` lockstep
-    ticks (fill + steady state + drain). This is the ONE definition —
-    ``pipeline_train_1f1b`` sizes its scan with it and the
+                   virtual_chunks: int = 1,
+                   schedule: str = "lockstep") -> int:
+    """Trip count of the schedule scan. This is the ONE definition —
+    the executors size their scans with it and the
     collective-consistency lint checks the traced scan against it, so a
     schedule edit that changes the tick arithmetic cannot silently
-    desynchronize the two."""
+    desynchronize the two.
+
+    ``schedule``:
+      * ``"lockstep"`` — the traced all-slots-every-tick form of this
+        module: ``M + 2·S·V - 1`` ticks (fill + steady + drain).
+      * ``"1f1b"`` — rank-asymmetric 1F1B (pipeline_async):
+        ``2·(V·M + S - 1)`` half-step ticks, the reference per-rank
+        1F1B span (interleaved V>1 included).
+      * ``"zb"`` — ZB-H1-style W-deferral (pipeline_async, V=1):
+        ``3·M + S - 1`` for M >= S; fill-dominated below that — the
+        count comes from the validated schedule builder either way.
+    """
     S = int(num_stages) * int(virtual_chunks)
-    return int(num_microbatches) + 2 * S - 1
+    M = int(num_microbatches)
+    if schedule == "lockstep":
+        return M + 2 * S - 1
+    from .pipeline_async import build_schedule
+    return build_schedule(int(num_stages), M, int(virtual_chunks),
+                          schedule).ticks
 
 
 def _tree_zeros_f32(t):
@@ -240,21 +256,48 @@ def split_chunks_round_robin(layer_params, num_layers: int,
 
 
 def schedule_efficiency(num_stages: int, num_microbatches: int,
-                        virtual_chunks: int = 1) -> float:
-    """Useful-work fraction of the traced 1F1B schedule.
+                        virtual_chunks: int = 1,
+                        schedule: str = "lockstep") -> float:
+    """Useful-work fraction of a pipeline schedule — the analytic model
+    measured efficiency is asserted against in tests.
 
-    The schedule runs ``M + 2S - 1`` lockstep ticks and every tick
-    executes all S slots (masked work included — an SPMD traced program
-    cannot skip a slot), so efficiency = M / (M + 2S - 1). VPP does not
-    enter: every device computes its V chunks every tick (module
-    docstring), so V multiplies useful and wasted work alike. This is
-    the quantity to DRIVE SCHEDULING DECISIONS with: raise M until the
-    bubble amortizes (the reference's lever too — its per-rank 1F1B has
-    the same (2S-1)-tick fill/drain, pipeline_parallel.py:565).
+    ``schedule="lockstep"`` (this module's traced form): the schedule
+    runs ``M + 2S - 1`` lockstep ticks and every tick executes all S
+    slots (masked work included — an SPMD traced program cannot skip a
+    slot), so efficiency = M / (M + 2S - 1). VPP does not enter: every
+    device computes its V chunks every tick (module docstring), so V
+    multiplies useful and wasted work alike.
     tests/test_pipeline_1f1b.py checks the compiled step's XLA flop
     count against this prediction.
+
+    ``schedule="1f1b"`` (rank-asymmetric, pipeline_async): ticks are
+    half-steps (one F or one full backward per rank per tick), span
+    ``2(VM + S - 1)``, efficiency ``VM / (VM + S - 1)`` — exactly the
+    reference 1F1B bubble ``1 - (S-1)/(VM + S - 1)``, interleaved V>1
+    included (the closed form is pinned against the schedule builder
+    across a (S, M, V) grid in tests/test_pipeline_async.py).
+
+    ``schedule="zb"`` (ZB-H1 W-deferral, V=1): each microbatch is
+    three unit ops per rank (F, input-grad B, deferred weight-grad W);
+    efficiency = 3M / ticks with the tick count from the validated
+    builder (= 3M/(3M + S - 1) for M >= S) — strictly above the 1F1B
+    bound at every geometry. Tick-fraction efficiency; the W split's
+    extra recompute FLOPs are documented in docs/PERF.md.
     """
     S, M = int(num_stages), int(num_microbatches)
+    V = int(virtual_chunks)
     if S < 1 or M < 1:
         raise ValueError("num_stages and num_microbatches must be >= 1")
-    return M / (M + 2 * S - 1)
+    if schedule == "lockstep":
+        return M / (M + 2 * S - 1)
+    if schedule == "1f1b":
+        # same validity envelope as the builder, so the model can never
+        # quote an efficiency for a schedule that does not build
+        from .pipeline_async import build_schedule
+        build_schedule(S, M, V, "1f1b")
+        return V * M / (V * M + S - 1)
+    if schedule == "zb":
+        ticks = schedule_ticks(S, M, V, schedule="zb")
+        return 3 * V * M / ticks
+    raise ValueError(f"schedule must be 'lockstep', '1f1b' or 'zb', "
+                     f"got {schedule!r}")

@@ -799,6 +799,87 @@ def test_1f1b_schedule_trip_count_checked_and_mutation_caught(train_targets):
     assert errs and "trip count" in errs[0].message
 
 
+@pytest.mark.parametrize("geom,model", [("pp2_zb", "zb"),
+                                        ("pp4_async", "1f1b")])
+def test_async_schedule_trip_count_checked_and_mutation_caught(
+        train_targets, geom, model):
+    """The rank-asymmetric schedules are traced targets too: the
+    schedule scan lives INSIDE the shard_map body and the trip-count
+    rule still sees it (type-based jaxpr walk); a tick-arithmetic
+    desync is caught exactly like the lockstep one."""
+    from paddle_tpu.parallel.pipeline_1f1b import schedule_ticks
+    g = TRAIN_GEOMETRIES[geom]
+    T = schedule_ticks(g["pp"], g["microbatches"], g["vpp"],
+                       schedule=model)
+    t = _fresh(train_targets[geom])
+    assert t.meta["expected_scan_trips"] == T
+    assert T in scan_trip_counts(t.jaxpr)
+    assert not _errors(CollectiveConsistencyPass().run(t))
+    t.meta["expected_scan_trips"] = T + 1    # seeded: schedule desync
+    errs = _errors(CollectiveConsistencyPass().run(t))
+    assert errs and "trip count" in errs[0].message
+
+
+def test_async_targets_per_pass_mutations(train_targets):
+    """One seeded mutation per training pass on the rank-asymmetric
+    targets — the shard_map program form must not blind any of them."""
+    # sharding-lint: decorative axis name on a param spec
+    t = _fresh(train_targets["pp4_async"])
+    i = t.meta["invar_labels"].index("[0]['params']['lm_head']")
+    t.meta["in_specs"][i] = P(None, "mp")
+    errs = _errors(ShardingLintPass().run(t))
+    assert errs and "mp" in errs[0].message
+    # donation-audit: dropped donation on a large opt leaf
+    t = _fresh(train_targets["pp2_zb"])
+    i = next(i for i, (c, v) in enumerate(
+        zip(t.meta["invar_classes"], t.jaxpr.jaxpr.invars))
+        if c == "opt" and np.prod(v.aval.shape or (1,)) > 64)
+    t.meta["donated_invars"][i] = False
+    errs = _errors(DonationAuditPass().run(t))
+    assert errs and "NON-donated" in errs[0].message
+    # hbm-peak: the estimator walks the shard_map program and a budget
+    # breach still fires
+    t = _fresh(train_targets["pp4_async"])
+    t.meta["hbm_budget_bytes"] = 1024
+    errs = _errors(HbmPeakPass().run(t))
+    assert errs and "budget" in errs[0].message
+    # all three clean un-mutated
+    for geom in ("pp2_zb", "pp4_async"):
+        for p in (ShardingLintPass(), DonationAuditPass(),
+                  CollectiveConsistencyPass()):
+            assert not _errors(p.run(_fresh(train_targets[geom]))), \
+                (geom, p.name)
+
+
+def test_graph_lint_json_reports_schedule_inventory(capsys):
+    """graph_lint --json carries the pipeline-schedule trip/phase
+    inventory next to the serving program inventory — one diffable
+    schema — and it agrees with the schedule builder's own counts."""
+    import importlib.util
+    import json as _json
+    import os
+    from paddle_tpu.analysis.training_graphs import schedule_inventory
+    from paddle_tpu.parallel.pipeline_async import build_schedule
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "graph_lint.py")
+    spec = importlib.util.spec_from_file_location("graph_lint", path)
+    gl = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gl)
+    rc = gl.main(["--suite", "training", "--json"])
+    out = _json.loads(capsys.readouterr().out)
+    assert rc == 0
+    inv = out["pipeline_schedules"]
+    assert inv == schedule_inventory()
+    assert inv["schema"] == "paddle_tpu.schedule_inventory/1"
+    assert {"pp_1f1b", "pp2_zb", "pp4_async"} <= set(inv["geometries"])
+    zb = inv["geometries"]["pp2_zb"]
+    sched = build_schedule(2, 5, 1, "zb")
+    assert zb["ticks"] == sched.ticks
+    assert zb["phases"] == sched.op_counts()
+    assert zb["phases"]["W"] == 2 * 5          # one W per stage per mb
+    assert zb["efficiency"] == pytest.approx(sched.efficiency, abs=1e-6)
+
+
 # ---------------------------------------------------------------------------
 # HBM peak estimator: XLA accuracy pin + drift + budget mutations
 # ---------------------------------------------------------------------------

@@ -189,3 +189,27 @@ def test_schedule_efficiency_analytic_properties():
         schedule_efficiency(2, 4)
     with pytest.raises(ValueError):
         schedule_efficiency(0, 4)
+
+
+def test_schedule_efficiency_models_async_schedules():
+    """The extended model (ISSUE 10): rank-asymmetric 1F1B lands the
+    reference per-rank bubble M/(M+S-1) — 0.889 at pp=2/M=8, 0.970 at
+    M=32 — interleaved V>1 is 1-(S-1)/(VM+S-1), and ZB-H1 W-deferral
+    beats both (3M/(3M+S-1) in the M>=S regime)."""
+    from paddle_tpu.parallel.pipeline_1f1b import (schedule_efficiency,
+                                                   schedule_ticks)
+    assert schedule_efficiency(2, 8, schedule="1f1b") == \
+        pytest.approx(8 / 9)       # 0.889, the reference 1F1B number
+    assert schedule_efficiency(2, 32, schedule="1f1b") == \
+        pytest.approx(32 / 33)     # 0.970
+    assert schedule_efficiency(2, 8, 2, schedule="1f1b") == \
+        pytest.approx(16 / 17)     # interleaved V=2
+    assert schedule_efficiency(2, 8, schedule="zb") == \
+        pytest.approx(24 / 25)     # 0.96 > 0.889
+    assert schedule_ticks(2, 8, schedule="1f1b") == 18
+    assert schedule_ticks(2, 8, schedule="zb") == 25
+    assert schedule_ticks(4, 8, schedule="1f1b") == 22
+    for S, M in ((2, 8), (4, 16), (8, 32)):
+        assert schedule_efficiency(S, M, schedule="zb") > \
+            schedule_efficiency(S, M, schedule="1f1b") > \
+            schedule_efficiency(S, M, schedule="lockstep")

@@ -1,0 +1,381 @@
+"""Rank-asymmetric 1F1B / ZB-H1 pipeline schedules
+(parallel/pipeline_async.py).
+
+Reference capabilities covered: pipeline_parallel.py:565 per-rank 1F1B
+(warmup/steady/drain differ per rank — the fill/drain bubble is
+1-(S-1)/(VM+S-1), not the lockstep (2S-1)/(M+2S-1)) and
+pipeline_zero_bubble.py ZB-H1 (backward split into input-grad B and
+deferred weight-grad W filling bubble slots).
+
+Three pin families:
+  * the schedule BUILDER: dependency-validated grids, closed-form
+    spans (the analytic model measured efficiency is asserted
+    against), O(S·V) M-independent saved-ring depths;
+  * NUMERICS: loss+grads match the lockstep schedule (and plain
+    single-stage autodiff) across a (pp, M, V) grid including M not
+    divisible by pp, with f32 grad accumulation pinned structurally
+    under bf16 activations;
+  * MEASURED efficiency from the real traced train step >= the
+    reference 1F1B numbers (0.889 at pp=2/M=8, 0.970 at M=32), and
+    the dropped-W-deferral mutation trips the trip-count analysis.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.parallel import init_hybrid_mesh
+from paddle_tpu.parallel.pipeline_1f1b import (pipeline_train_1f1b,
+                                               schedule_efficiency,
+                                               schedule_ticks)
+from paddle_tpu.parallel.pipeline_async import (IDLE, OP_W,
+                                                build_schedule,
+                                                pipeline_train_async)
+
+
+def _cfg(pp, schedule="1f1b", vpp=1, M=8, layers=4, dtype=jnp.float32):
+    return L.LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=layers, num_attention_heads=4,
+        num_key_value_heads=4, max_position_embeddings=32,
+        dtype=dtype, remat=False, use_flash_attention=False,
+        pp_stages=pp, num_microbatches=M, pp_schedule=schedule,
+        vpp_chunks=vpp)
+
+
+def _tree_close(a, b, rtol, atol):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_allclose(np.asarray(x, np.float32),
+                                   np.asarray(y, np.float32),
+                                   rtol=rtol, atol=atol)
+
+
+# ---------------------------------------------------------------------------
+# schedule builder: validity, closed forms, memory bounds
+# ---------------------------------------------------------------------------
+
+def test_builder_v1_grid_matches_closed_forms():
+    """The analytic model (schedule_ticks / schedule_efficiency) and
+    the dependency-validated builder agree everywhere; 1f1b lands the
+    reference per-rank bubble exactly, zb beats it."""
+    for S in (2, 3, 4, 8):
+        for M in (1, 2, 3, 5, 8, 16):
+            sc = build_schedule(S, M, 1, "1f1b")
+            assert sc.ticks == 2 * (M + S - 1)
+            assert sc.ticks == schedule_ticks(S, M, 1, schedule="1f1b")
+            assert sc.efficiency == pytest.approx(M / (M + S - 1))
+            assert sc.efficiency == pytest.approx(
+                schedule_efficiency(S, M, 1, schedule="1f1b"))
+            zb = build_schedule(S, M, 1, "zb")
+            assert zb.ticks == schedule_ticks(S, M, 1, schedule="zb")
+            assert zb.efficiency == pytest.approx(
+                schedule_efficiency(S, M, 1, schedule="zb"))
+            if M >= S:   # steady-state regime: closed form 3M + S - 1
+                assert zb.ticks == 3 * M + S - 1
+                assert zb.efficiency > sc.efficiency
+            # zb never falls below the 1F1B reference bound
+            assert zb.efficiency >= M / (M + S - 1) - 1e-12
+
+
+def test_builder_interleaved_matches_reference_bound():
+    """V>1 (the reference's VPP round-robin order) lands the
+    interleaved-1F1B analytic efficiency 1-(S-1)/(VM+S-1) exactly."""
+    for S in (2, 4, 8):
+        for V in (2, 4):
+            for M in (S, 2 * S, 4 * S):
+                sc = build_schedule(S, M, V, "1f1b")
+                assert sc.ticks == 2 * (V * M + S - 1)
+                assert sc.efficiency == pytest.approx(
+                    V * M / (V * M + S - 1))
+                assert sc.efficiency == pytest.approx(
+                    schedule_efficiency(S, M, V, schedule="1f1b"))
+                # interleaving strictly shrinks the bubble vs V=1
+                assert sc.efficiency > M / (M + S - 1)
+
+
+def test_builder_saved_rings_are_o_sv_and_m_independent():
+    """The 1F1B property, proven per schedule by the interval
+    allocator: saved-activation/cotangent ring depths are O(S·V) and
+    DO NOT grow with M (GPipe's O(M) is exactly what this schedule
+    exists to avoid; zb's W backlog is capped at S so deferral does
+    not reintroduce it)."""
+    for S in (2, 4, 8):
+        for V, var in ((1, "1f1b"), (1, "zb"), (2, "1f1b")):
+            a = build_schedule(S, 2 * S, V, var)
+            b = build_schedule(S, 8 * S, V, var)
+            assert (a.depth_x, a.depth_c) == (b.depth_x, b.depth_c), \
+                (S, V, var)
+            assert b.depth_x <= 3 * S * V
+            assert b.depth_c <= 2 * S * V
+
+
+def test_builder_rejections():
+    with pytest.raises(ValueError, match="num_stages >= 2"):
+        build_schedule(1, 4, 1, "1f1b")
+    with pytest.raises(ValueError, match="variant"):
+        build_schedule(2, 4, 1, "zigzag")
+    with pytest.raises(ValueError, match="ZB-V"):
+        build_schedule(2, 4, 2, "zb")
+    with pytest.raises(ValueError, match="divisible"):
+        build_schedule(2, 3, 2, "1f1b")
+    with pytest.raises(ValueError, match="microbatches"):
+        build_schedule(2, 0, 1, "1f1b")
+
+
+def test_schedule_efficiency_lockstep_unchanged():
+    """Back-compat: the lockstep model is untouched (same numbers the
+    r5 ceiling table and existing tests pin)."""
+    assert schedule_efficiency(2, 8) == pytest.approx(8 / 11)
+    assert schedule_efficiency(4, 32) == pytest.approx(32 / 39)
+    assert schedule_ticks(2, 8) == 11
+    with pytest.raises(ValueError, match="schedule"):
+        schedule_efficiency(2, 8, schedule="wat")
+
+
+# ---------------------------------------------------------------------------
+# numerics: match the lockstep schedule and single-stage autodiff
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("pp,vpp,M,sched", [
+    (2, 1, 5, "1f1b_async"),      # M not divisible by pp
+    (2, 1, 5, "zb"),
+    (4, 1, 8, "zb"),
+    (2, 2, 4, "1f1b_async"),      # interleaved VPP
+])
+def test_async_matches_lockstep(pp, vpp, M, sched):
+    """Loss and every grad must match the lockstep schedule — the
+    existing 1F1B exactness pins transfer to the new schedules."""
+    hm = init_hybrid_mesh(dp=1, pp=pp, tp=1, set_global=False)
+    cfg_a, cfg_l = _cfg(pp, sched, vpp, M), _cfg(pp, "1f1b", vpp, M)
+    params = L.init_params(cfg_a, jax.random.PRNGKey(0))
+    with hm.mesh:
+        batch = L.make_batch(cfg_a, batch_size=M, seq_len=16,
+                             mesh=hm.mesh)
+        loss_a, grads_a = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg_a, hm.mesh))(params,
+                                                             batch)
+        loss_l, grads_l = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg_l, hm.mesh))(params,
+                                                             batch)
+    np.testing.assert_allclose(loss_a, loss_l, rtol=1e-6, atol=1e-7)
+    _tree_close(grads_a, grads_l, rtol=2e-5, atol=1e-6)
+
+
+def test_async_matches_single_stage_autodiff():
+    """Absolute correctness: the zb schedule against plain pp=1
+    value_and_grad (embedding + head bracket included)."""
+    pp, M = 2, 4
+    hm = init_hybrid_mesh(dp=1, pp=pp, tp=1, set_global=False)
+    cfg = _cfg(pp, "zb", 1, M)
+    ref_cfg = _cfg(1, "gpipe", 1, 1)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    with hm.mesh:
+        batch = L.make_batch(cfg, batch_size=M, seq_len=32,
+                             mesh=hm.mesh)
+        loss_p, grads_p = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg, hm.mesh))(params,
+                                                           batch)
+    hm1 = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
+    with hm1.mesh:
+        loss_r, grads_r = jax.jit(
+            lambda p, b: jax.value_and_grad(L.loss_fn)(
+                p, b, ref_cfg, hm1.mesh))(params, batch)
+    np.testing.assert_allclose(loss_p, loss_r, rtol=1e-5, atol=1e-6)
+    _tree_close(grads_p, grads_r, rtol=2e-4, atol=1e-5)
+
+
+def test_async_train_step_losses_equal_lockstep_steps():
+    """make_train_step integration: three optimizer steps under the zb
+    schedule produce the SAME loss trajectory as lockstep (same
+    grads -> same adamw updates)."""
+    losses = {}
+    for sched in ("1f1b", "zb"):
+        cfg = L.LlamaConfig.tiny(dtype=jnp.float32, remat=False,
+                                 use_flash_attention=False, pp_stages=2,
+                                 pp_schedule=sched, num_microbatches=4)
+        hm = init_hybrid_mesh(dp=1, pp=2, tp=1, set_global=False)
+        with hm.mesh:
+            step, init = L.make_train_step(cfg, hm.mesh)
+            state = init(jax.random.PRNGKey(0))
+            batch = L.make_batch(cfg, batch_size=4, seq_len=16,
+                                 mesh=hm.mesh)
+            out = []
+            for _ in range(3):
+                state, loss = step(state, batch)
+                out.append(float(loss))
+        losses[sched] = out
+    np.testing.assert_allclose(losses["zb"], losses["1f1b"], rtol=1e-5)
+    assert losses["zb"][-1] < losses["zb"][0]
+
+
+def test_async_requires_pp_only_mesh():
+    hm = init_hybrid_mesh(dp=1, pp=2, tp=2, set_global=False)
+    cfg = _cfg(2, "1f1b_async", 1, 4)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    with hm.mesh:
+        batch = L.make_batch(cfg, batch_size=4, seq_len=16,
+                             mesh=hm.mesh)
+        with pytest.raises(NotImplementedError, match="non-pp"):
+            L.grads_1f1b(params, batch, cfg, hm.mesh)
+
+
+def test_bad_async_schedule_name_rejected():
+    hm = init_hybrid_mesh(dp=1, pp=2, tp=1, set_global=False)
+    cfg = _cfg(2, "zb_async")
+    with pytest.raises(ValueError, match="pp_schedule"):
+        L.make_train_step(cfg, hm.mesh)
+
+
+# ---------------------------------------------------------------------------
+# fp32 grad accumulation pin under bf16 activations
+# ---------------------------------------------------------------------------
+
+def test_fp32_grad_accum_pinned_under_bf16():
+    """Structural dtype pin: in the traced schedule scan the grad
+    accumulators ride the carry in f32 while the saved
+    activation/cotangent rings stay bf16; returned grads are cast back
+    to the bf16 param dtype."""
+    from paddle_tpu.core.graph_trace import iter_jaxpr_eqns
+    pp, M = 2, 4
+    cfg = _cfg(pp, "zb", 1, M, dtype=jnp.bfloat16)
+    hm = init_hybrid_mesh(dp=1, pp=pp, tp=1, set_global=False)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    with hm.mesh:
+        batch = L.make_batch(cfg, batch_size=M, seq_len=16,
+                             mesh=hm.mesh)
+        jaxpr = jax.make_jaxpr(
+            lambda p, b: L.grads_1f1b(p, b, cfg, hm.mesh))(params,
+                                                           batch)
+        grads = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg, hm.mesh))(params,
+                                                           batch)[1]
+    T = schedule_ticks(pp, M, 1, schedule="zb")
+    sched_scans = [
+        eqn for _path, eqn in iter_jaxpr_eqns(jaxpr)
+        if eqn.primitive.name == "scan" and eqn.params["length"] == T]
+    assert sched_scans, "schedule scan not found in the traced program"
+    eqn = sched_scans[0]
+    carry = eqn.invars[eqn.params["num_consts"]:
+                       eqn.params["num_consts"] + eqn.params["num_carry"]]
+    f32_acc = [v for v in carry
+               if v.aval.dtype == jnp.float32 and v.aval.ndim >= 2]
+    bf16_rings = [v for v in carry
+                  if v.aval.dtype == jnp.bfloat16 and v.aval.ndim >= 3]
+    assert len(f32_acc) >= 5, [v.aval for v in carry]   # gacc + ghead
+    assert bf16_rings, [v.aval for v in carry]          # sx/sc rings
+    for leaf, ref in zip(jax.tree_util.tree_leaves(grads),
+                         jax.tree_util.tree_leaves(params)):
+        assert leaf.dtype == ref.dtype
+
+
+# ---------------------------------------------------------------------------
+# measured efficiency from the real traced program
+# ---------------------------------------------------------------------------
+
+def _measured(pp, M, sched_cfg, model):
+    from paddle_tpu.analysis.collectives import scan_trip_counts
+    cfg = L.LlamaConfig.tiny(dtype=jnp.float32, remat=False,
+                             use_flash_attention=False, pp_stages=pp,
+                             pp_schedule=sched_cfg, num_microbatches=M)
+    hm = init_hybrid_mesh(dp=1, pp=pp, tp=1, set_global=False)
+    with hm.mesh:
+        step, init = L.make_train_step(cfg, hm.mesh)
+        state = jax.eval_shape(lambda: init(jax.random.PRNGKey(0)))
+        batch = {
+            "tokens": jax.ShapeDtypeStruct((M, 8), jnp.int32),
+            "labels": jax.ShapeDtypeStruct((M, 8), jnp.int32)}
+        jaxpr = jax.make_jaxpr(lambda s, b: step(s, b))(state, batch)
+    trips = scan_trip_counts(jaxpr)
+    T = schedule_ticks(pp, M, 1, schedule=model)
+    assert T in trips, (T, sorted(set(trips)))
+    useful = {"1f1b": 2 * M, "zb": 3 * M}[model]
+    return useful / T
+
+
+def test_measured_efficiency_meets_reference_1f1b():
+    """THE acceptance pin: measured (traced tick counts of the real
+    train step) schedule efficiency >= the reference 1F1B numbers —
+    0.889 at pp=2/M=8 and 0.970 at M=32 — and == the analytic model."""
+    for M, floor in ((8, 0.889), (32, 0.970)):
+        eff = _measured(2, M, "1f1b_async", "1f1b")
+        assert eff == pytest.approx(M / (M + 1))       # = 0.8889/0.9697
+        assert eff >= floor - 5e-4
+        assert eff == pytest.approx(
+            schedule_efficiency(2, M, schedule="1f1b"))
+
+
+def test_measured_efficiency_zb_beats_1f1b():
+    eff_zb = _measured(2, 8, "zb", "zb")
+    assert eff_zb == pytest.approx(24 / 25)            # 0.96
+    assert eff_zb > _measured(2, 8, "1f1b_async", "1f1b")
+    assert eff_zb == pytest.approx(
+        schedule_efficiency(2, 8, schedule="zb"))
+
+
+# ---------------------------------------------------------------------------
+# dropped W-deferral mutation: statically caught, concretely wrong
+# ---------------------------------------------------------------------------
+
+def test_dropped_w_deferral_trips_consistency_and_corrupts_grads():
+    """Strip the deferred-W drain tail from a zb schedule: the traced
+    scan loses ticks, so the collective/trip-count rule fires (the
+    designated safety net), and the missing weight-grad contributions
+    corrupt the stage grads concretely."""
+    from paddle_tpu.analysis import (CollectiveConsistencyPass,
+                                     GraphTarget, Severity)
+    S, M = 2, 3
+    sched = build_schedule(S, M, 1, "zb")
+    # trailing ticks whose ops are only W/idle = the deferral tail
+    tail = 0
+    for t in range(sched.ticks - 1, -1, -1):
+        if all(k in (IDLE, OP_W) for k in sched.kind[t]):
+            tail += 1
+        else:
+            break
+    assert tail >= 1
+    cut = sched.ticks - tail
+    mutated = dataclasses.replace(
+        sched, ticks=cut,
+        **{f: getattr(sched, f)[:cut]
+           for f in ("kind", "chunk", "mb", "slot_x", "slot_c",
+                     "inject", "emit", "store_up", "store_dn")})
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def head_fn(hp, y, lbl):
+        return jnp.mean((y @ hp["wo"] - lbl) ** 2)
+
+    d = 8
+    sp = {"w": jax.random.normal(jax.random.PRNGKey(0), (S, d, d)) * .3}
+    hp = {"wo": jax.random.normal(jax.random.PRNGKey(1), (d, d)) * .3}
+    x = jax.random.normal(jax.random.PRNGKey(2), (M, 4, d))
+    lbl = jax.random.normal(jax.random.PRNGKey(3), (M, 4, d))
+    hm = init_hybrid_mesh(dp=1, pp=S, tp=1, set_global=False)
+
+    def run(schedule):
+        with hm.mesh:
+            return pipeline_train_async(
+                stage_fn, head_fn, sp, hp, x, lbl, num_stages=S,
+                variant="zb", mesh=hm.mesh, _schedule=schedule)
+
+    with hm.mesh:
+        jaxpr = jax.make_jaxpr(lambda: run(mutated))()
+    target = GraphTarget(
+        name="toy.zb_mutated", jaxpr=jaxpr,
+        meta={"expected_scan_trips": sched.ticks})
+    errs = [f for f in CollectiveConsistencyPass().run(target)
+            if f.severity == Severity.ERROR]
+    assert errs and "trip count" in errs[0].message
+    # and the grads really are wrong: W carried those contributions
+    good = jax.jit(lambda: run(sched))()
+    bad = jax.jit(lambda: run(mutated))()
+    np.testing.assert_allclose(good[0], bad[0], rtol=1e-6)  # loss ok
+    assert not np.allclose(np.asarray(good[1]["w"]),
+                           np.asarray(bad[1]["w"]), rtol=1e-3)

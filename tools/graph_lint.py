@@ -18,8 +18,11 @@ runs, in seconds and with zero XLA compiles:
     `serving_programs`);
   * the TRAINING passes (sharding-lint, donation-audit, hbm-peak,
     collective-consistency trip counts) over the llama auto-parallel
-    train step at the dp / dp×mp / pp-1F1B / zero1 geometries plus the
-    1F1B stage-chunk group (analysis/training_graphs.py);
+    train step at the dp / dp×mp / pp-1F1B / zero1 geometries, the
+    rank-asymmetric pipeline schedules (pp2_zb W-deferral, pp4_async
+    per-rank 1F1B — `--json` carries their trip/phase inventory as
+    `pipeline_schedules`), plus the 1F1B stage-chunk group
+    (analysis/training_graphs.py);
   * the REWRITE suite (analysis/rewrite.py): every registered rewrite
     pass applied to its flagship targets — the jnp-rmsnorm serving
     graphs and the unfused-int8 decode step — with each expected
@@ -148,6 +151,16 @@ def main(argv=None):
                     "metric": RECOMPILES_METRIC,
                     "schema": "paddle_tpu.program_inventory/1",
                 }}
+    if args.suite in ("all", "training"):
+        # the training-schedule counterpart of serving_programs: the
+        # pipeline schedules' expected trip/phase inventory (tick
+        # counts, per-op-kind rank-ticks, modeled efficiency) — one
+        # diffable schema next to the serving program inventory, and
+        # the same numbers the collective-consistency pass pins via
+        # expected_scan_trips on the traced train steps
+        from paddle_tpu.analysis.training_graphs import (
+            schedule_inventory)
+        out["pipeline_schedules"] = schedule_inventory()
     if rw_table is not None:
         out["rewrite"] = rw_table
     out["hbm"] = [
