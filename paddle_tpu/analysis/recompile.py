@@ -38,7 +38,7 @@ from .framework import (Finding, GraphTarget, LintPass, Severity,
 
 __all__ = ["ServingGeometry", "enumerate_chunk_programs",
            "enumerate_tick_programs", "program_inventory",
-           "RecompileHazardPass"]
+           "tick_budget", "tick_width_grid", "RecompileHazardPass"]
 
 
 @dataclass
@@ -54,6 +54,9 @@ class ServingGeometry:
     ragged: bool = False
     max_batch: int = 0
     decode_block: int = 1
+    # speculative decoding (r15): draft-length cap; > 0 routes every
+    # span-carrying tick through the ONE verify program per width
+    spec_k: int = 0
 
     @staticmethod
     def of_engine(engine) -> "ServingGeometry":
@@ -67,7 +70,8 @@ class ServingGeometry:
             prefill_chunk=engine._chunk,
             ragged=True,
             max_batch=engine.scheduler.max_batch,
-            decode_block=engine._decode_block)
+            decode_block=engine._decode_block,
+            spec_k=engine._spec_k)
 
 
 def _bucket(n: int, buckets) -> int:
@@ -83,6 +87,22 @@ def tick_budget(geom: ServingGeometry) -> int:
     arithmetic as ``ServingEngine.__init__``)."""
     return (int(geom.prefill_chunk) if geom.prefill_chunk is not None
             else int(geom.buckets[-1]))
+
+
+def tick_width_grid(geom: ServingGeometry) -> List[int]:
+    """The engine's packed-width grid (the same arithmetic as
+    ``ServingEngine.__init__`` — pinned against a live engine by
+    test): prompt buckets capped at the prefill budget, plus the
+    budget itself; a speculative geometry adds the all-slots-drafting
+    width ``S*(1+spec_k)`` and the combined worst case on top, so
+    every reachable span-token total (prefill spans + draft spans)
+    snaps to a small static set."""
+    budget = tick_budget(geom)
+    grid = {min(int(b), budget) for b in geom.buckets} | {budget}
+    if geom.spec_k:
+        spec_max = int(geom.max_batch) * (1 + int(geom.spec_k))
+        grid |= {spec_max, budget + spec_max}
+    return sorted(grid)
 
 
 def enumerate_tick_programs(geom: ServingGeometry) -> Dict[int,
@@ -102,17 +122,30 @@ def enumerate_tick_programs(geom: ServingGeometry) -> Dict[int,
       width ``max_batch``, or — when a live request samples — the
       single-step ``serving_tick`` at the same width.
 
+    A SPECULATIVE geometry (``spec_k > 0``) changes the mixed widths,
+    not the bound: every tick carrying spans or drafts — prefill-only
+    ticks included — runs the ONE ``spec_k``-static verify program for
+    its width (speculation replaces the fused greedy tail there, so
+    the tail variant is unreachable), and the width grid grows the two
+    speculative entries (``tick_width_grid``). Width ``max_batch``
+    keeps its two programs: pure-sampling ticks run the single-step
+    base tick and draft-less pure-greedy ticks still run the fused
+    block — a slot degraded by the acceptance policy is a data state,
+    not a new program.
+
     Nothing else is reachable, whatever the traffic: the bound is
     1-2 programs per width bucket by construction.
     """
     S = int(geom.max_batch)
     k = int(geom.decode_block)
-    budget = tick_budget(geom)
-    grid = sorted({min(int(b), budget) for b in geom.buckets}
-                  | {budget})
-    mixed: Set[str] = {f"serving_tick[mixed,tail={k - 1}]"}
-    if k > 1:
-        mixed.add("serving_tick[mixed,tail=0]")     # sampling ticks
+    grid = tick_width_grid(geom)
+    if geom.spec_k:
+        mixed: Set[str] = {f"serving_tick[verify,spec_k="
+                           f"{int(geom.spec_k)}]"}
+    else:
+        mixed = {f"serving_tick[mixed,tail={k - 1}]"}
+        if k > 1:
+            mixed.add("serving_tick[mixed,tail=0]")     # sampling ticks
     out: Dict[int, Set[str]] = {S + w: set(mixed) for w in grid}
     out[S] = {"serving_tick[decode]", f"serving_tick_block[k={k}]"}
     return out

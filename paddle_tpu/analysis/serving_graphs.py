@@ -32,7 +32,8 @@ def engine_geometry(*, page_size: int, max_prompt_len: int,
                     prompt_buckets=None,
                     prefix_cache: bool = True,
                     max_batch: int = 8,
-                    decode_block: int = 1) -> ServingGeometry:
+                    decode_block: int = 1,
+                    spec_k: int = 0) -> ServingGeometry:
     """The ``ServingGeometry`` a ``ServingEngine(**same_kwargs)`` would
     run — the same arithmetic as the engine ctor, computable without
     building pools or starting workers (tests pin the two against each
@@ -51,7 +52,7 @@ def engine_geometry(*, page_size: int, max_prompt_len: int,
         attach_quantum=1 if prefix_cache else 0,
         prefill_chunk=prefill_chunk,
         ragged=True, max_batch=int(max_batch),
-        decode_block=int(decode_block))
+        decode_block=int(decode_block), spec_k=int(spec_k))
 
 
 def _get_model(name: str):
@@ -72,13 +73,20 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
                     page_size: int = 4, max_prompt_len: int = 16,
                     max_new_tokens_cap: int = 16,
                     prefill_chunk: int = 8,
-                    decode_block: int = 4) -> List[GraphTarget]:
+                    decode_block: int = 4,
+                    spec_k: int = 3) -> List[GraphTarget]:
     """GraphTargets for one model's flagship serving programs — the
     r12 one-program-tick set: ``serving_tick`` at both reachable packed
     widths (mixed prefill+decode and decode-only/sampling),
     ``serving_tick_block`` (the fused greedy path) and
     ``generate_paged`` (the offline batched decode), plus the engine
-    geometry riding the block target for the recompile-hazard pass."""
+    geometry riding the block target for the recompile-hazard pass —
+    and, since r15, the speculative VERIFY tick
+    (``serving_tick[verify]`` at the all-slots-drafting width, spec_k
+    static, draft/acceptance geometry as device data) carrying the
+    SPECULATIVE engine geometry, so the recompile pass statically
+    proves the draft/verify program set keeps the
+    ≤2-programs-per-width-bucket invariant too."""
     import jax
     import jax.numpy as jnp
 
@@ -134,6 +142,33 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
             static_kwargs=dict(cfg=cfg, tq=tq, attn_impl="dense"),
             compute_dtype=cfg.dtype, slots=slots,
             donated_outputs=(2, 3), meta=dict(meta)))
+
+    # --- the speculative verify tick (r15): drafted slots as ragged
+    # spans + in-graph longest-prefix acceptance. Traced at the
+    # all-slots-drafting width; the SPECULATIVE engine geometry rides
+    # this target, so graph_lint proves the draft/verify program set
+    # stays within the per-bucket bound (emitted as
+    # serving_programs_spec in --json)
+    spec_geom = engine_geometry(
+        page_size=page_size, max_prompt_len=max_prompt_len,
+        max_new_tokens_cap=max_new_tokens_cap,
+        prefill_chunk=prefill_chunk, max_batch=slots,
+        decode_block=decode_block, spec_k=spec_k)
+    Tv = slots + slots * (1 + spec_k)
+    ver_meta = dict(
+        tick_meta(Tv),
+        ver_idx=sds((slots, 1 + spec_k), i32),
+        draft_tok=sds((slots, spec_k), i32),
+        draft_len=sds((slots,), i32),
+        tail_live=jax.ShapeDtypeStruct((slots,), jnp.bool_))
+    targets.append(trace_graph(
+        f"{model}.serving_tick[verify,spec_k={spec_k}]",
+        mod.serving_tick,
+        (params, sds((Tv,), i32), ver_meta, kp, vp),
+        static_kwargs=dict(cfg=cfg, tq=slots * (1 + spec_k),
+                           spec_k=spec_k, attn_impl="dense"),
+        compute_dtype=cfg.dtype, slots=slots,
+        donated_outputs=(3, 4), meta=dict(meta, geometry=spec_geom)))
 
     # --- fused greedy decode block: the per-tick hot program ---------
     targets.append(trace_graph(

@@ -200,9 +200,17 @@ class TraceResult:
                 self.traced_ids.add(id(t))
 
 
-def trace_layer_graph(model, x: Tensor) -> TraceResult:
+def trace_layer_graph(model, x: Tensor, leaves=None) -> TraceResult:
     """Run ``model(x)`` in eval/no-grad with recording hooks installed;
-    restores training mode and hooks afterwards."""
+    restores training mode and hooks afterwards.
+
+    ``leaves`` sets the trace granularity: the layers treated as
+    ATOMIC (one "layer" event each; anything inside them — sublayer
+    calls, functional ops — is masked by the depth counter). Default
+    None = the model's leaf sublayers (the ONNX-export shape). The
+    auto-parallel Engine's pp forward-order check passes its top-level
+    UNITS here, so "op" events then mean exactly "functional math
+    between units" — glue a stage loop cannot reproduce."""
     from ..autograd import tape as _tape
     from ..ops import registry as _registry
 
@@ -225,8 +233,11 @@ def trace_layer_graph(model, x: Tensor) -> TraceResult:
             src = inputs[0] if isinstance(inputs, tuple) else inputs
             res.keep.append(src)
 
-    leaves = [s for _, s in model.named_sublayers(include_self=True)
-              if not list(s.sublayers())]
+    if leaves is None:
+        leaves = [s for _, s in model.named_sublayers(include_self=True)
+                  if not list(s.sublayers())]
+    else:
+        leaves = list(leaves)
     for s in leaves:
         hooks.append(s.register_forward_pre_hook(pre))
         hooks.append(s.register_forward_post_hook(post))

@@ -267,46 +267,44 @@ class Engine:
         model whose forward calls them in another order, reuses one, or
         does math BETWEEN units (extra residual, functional glue) would
         silently train different math under pp_degree > 1. One traced
-        forward (eval + no_grad, so no RNG is consumed and no buffers
-        move) must show: every unit called exactly once, in definition
-        order, each unit's output fed VERBATIM as the next unit's input,
-        and the last unit's output returned as the model output.
+        forward through the shared layer-graph tracer
+        (``core.graph_trace.trace_layer_graph`` with the UNITS as the
+        trace granularity — eval + no_grad, so no RNG is consumed and
+        no buffers move) must show: a layer-event sequence equal to the
+        unit list (every unit exactly once, in definition order), ZERO
+        top-level functional-op events (an op event at unit granularity
+        IS math between units — the tracer's depth mask hides
+        everything inside a unit's own forward), each unit's output fed
+        VERBATIM as the next unit's input, and the last unit's output
+        returned as the model output.
 
         Known limit: a forward_pre_hook that REPLACES a unit's input
         (e.g. shard_layer's input_fn) breaks the identity chain and is
         rejected here even though the stage loop would reproduce it —
         pre-hook input rewriting is unsupported under Engine pp."""
-        from ...autograd import tape as _tape
+        from ...core.graph_trace import trace_layer_graph
         pre, blocks, post = self._pp_blocks
         units = [*pre, *blocks, *post]
-        events: List = []
-        hooks = []
-
-        def post_hook(layer, inputs, output):
-            src = inputs[0] if isinstance(inputs, tuple) else inputs
-            events.append((layer, src, output))
-
-        for u in units:
-            hooks.append(u.register_forward_post_hook(post_hook))
-        # snapshot per-sublayer training flags: a blanket train() after
-        # eval() would clobber deliberately-frozen submodules (a user's
+        # snapshot per-sublayer training flags: the tracer's own
+        # restore is model-wide (a blanket train() after eval() would
+        # clobber deliberately-frozen submodules — a user's
         # model.backbone.eval() before fit)
         modes = [(l, l.training)
                  for l in self.model.sublayers(include_self=True)]
-        self.model.eval()
         try:
-            with _tape.no_grad():
-                y = self.model(Tensor(x, stop_gradient=True))
+            tr = trace_layer_graph(self.model,
+                                   Tensor(x, stop_gradient=True),
+                                   leaves=units)
         finally:
             for l, flag in modes:
                 l.training = flag
-            for h in hooks:
-                h.remove()
 
         def name(u):
             return type(u).__name__
 
-        called = [e[0] for e in events]
+        layer_events = [e for e in tr.events if e[0] == "layer"]
+        op_events = [e for e in tr.events if e[0] == "op"]
+        called = [e[1] for e in layer_events]
         if called != units:
             raise ValueError(
                 "Engine pipeline parallelism requires the model's forward "
@@ -315,21 +313,36 @@ class Engine:
                 f"{[name(u) for u in called]} != unit list "
                 f"{[name(u) for u in units]}. Reorder the sublayer "
                 "definitions to match the forward (or use the dp/mp path)")
-        for (u_a, _, out_a), (u_b, in_b, _) in zip(events, events[1:]):
+        for ev_a, ev_b in zip(layer_events, layer_events[1:]):
+            out_a = ev_a[3]
+            in_b = ev_b[2][0] if isinstance(ev_b[2], tuple) else ev_b[2]
             if out_a is not in_b:
                 raise ValueError(
                     f"Engine pipeline parallelism: the output of "
-                    f"{name(u_a)} is not (identically) the input of "
-                    f"{name(u_b)} — the forward does extra math between "
+                    f"{name(ev_a[1])} is not (identically) the input of "
+                    f"{name(ev_b[1])} — the forward does extra math between "
                     "units (residual/functional glue), which the stage "
                     "loop cannot reproduce; fold it into a unit or use "
                     "the dp/mp path")
-        if events and y is not events[-1][2]:
+        if layer_events and tr.y is not layer_events[-1][3]:
             raise ValueError(
                 "Engine pipeline parallelism: the model output is not "
-                f"(identically) the last unit's ({name(events[-1][0])}) "
+                f"(identically) the last unit's "
+                f"({name(layer_events[-1][1])}) "
                 "output — the forward post-processes it outside the unit "
                 "list; fold that into a unit or use the dp/mp path")
+        if op_events:
+            # survived the identity checks yet ran top-level functional
+            # ops: glue the chain cannot see — e.g. input rewriting
+            # BEFORE the first unit, or side computations off the
+            # residual stream (the tracer's depth mask guarantees these
+            # ran OUTSIDE every unit's own forward)
+            raise ValueError(
+                "Engine pipeline parallelism: the forward runs "
+                "functional ops outside the unit list "
+                f"({sorted({e[1] for e in op_events})}) — extra math "
+                "between units the stage loop cannot reproduce; fold "
+                "it into a unit or use the dp/mp path")
         self._pp_verified = True
 
     def prepare(self, sample_input=None):

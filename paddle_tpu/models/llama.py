@@ -1275,8 +1275,8 @@ def serving_decode_block(params, tok, lengths, tables, k_pages, v_pages,
 
 
 def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
-                 decode_tail: int = 0, attn_impl: str = "auto",
-                 _block_fn=None):
+                 decode_tail: int = 0, spec_k: int = 0,
+                 attn_impl: str = "auto", _block_fn=None):
     """ONE ragged serving tick: any mix of chunked prefills, warm-prefix
     attaches and decode steps as a single static program.
 
@@ -1322,13 +1322,49 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
     advance — mid-prefill slots stay dead through the tail (q_len 0,
     KV writes to the trash page).
 
+    ``spec_k`` (STATIC — the engine's draft-length cap; one compile
+    per value, and a speculative engine uses exactly one) turns the
+    tick into the speculative VERIFY program: speculating slots
+    submitted their current token plus up to ``spec_k`` draft tokens
+    as an ordinary ragged span (the same packed stream, mixed with
+    prefill spans and plain decode slots), and the tick additionally
+    computes the target model's greedy argmax at EVERY span position
+    plus the in-graph longest-prefix acceptance against the drafts.
+    Three extra ``meta`` arrays carry the (per-slot, DATA-not-shape)
+    speculation geometry:
+
+    * ``ver_idx [S, 1+spec_k]``: packed index of each slot's span
+      token ``j`` (position ``j``'s hidden state predicts span
+      position ``j+1``); non-speculating slots point every entry at
+      their ``last`` token, so their row 0 reproduces the plain
+      tick's logits/argmax exactly;
+    * ``draft_tok [S, spec_k]`` / ``draft_len [S]``: the draft tokens
+      and each slot's actual draft count ``k_s <= spec_k`` (0 for
+      non-speculating slots — adaptive k is data, the cap is the only
+      shape).
+
+    ``spec_k`` and ``decode_tail`` are mutually exclusive (speculation
+    IS the multi-token lever on a speculative engine).
+
     Returns ``(toks, logits [S, V] f32, k_pages', v_pages')``:
     ``toks`` is the in-graph greedy argmax of each slot's last-position
     logits — ``[S]`` i32 when ``decode_tail == 0``, else
     ``[S, 1+decode_tail]`` (the host pulls only these ints on greedy
     ticks); ``logits`` is the RAGGED pass's (first step's) logits and
     stays on device unless a sampling request actually fetches its row
-    (sampling ticks run ``decode_tail=0``).
+    (sampling ticks run ``decode_tail=0``). With ``spec_k > 0`` the
+    return is ``(toks [S, 1+spec_k], accept [S], logits [S, V] f32,
+    k_pages', v_pages')``: ``toks[s, j]`` is the target argmax after
+    consuming span tokens ``0..j``, ``accept[s]`` the number of
+    leading drafts matching it (``toks[s, :accept[s]]`` equal the
+    drafts token-for-token and ``toks[s, accept[s]]`` is the bonus/
+    correction token — ``1 + accept`` emitted tokens from ONE target
+    launch), and ``logits`` is row 0's logits (``ver_idx[:, 0]``
+    points at ``last`` for every slot a host would sample from).
+    Rejected draft KV needs no device-side rollback: the stale rows
+    sit past the slot's advanced length, masked by ``kv_len`` until
+    the sequence's real tokens overwrite them positionally — the same
+    trash-row discipline retiring overruns already rely on.
 
     Exactness: the span's KV is scattered into the pages FIRST, then
     the ragged kernel attends over pages only, bottom-right causal —
@@ -1341,6 +1377,12 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
         ragged_paged_attention_packed)
     block_fn = _block_fn if _block_fn is not None else _block
     tq = int(tq)
+    spec_k = int(spec_k)
+    decode_tail = int(decode_tail)
+    if spec_k and decode_tail:
+        raise ValueError("spec_k and decode_tail are mutually "
+                         "exclusive (speculation replaces the "
+                         "fused greedy tail)")
     S = meta["q_len"].shape[0]
     tok_slot = meta["tok_slot"]
     tok_qoff = meta["tok_qoff"]
@@ -1373,10 +1415,27 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
     h, (kp_new, vp_new) = lax.scan(body, h, (params["layers"], k_pages,
                                              v_pages))
     h = rms_norm(h[0], params["final_norm"], cfg.rms_norm_eps)  # [T, D]
+    if spec_k:
+        # logits at EVERY span position of every slot — the verify
+        # pass's whole point: one launch prices 1+spec_k predictions
+        h_ver = h[meta["ver_idx"]]                  # [S, 1+spec_k, D]
+        logits_ver = _mm(h_ver, params["lm_head"]).astype(jnp.float32)
+        toks = jnp.argmax(logits_ver, axis=-1).astype(jnp.int32)
+        # longest-prefix acceptance: draft j is accepted iff every
+        # draft 0..j matched the target argmax at its span position
+        # (cumprod zeroes everything after the first mismatch) and j
+        # is a real draft (j < draft_len — adaptive k is data)
+        j = jnp.arange(spec_k)
+        match = ((toks[:, :spec_k] == meta["draft_tok"])
+                 & (j[None, :] < meta["draft_len"][:, None]))
+        accept = jnp.cumprod(match.astype(jnp.int32), axis=1) \
+                    .sum(axis=1).astype(jnp.int32)
+        # row 0 == the plain tick's logits for every non-speculating
+        # slot (ver_idx[:, 0] = last there): sampling slots read it
+        return toks, accept, logits_ver[:, 0], kp_new, vp_new
     h_last = h[meta["last"]]                                    # [S, D]
     logits = _mm(h_last, params["lm_head"]).astype(jnp.float32)
     toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    decode_tail = int(decode_tail)
     if not decode_tail:
         return toks, logits, kp_new, vp_new
 

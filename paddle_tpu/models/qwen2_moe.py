@@ -431,12 +431,12 @@ def serving_decode_block(params, tok, lengths, tables, k_pages, v_pages,
 
 
 def serving_tick(params, tokens, meta, k_pages, v_pages, cfg,
-                 tq: int = 1, decode_tail: int = 0,
+                 tq: int = 1, decode_tail: int = 0, spec_k: int = 0,
                  attn_impl: str = "auto"):
     from .llama import serving_tick as _impl
     return _impl(params, tokens, meta, k_pages, v_pages, cfg, tq=tq,
-                 decode_tail=decode_tail, attn_impl=attn_impl,
-                 _block_fn=_decode_block)
+                 decode_tail=decode_tail, spec_k=spec_k,
+                 attn_impl=attn_impl, _block_fn=_decode_block)
 
 
 def serving_tick_block(params, tok, lengths, tables, k_pages, v_pages,

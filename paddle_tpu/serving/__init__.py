@@ -16,6 +16,9 @@ shape (PAPERS.md).
     RequestHandle   — per-request token stream / blocking result
     ServingMetrics  — counters + latency histograms + Prometheus text
                       exposition (serving/metrics.py)
+    NGramDrafter    — self-drafting n-gram proposer for speculative
+                      decoding; AcceptancePolicy — the adaptive draft
+                      budget (serving/speculative.py)
 
 Runtime observability (span tracer, flight-recorder postmortems, the
 live recompile sentinel) lives in paddle_tpu/observability/ and is
@@ -29,7 +32,9 @@ from .prefix_cache import PrefixCache  # noqa: F401
 from .scheduler import (Request, RequestHandle, Scheduler,  # noqa: F401
                         CANCELLED, COMPLETED, QUEUED, REJECTED, RUNNING,
                         TIMED_OUT)
+from .speculative import (AcceptancePolicy, NGramDrafter)  # noqa: F401
 
 __all__ = ["ServingEngine", "Scheduler", "PrefixCache", "Request",
-           "RequestHandle", "ServingMetrics", "Histogram", "QUEUED",
+           "RequestHandle", "ServingMetrics", "Histogram",
+           "NGramDrafter", "AcceptancePolicy", "QUEUED",
            "RUNNING", "COMPLETED", "CANCELLED", "TIMED_OUT", "REJECTED"]

@@ -150,7 +150,7 @@ def _jit_step_fns(mod, cfg, attn_impl: str, rewrites: bool = False):
     tick = jax.jit(_rw(partial(mod.serving_tick, cfg=cfg,
                                attn_impl=attn_impl)),
                    donate_argnums=(3, 4),
-                   static_argnames=("tq", "decode_tail"))
+                   static_argnames=("tq", "decode_tail", "spec_k"))
     blk = jax.jit(_rw(partial(mod.serving_tick_block, cfg=cfg,
                               attn_impl=attn_impl)), donate_argnums=(4, 5),
                   static_argnames=("num_steps",))
@@ -247,6 +247,31 @@ class ServingEngine:
     and records a sentinel span — the runtime alarm form of the static
     ≤2-programs-per-bucket proof. Default from
     ``PADDLE_TPU_SERVING_SENTINEL`` (on when unset).
+    speculative: None (default, off); True/"ngram" = self-drafting
+    speculative decoding (serving/speculative.py NGramDrafter — prompt
+    lookup over the request's own history, zero model cost); or any
+    object with ``propose(history, k) -> tokens`` / bare callable (the
+    pluggable draft-model hook). Each tick, every live GREEDY slot may
+    submit its current token plus up to ``spec_k`` draft tokens as an
+    ordinary ragged span of the one-program tick; the target model
+    verifies the whole span in ONE launch (in-graph longest-prefix
+    acceptance against its own argmax) and the slot emits
+    ``1 + accepted`` tokens. Greedy outputs stay bitwise-equal to the
+    non-speculative engine and to ``generate()`` whatever the drafter
+    proposes (tests/test_speculative.py pins every cache state);
+    rejected draft KV needs no rollback — the stale rows sit past the
+    slot's length, masked until real tokens overwrite them (the same
+    trash-row discipline as retiring overruns). Scheduling is
+    acceptance-aware: a per-request acceptance EWMA adapts each slot's
+    draft budget, degrading low-acceptance slots to plain one-token
+    decode (with periodic probes). Speculation replaces the fused
+    greedy tail on mixed ticks (``decode_tail`` and ``spec_k`` are
+    mutually exclusive programs); pure-decode ticks with no drafts
+    still run the fused block, so the program set stays ≤2 per width
+    bucket — statically proven via the spec-aware
+    ``enumerate_tick_programs``.
+    spec_k: draft-length CAP (static — the one extra compile knob; a
+    slot's actual per-tick draft count is device data).
     """
 
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
@@ -266,7 +291,9 @@ class ServingEngine:
                  trace_capacity: int = 65536,
                  flight_ticks: int = 64,
                  flight_dir: Optional[str] = None,
-                 recompile_sentinel: Optional[bool] = None):
+                 recompile_sentinel: Optional[bool] = None,
+                 speculative=None,
+                 spec_k: int = 3):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if prefill_chunk is not None:
@@ -316,14 +343,35 @@ class ServingEngine:
         # (scheduling) role. None = absorb a whole suffix in one tick.
         self._budget = int(prefill_chunk) if prefill_chunk is not None \
             else max_bucket
+        # speculative decoding (serving/speculative.py): drafter +
+        # per-request adaptive-k policy; None = off (spec_k then plays
+        # no role and compiles nothing)
+        from .speculative import AcceptancePolicy, resolve_drafter
+        self._drafter = resolve_drafter(speculative)
+        if self._drafter is not None and int(spec_k) < 1:
+            raise ValueError(f"spec_k must be >= 1 when speculative "
+                             f"decoding is on, got {spec_k}")
+        self._spec_k = int(spec_k) if self._drafter is not None else 0
+        self._spec_policy = (AcceptancePolicy(self._spec_k)
+                             if self._drafter is not None else None)
         # packed-width grid: a spans tick runs at the smallest width
         # covering its ACTUAL span tokens (a warm attach whose suffix
         # is 40 tokens must not pay the 256-wide cold program). This
         # pads the program like any jit bucket pad — geometry stays
         # data (span offsets, prefix sizes, cache lengths), so it has
         # no exactness role, unlike the deleted chunk/attach quanta.
-        self._w_grid = sorted({min(b, self._budget)
-                               for b in self._buckets} | {self._budget})
+        # With speculation on, spec spans add up to S*(1+spec_k)
+        # tokens on top of the prefill budget: the grid grows two
+        # entries (the all-slots-drafting width and the combined
+        # worst case) so every reachable span-token total still snaps
+        # to a small static set — mirrored EXACTLY by
+        # analysis/recompile.tick_width_grid (pinned by test).
+        grid = {min(b, self._budget) for b in self._buckets} \
+            | {self._budget}
+        if self._spec_k:
+            spec_max = max_batch * (1 + self._spec_k)
+            grid |= {spec_max, self._budget + spec_max}
+        self._w_grid = sorted(grid)
         # statically prove the one-program-tick invariant for THIS
         # geometry (the recompile-hazard pass, analysis/recompile.py):
         # the ragged engine reaches exactly {serving_tick@S+w (w in the
@@ -340,7 +388,8 @@ class ServingEngine:
             buckets=list(self._buckets),
             attach_quantum=1 if self.prefix_cache is not None else 0,
             prefill_chunk=prefill_chunk, ragged=True,
-            max_batch=max_batch, decode_block=self._decode_block)
+            max_batch=max_batch, decode_block=self._decode_block,
+            spec_k=self._spec_k)
         # the static proof's inventory, kept on the engine: the
         # recompile sentinel reports it as "expected", the flight
         # recorder ships it with every postmortem, and graph_lint
@@ -542,6 +591,79 @@ class ServingEngine:
         if self.sentinel is not None:
             self.sentinel.arm()
 
+    def warm_programs(self) -> int:
+        """Eagerly compile every tick program the static inventory
+        enumerates, via all-padding no-op ticks (every packed token is
+        the padding sentinel, every KV write lands on the trash page,
+        every output row is junk the caller discards) — so the compile
+        set is covered DETERMINISTICALLY instead of depending on which
+        widths traffic happens to hit. This is what lets the recompile
+        sentinel be armed right after construction and stay clean: on a
+        speculative engine the reachable verify widths depend on
+        per-tick draft counts, which a traffic-shaped warmup cannot
+        guarantee to cover. Safe any time (serialized against ticks;
+        real pages are never read into outputs that matter nor
+        written). Returns the number of jit invocations made."""
+        jnp = self._jnp
+        S = self.scheduler.max_batch
+        pps = self.scheduler.pages_per_slot
+        n = 0
+        with self._tick_lock:
+            tabs = np.full((S, pps), PagePool.TRASH, np.int32)
+            zs = np.zeros((S,), np.int32)
+
+            def pad_meta(T):
+                m = dict(
+                    tok_slot=jnp.asarray(np.full((T,), S, np.int32)),
+                    tok_pos=jnp.asarray(np.zeros((T,), np.int32)),
+                    tok_page=jnp.asarray(
+                        np.full((T,), PagePool.TRASH, np.int32)),
+                    tok_off=jnp.asarray(np.zeros((T,), np.int32)),
+                    tok_qoff=jnp.asarray(np.zeros((T,), np.int32)),
+                    q_len=jnp.asarray(zs), kv_len=jnp.asarray(zs),
+                    last=jnp.asarray(zs), tables=jnp.asarray(tabs),
+                    tail_live=jnp.asarray(np.zeros((S,), bool)))
+                return m
+
+            def spec_meta(T):
+                m = pad_meta(T)
+                k = self._spec_k
+                m.update(ver_idx=jnp.asarray(
+                             np.zeros((S, 1 + k), np.int32)),
+                         draft_tok=jnp.asarray(np.zeros((S, k),
+                                                        np.int32)),
+                         draft_len=jnp.asarray(zs))
+                return m
+
+            # mixed widths (the spans tick — verify program on a
+            # speculative engine, tail/no-tail variants otherwise)
+            for w in self._w_grid:
+                T = S + w
+                tok = jnp.asarray(np.zeros((T,), np.int32))
+                if self._spec_k:
+                    _, _, _, self._kp, self._vp = self._tick_jit(
+                        self._params, tok, spec_meta(T), self._kp,
+                        self._vp, tq=w, decode_tail=0,
+                        spec_k=self._spec_k)
+                    n += 1
+                else:
+                    tails = {self._decode_block - 1, 0}
+                    for tail in sorted(tails, reverse=True):
+                        _, _, self._kp, self._vp = self._tick_jit(
+                            self._params, tok, pad_meta(T), self._kp,
+                            self._vp, tq=w, decode_tail=tail)
+                        n += 1
+            # width S: the single-step (sampling) tick + fused block
+            tok = jnp.asarray(zs)
+            _, _, self._kp, self._vp = self._tick_jit(
+                self._params, tok, pad_meta(S), self._kp, self._vp,
+                tq=1, decode_tail=0)
+            _, self._kp, self._vp = self._block_jit(
+                self._params, tok, jnp.asarray(zs), jnp.asarray(tabs),
+                self._kp, self._vp, num_steps=self._decode_block)
+            n += 2
+        return n
+
     def audit(self):
         """Standalone paged-KV invariant audit (serialized against
         ticks): returns the violation list — empty when healthy."""
@@ -560,7 +682,8 @@ class ServingEngine:
                 f"max_batch={self.scheduler.max_batch} "
                 f"buckets={self._buckets} width_grid={self._w_grid} "
                 f"prefill_chunk={self._chunk} "
-                f"decode_block={self._decode_block}")
+                f"decode_block={self._decode_block} "
+                f"spec_k={self._spec_k}")
 
     def _audit_or_raise(self) -> None:
         """Per-tick debug-mode check (caller holds the tick lock)."""
@@ -815,8 +938,51 @@ class ServingEngine:
         if self._emit(slot, req, tok):
             self._retire(slot, COMPLETED)
 
+    # ------------------------------------------------------- speculation ----
+    def _collect_drafts(self, live):
+        """The tick's draft side (host, model-free by default): ask the
+        drafter for up to ``policy.budget(...)`` next tokens per live
+        GREEDY slot. Returns ``{slot: int32[k_s]}`` with ``1 <= k_s <=
+        spec_k``; slots with no entry decode plainly this tick.
+        Drafting never blocks correctness — an arbitrarily wrong draft
+        only costs the wasted span rows (verification emits the
+        target's own tokens)."""
+        drafts = {}
+        t0 = time.monotonic()
+        # a drafter that declares its history window (NGramDrafter
+        # does) gets only that tail — rebuilding the FULL
+        # prompt+generated array per slot per tick would be O(produced)
+        # host work on the hot path for long generations; drafters
+        # without the attribute keep the whole-history contract
+        window = getattr(self._drafter, "max_history", None)
+        for slot, req in live:
+            if req.temperature != 0.0:
+                continue    # speculation is a greedy-only lever
+            remaining = req.max_new_tokens - int(self._produced[slot]) - 1
+            k = self._spec_policy.budget(req, remaining)
+            if k <= 0:
+                continue
+            toks = req.tokens if window is None else req.tokens[-window:]
+            parts = [np.asarray(toks, np.int32)]
+            if window is None or len(toks) < window:
+                need = None if window is None else window - len(toks)
+                parts.insert(0, req.prompt if need is None
+                             else req.prompt[-need:])
+            hist = np.concatenate(parts)
+            d = np.asarray(self._drafter.propose(hist, k),
+                           np.int32).reshape(-1)[:k]
+            if d.size:
+                drafts[slot] = d
+        if drafts and self.tracer.enabled:
+            self.tracer.add(
+                "serving.draft", "engine.draft", t0, time.monotonic(),
+                slots=len(drafts),
+                tokens=int(sum(d.size for d in drafts.values())))
+        return drafts
+
     # -------------------------------------------------------------- tick ----
-    def _ragged_tick(self, live, spans, tail: int = 0) -> None:
+    def _ragged_tick(self, live, spans, tail: int = 0,
+                     drafts=None) -> None:
         """ONE serving_tick call covering every live slot's decode token
         plus the collected prompt spans. Geometry is data: the program
         compiles once per packed width (S when no prefill work is
@@ -827,14 +993,31 @@ class ServingEngine:
         slots plus spans COMPLETING their prompt this tick — so an
         admission tick still produces a full decode block for in-flight
         streams (mid-prefill slots sit the tail out on the trash
-        page)."""
+        page).
+
+        ``drafts`` (``{slot: draft tokens}``, speculative engines only)
+        turns drafted slots into ordinary ragged SPANS: current token
+        plus the drafts, written-then-attended exactly like a prefill
+        chunk, with the verify/acceptance outputs computed in-graph
+        (``spec_k`` mode of ``serving_tick``). Any tick carrying spans
+        or drafts on a speculative engine runs the ONE verify program
+        for its width — prefill-only ticks included — which is what
+        keeps the per-bucket program count at 1 there."""
         jnp = self._jnp
         S = self.scheduler.max_batch
         ps = self.pool.page_size
         pps = self.scheduler.pages_per_slot
-        span_tok = sum(take for _, _, _, take in spans)
+        drafts = drafts or {}
+        # speculative engines route every span-carrying tick through
+        # the verify program (one program per mixed width); plain
+        # width-S ticks (pure sampling) stay on the shared base program
+        spec = self._spec_k if (drafts or spans) else 0
+        if spec:
+            tail = 0    # speculation replaces the fused greedy tail
+        span_tok = (sum(take for _, _, _, take in spans)
+                    + sum(1 + d.size for d in drafts.values()))
         width = next((w for w in self._w_grid if w >= span_tok),
-                     self._budget) if spans else 0
+                     self._w_grid[-1]) if span_tok else 0
         T = S + width
         tq = max(width, 1)
         tok = np.zeros((T,), np.int32)
@@ -848,6 +1031,8 @@ class ServingEngine:
         tabs = np.stack([self.scheduler.effective_row(s)
                          for s in range(S)]).astype(np.int32)
         for slot, req in live:
+            if slot in drafts:
+                continue    # rides the span region below
             tok[slot] = self._cur_tok[slot]
             tok_slot[slot] = slot
             tok_pos[slot] = self.scheduler.lengths[slot]
@@ -856,6 +1041,24 @@ class ServingEngine:
             last[slot] = slot
             tail_live[slot] = True
         idx = S
+        spec_rows = []                      # (slot, idx0, k_s)
+        for slot, req in live:
+            d = drafts.get(slot)
+            if d is None:
+                continue
+            k_s = int(d.size)
+            p0 = int(self.scheduler.lengths[slot])
+            tok[idx] = self._cur_tok[slot]
+            tok[idx + 1: idx + 1 + k_s] = d
+            tok_slot[idx: idx + 1 + k_s] = slot
+            tok_pos[idx: idx + 1 + k_s] = np.arange(p0, p0 + 1 + k_s)
+            tok_qoff[idx: idx + 1 + k_s] = np.arange(1 + k_s)
+            q_len[slot] = 1 + k_s
+            kv_len[slot] = p0 + 1 + k_s
+            last[slot] = idx + k_s
+            tail_live[slot] = True
+            spec_rows.append((slot, idx, k_s))
+            idx += 1 + k_s
         for slot, req, start, take in spans:
             tok[idx:idx + take] = req.prompt[start:start + take]
             tok_slot[idx:idx + take] = slot
@@ -883,23 +1086,52 @@ class ServingEngine:
                     q_len=jnp.asarray(q_len), kv_len=jnp.asarray(kv_len),
                     last=jnp.asarray(last), tables=jnp.asarray(tabs),
                     tail_live=jnp.asarray(tail_live))
+        if spec:
+            # verify geometry: per-slot span-position indices + drafts
+            # (all DATA — non-speculating slots point at `last`, so
+            # their row 0 is the plain tick's logits/argmax)
+            ver_idx = np.tile(last[:, None], (1, 1 + spec)).astype(
+                np.int32)
+            draft_tok = np.zeros((S, spec), np.int32)
+            draft_len = np.zeros((S,), np.int32)
+            for slot, idx0, k_s in spec_rows:
+                ver_idx[slot, :1 + k_s] = np.arange(idx0, idx0 + 1 + k_s)
+                ver_idx[slot, 1 + k_s:] = idx0 + k_s
+                draft_tok[slot, :k_s] = drafts[slot]
+                draft_len[slot] = k_s
+            meta.update(ver_idx=jnp.asarray(ver_idx),
+                        draft_tok=jnp.asarray(draft_tok),
+                        draft_len=jnp.asarray(draft_len))
         t0 = time.perf_counter()
+        m0 = time.monotonic()
         with RecordEvent("serving.tick"), \
                 self.tracer.span("serving.tick", track="engine.decode",
                                  tick=self._tick_no, width=int(width),
                                  live=len(live), span_tokens=int(span_tok),
-                                 tail=int(tail)):
-            toks_d, logits_d, self._kp, self._vp = self._tick_jit(
-                self._params, jnp.asarray(tok), meta, self._kp, self._vp,
-                tq=tq, decode_tail=tail)
-            # [S] (tail=0) or [S, 1+tail] i32 — the only eager pull
-            toks = np.asarray(toks_d)
+                                 tail=int(tail), spec=len(spec_rows)):
+            if spec:
+                toks_d, accept_d, logits_d, self._kp, self._vp = \
+                    self._tick_jit(self._params, jnp.asarray(tok), meta,
+                                   self._kp, self._vp, tq=tq,
+                                   decode_tail=0, spec_k=spec)
+                # [S, 1+spec_k] i32 + [S] i32 — the eager pulls
+                toks = np.asarray(toks_d)
+                accept = np.asarray(accept_d)
+            else:
+                toks_d, logits_d, self._kp, self._vp = self._tick_jit(
+                    self._params, jnp.asarray(tok), meta, self._kp,
+                    self._vp, tq=tq, decode_tail=tail)
+                # [S] (tail=0) or [S, 1+tail] i32 — the only eager pull
+                toks = np.asarray(toks_d)
+        m1 = time.monotonic()
         if toks.ndim == 1:
             toks = toks[:, None]
         if live:
             self.metrics.inc("decode_steps", 1 + tail)
             self.metrics.observe("decode_step_s",
                                  (time.perf_counter() - t0) / (1 + tail))
+        if spec_rows:
+            self.metrics.inc("spec_ticks")
 
         def next_tok(slot, req):
             if req.temperature == 0.0:
@@ -907,6 +1139,30 @@ class ServingEngine:
             return self._sample(slot, req, np.asarray(logits_d[slot]))
 
         for slot, req in live:
+            d = drafts.get(slot)
+            if d is not None:
+                # speculative slot: 1 + accept tokens from this ONE
+                # launch (verified prefix + the bonus/correction
+                # token); rejected draft KV stays past the advanced
+                # length — masked by kv_len until real tokens
+                # positionally overwrite it (no device-side rollback)
+                k_s = int(d.size)
+                a = int(accept[slot])
+                self.scheduler.lengths[slot] += 1 + a
+                self.metrics.inc("draft_tokens", k_s)
+                self.metrics.inc("draft_accepted", a)
+                self.metrics.inc("draft_rejected", k_s - a)
+                self.metrics.observe("spec_accept_rate", a / k_s)
+                self._spec_policy.update(req, k_s, a)
+                if self.tracer.enabled:
+                    self.tracer.add("spec.verify", f"slot{slot}", m0, m1,
+                                    req=req.id, drafted=k_s, accepted=a)
+                    if k_s > a:
+                        self.tracer.add("spec.rollback", f"slot{slot}",
+                                        m1, m1, req=req.id,
+                                        rejected=k_s - a)
+                self._emit_greedy(slot, req, toks[slot], 0, a + 1)
+                continue
             self.scheduler.lengths[slot] += 1 + tail
             t = next_tok(slot, req)
             self._cur_tok[slot] = t
@@ -964,7 +1220,20 @@ class ServingEngine:
         mid-prefill spans sit it out on the trash page regardless
         (``tail_live``), so a parked sampling request must not throttle
         in-flight greedy streams to one token per tick for the length
-        of its prefill."""
+        of its prefill.
+
+        Speculative engines add one branch on top: any tick with
+        drafts or prefill spans runs the verify program (drafted slots
+        as ragged spans, everything else riding along); a tick with
+        neither falls through to the plain paths — pure-greedy live
+        slots whose acceptance degraded them to k=0 still get the
+        fused block, so 'speculation off' is a per-slot data state,
+        not a different program set."""
+        if self._drafter is not None:
+            drafts = self._collect_drafts(live)
+            if drafts or spans:
+                self._ragged_tick(live, spans, 0, drafts)
+                return
         greedy_live = all(r.temperature == 0.0 for _, r in live)
         if not spans and greedy_live and live:
             self._block_tick(live)

@@ -90,7 +90,11 @@ class ServingMetrics:
     prefix_misses per admission, prefix_hit_tokens — prompt tokens NOT
     recomputed, prefix_pages_saved — pages attached instead of
     allocated), invariant_violations, recompiles (post-warmup XLA
-    compiles the recompile sentinel observed).
+    compiles the recompile sentinel observed), and speculative
+    decoding (spec_ticks — verify launches; draft_tokens /
+    draft_accepted / draft_rejected — per-draft-token outcomes:
+    launches-per-emitted-token is decode_steps / tokens_out, mean
+    acceptance draft_accepted / draft_tokens).
     Labeled counters (``inc_labeled``): the same monotonic semantics
     with a small label set — e.g. ``recompiles{during="serving.tick"}``
     names WHAT a post-warmup compile interrupted. Kept separate from
@@ -105,7 +109,8 @@ class ServingMetrics:
     admission shows up here as one huge stall), batch_occupancy (live
     slots / max_batch per tick), page_utilization (used / allocatable
     pages, sampled per tick), chunk_queue_depth (requests mid
-    chunked-prefill, sampled per tick). Histogram summaries report the
+    chunked-prefill, sampled per tick), spec_accept_rate (accepted /
+    drafted per speculative verify launch). Histogram summaries report the
     lifetime mean AND the windowed mean/percentiles separately — see
     :class:`Histogram`.
     """
@@ -115,10 +120,12 @@ class ServingMetrics:
                 "decode_steps", "tokens_out", "prefix_hits",
                 "prefix_misses", "prefix_hit_tokens",
                 "prefix_pages_saved", "invariant_violations",
-                "recompiles")
+                "recompiles", "spec_ticks", "draft_tokens",
+                "draft_accepted", "draft_rejected")
     HISTOGRAMS = ("queue_wait_s", "ttft_s", "decode_step_s",
                   "decode_stall_s", "batch_occupancy",
-                  "page_utilization", "chunk_queue_depth")
+                  "page_utilization", "chunk_queue_depth",
+                  "spec_accept_rate")
 
     def __init__(self):
         self._lock = threading.Lock()

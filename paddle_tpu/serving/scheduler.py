@@ -53,7 +53,7 @@ class Request:
                  "submit_t", "admit_t", "first_token_t", "finish_t",
                  "slot", "pages", "cancel_flag", "stream", "done",
                  "error", "prefix_nodes", "cached_len", "prefilling",
-                 "chunk_done", "table_row")
+                 "chunk_done", "table_row", "spec_rate", "spec_probe")
 
     def __init__(self, prompt, max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
@@ -86,6 +86,11 @@ class Request:
         self.chunk_done = 0                 # suffix tokens prefilled so far
         self.table_row = None               # real row while parked (the
         #                                     scheduler row is all-TRASH)
+        # speculative decoding (serving/speculative.py): running
+        # acceptance-rate EWMA (optimistic start — first drafts always
+        # get a chance) + probe counter for degraded slots
+        self.spec_rate = 1.0
+        self.spec_probe = 0
         self.cancel_flag = False
         self.stream: "queue.Queue" = queue.Queue()
         self.done = threading.Event()

@@ -1,0 +1,169 @@
+"""Speculative-decoding draft side: self-drafting n-gram proposer +
+the pluggable draft hook + the per-request adaptive-k policy.
+
+Single-stream greedy decode is weight-bandwidth-bound: one target
+launch streams every projection weight for ONE token (docs/PERF.md
+decode section — 0.69 of the int8 ceiling). Speculation changes the
+tokens-per-launch numerator instead of the bytes denominator: a cheap
+DRAFTER proposes up to ``k`` next tokens, the target model scores the
+whole draft as one ragged span through the SAME ``serving_tick``
+program (models/llama.py ``spec_k`` verify mode), and the in-graph
+longest-prefix acceptance emits ``1 + accepted`` tokens per launch.
+Greedy outputs stay bitwise-equal to plain decode whatever the drafter
+proposes: accepted drafts equal the target argmax BY CONSTRUCTION
+(that is the acceptance test), and the first non-matching position
+emits the target's own correction token.
+
+Drafting here is HOST-side and model-free by default
+(:class:`NGramDrafter` — prompt-lookup / self-drafting: the
+continuation of the most recent history match of the current suffix
+n-gram, arxiv-style "prompt lookup decoding"). Any object with
+``propose(history, k) -> int32[<=k]`` (or a bare callable with that
+signature) plugs in via ``ServingEngine(speculative=...)`` — a
+draft-MODEL hook is a propose() that runs a small model; the engine
+does not care where drafts come from, only that verification is exact.
+
+The adaptive-k policy (:class:`AcceptancePolicy`) is the scheduling
+half: a per-request EWMA of the measured acceptance rate decides how
+many draft tokens the slot may submit next tick. Low-acceptance slots
+degrade to plain one-token decode (k=0 drafts) with a periodic probe
+so a workload that BECOMES predictable (e.g. generation entering a
+repetitive region) is re-detected instead of locked out.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["NGramDrafter", "AcceptancePolicy", "resolve_drafter"]
+
+
+class NGramDrafter:
+    """Self-drafting / prompt-lookup proposer.
+
+    ``propose(history, k)`` searches the request's own token history
+    (prompt + everything generated so far) for the most recent earlier
+    occurrence of the current suffix n-gram — longest ``n`` first,
+    down to ``min_ngram`` — and proposes the ``k`` tokens that
+    followed that occurrence. Zero model cost, and exactly the right
+    shape for the two workloads speculation wins on: repetitive
+    generation (greedy decode of any fixed model is eventually
+    periodic — once one period is in the history the drafter predicts
+    the next perfectly) and prompts the answer quotes from.
+    Returns an int32 array of length ``<= k`` (empty = no match, the
+    slot decodes plainly this tick).
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 max_history: int = 1024):
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{min_ngram}/{max_ngram}")
+        self.max_ngram = int(max_ngram)
+        self.min_ngram = int(min_ngram)
+        self.max_history = int(max_history)
+
+    def propose(self, history, k: int) -> np.ndarray:
+        h = np.asarray(history, np.int32).reshape(-1)[-self.max_history:]
+        empty = np.empty((0,), np.int32)
+        if k < 1 or h.size < self.min_ngram + 1:
+            return empty
+        best = empty
+        for n in range(min(self.max_ngram, h.size - 1),
+                       self.min_ngram - 1, -1):
+            pat = h[-n:]
+            # windows over h[:-1]: the trivial self-match (the suffix
+            # itself) ends at h[-1] and is excluded by construction
+            win = np.lib.stride_tricks.sliding_window_view(h[:-1], n)
+            hits = np.flatnonzero((win == pat).all(axis=1))
+            # most recent match with a FULL k-token continuation wins:
+            # inside a repeated run the very latest match sits at the
+            # history's edge with only a token or two after it, while
+            # one period earlier the whole next period is available —
+            # a truncated draft would cap acceptance at its own length
+            for i in hits[::-1]:
+                cont = h[i + n: i + n + k]
+                if cont.size == k:
+                    return np.ascontiguousarray(cont, np.int32)
+                if cont.size > best.size:
+                    best = cont
+        return np.ascontiguousarray(best, np.int32)
+
+
+class AcceptancePolicy:
+    """Per-request adaptive draft budget from a running acceptance
+    EWMA (the acceptance-aware half of the scheduler).
+
+    ``budget(state, remaining)`` -> draft tokens the slot may submit
+    this tick (0 = plain decode); ``update(state, drafted, accepted)``
+    folds one verify result in. ``state`` is any object with mutable
+    ``spec_rate`` / ``spec_probe`` attributes (the engine uses the
+    Request itself). The EWMA starts optimistic (1.0 — the first
+    drafts always get a chance); once it falls under ``floor`` the
+    slot degrades to plain decode except for one probe draft every
+    ``probe_every`` opportunities, so acceptance can recover when the
+    stream turns predictable again."""
+
+    def __init__(self, k: int, *, ewma: float = 0.25,
+                 floor: float = 0.125, probe_every: int = 8):
+        if k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {k}")
+        self.k = int(k)
+        self.ewma = float(ewma)
+        self.floor = float(floor)
+        self.probe_every = int(probe_every)
+
+    def budget(self, state, remaining: int) -> int:
+        """Draft tokens allowed this tick: the EWMA scales the cap
+        (drafting k costs k span rows whether accepted or not, so an
+        uncertain slot drafts short and a locked-on slot drafts full).
+        ``remaining`` additionally caps drafts at the request's funded
+        page budget (max_new_tokens - produced - 1 cache positions are
+        still fundable; beyond that draft KV would only land on the
+        trash page — harmless but wasted)."""
+        cap = min(self.k, int(remaining))
+        if cap <= 0:
+            return 0
+        if state.spec_rate < self.floor:
+            state.spec_probe += 1
+            if state.spec_probe % self.probe_every:
+                return 0            # degraded: plain decode, mostly
+            return 1                # periodic probe draft
+        return max(1, min(cap, int(state.spec_rate * self.k + 0.5)))
+
+    def update(self, state, drafted: int, accepted: int) -> None:
+        if drafted <= 0:
+            return
+        rate = accepted / drafted
+        state.spec_rate = ((1.0 - self.ewma) * state.spec_rate
+                           + self.ewma * rate)
+
+
+class _CallableDrafter:
+    """Adapter: a bare ``fn(history, k) -> tokens`` as a drafter."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def propose(self, history, k: int) -> np.ndarray:
+        return np.asarray(self._fn(history, k), np.int32).reshape(-1)
+
+
+def resolve_drafter(spec) -> Optional[object]:
+    """Normalize ``ServingEngine(speculative=...)``: None/False -> off;
+    True/"ngram" -> the default :class:`NGramDrafter`; an object with
+    ``propose`` passes through (the draft-model hook); a bare callable
+    is wrapped."""
+    if spec in (None, False, "off", "none"):
+        return None
+    if spec in (True, "ngram"):
+        return NGramDrafter()
+    if hasattr(spec, "propose"):
+        return spec
+    if callable(spec):
+        return _CallableDrafter(spec)
+    raise ValueError(
+        f"speculative must be None/True/'ngram', an object with "
+        f"propose(history, k), or a callable — got {spec!r}")

@@ -62,7 +62,7 @@ _SMOKE_MODULES = {
     "test_paged_kv", "test_int8_decode", "test_inference", "test_moe",
     "test_pallas_kernels", "test_distributed", "test_prefix_cache",
     "test_analysis", "test_rewrite", "test_ragged_attention",
-    "test_observability", "test_pipeline_async",
+    "test_observability", "test_pipeline_async", "test_speculative",
 }
 
 

@@ -140,6 +140,54 @@ def test_pp_guard_rejects_postprocessed_output():
         _fit_one(Post())
 
 
+def test_pp_guard_rejects_unit_reuse():
+    """A unit called TWICE shows up directly in the traced layer-event
+    sequence (the shared ``trace_layer_graph`` machinery at unit
+    granularity) — the sequence-mismatch raise, at prepare() time."""
+    class Reuse(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = pt.nn.Sequential(*[_Block() for _ in range(4)])
+
+        def forward(self, x):
+            for b in self.blocks:
+                x = b(x)
+            return self.blocks[0](x)    # re-enters unit 0
+
+    model = Reuse()
+    eng = Engine(model, loss=_mse,
+                 optimizer=pt.optimizer.SGD(
+                     learning_rate=1e-2, parameters=model.parameters()),
+                 strategy=Strategy(pp_degree=2, num_microbatches=2))
+    with pytest.raises(ValueError, match="definition order"):
+        eng.prepare(sample_input=_x())
+
+
+def test_pp_guard_rejects_glue_before_first_unit():
+    """Functional math BEFORE the first unit leaves the unit-to-unit
+    identity chain intact — only the tracer's top-level op events see
+    it (the new trace_layer_graph-based check; the old per-unit hook
+    chain was blind here)."""
+    class PreGlue(pt.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.blocks = pt.nn.Sequential(*[_Block() for _ in range(4)])
+
+        def forward(self, x):
+            x = x * 2.0                 # outside every unit
+            for b in self.blocks:
+                x = b(x)
+            return x
+
+    model = PreGlue()
+    eng = Engine(model, loss=_mse,
+                 optimizer=pt.optimizer.SGD(
+                     learning_rate=1e-2, parameters=model.parameters()),
+                 strategy=Strategy(pp_degree=2, num_microbatches=2))
+    with pytest.raises(ValueError, match="extra math between units"):
+        eng.prepare(sample_input=_x())
+
+
 def test_pp_guard_accepts_plain_chain_and_prepare_sample():
     model = pt.nn.Sequential(*[_Block() for _ in range(4)])
     opt = pt.optimizer.SGD(learning_rate=1e-2,

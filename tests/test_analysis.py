@@ -356,6 +356,15 @@ def test_graph_lint_json_reports_serving_program_set(capsys):
     sent = out["observability"]["sentinel"]
     assert sent["expected_programs"] == sp
     assert sent["metric"] == "paddle_serving_recompiles_total"
+    # r15: the SPECULATIVE engine's inventory rides the same schema —
+    # the static proof that the draft/verify tick programs keep the
+    # per-bucket bound (exactly one verify program per mixed width)
+    sps = out["serving_programs_spec"]
+    assert sps["programs_per_bucket"] <= 2
+    verify = [p for progs in sps["widths"].values() for p in progs
+              if p.startswith("serving_tick[verify")]
+    assert verify and all(len(progs) <= 2
+                          for progs in sps["widths"].values())
 
 
 def test_prefix_attach_is_exact(params):

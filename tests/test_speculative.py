@@ -1,0 +1,430 @@
+"""Speculative decoding on the one-program tick (ISSUE r15).
+
+Verification story, mirroring the int8/ragged playbooks:
+
+* the DRAFTER is exactness-irrelevant by construction — the engine's
+  greedy output is pinned bitwise-equal to the non-speculative engine
+  AND to ``generate()`` under the self-drafting n-gram proposer, an
+  ORACLE drafter (every draft accepted) and an ANTI-oracle (every
+  draft rejected), across every cache state: cold, warm-prefix,
+  chunked prefill, post-defrag;
+* hard neighbors share the tick: a speculating slot with a
+  chunked-prefill span and a parked SAMPLING request (the PR 7
+  regression class), and ``close(drain=True)`` lands mid-verify;
+* the acceptance-aware scheduler degrades a hostile-drafter slot to
+  plain decode (probes only) and the program set stays within the
+  statically proven ≤2-per-width-bucket inventory — pinned against the
+  live engine and kept compile-clean under an armed recompile
+  sentinel after ``warm_programs()``;
+* the spec_ab bench emits the acceptance numbers; the slow tier pins
+  the ISSUE bar: ≥1.8x fewer target-model launches per emitted token
+  at acceptance ≥0.7 on the self-drafting repetitive workload.
+"""
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.models import llama as L
+from paddle_tpu.serving import NGramDrafter, ServingEngine
+from paddle_tpu.serving.speculative import AcceptancePolicy
+
+CFG = L.LlamaConfig.tiny(dtype=jnp.float32, use_flash_attention=False,
+                         remat=False)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return L.init_params(CFG, jax.random.PRNGKey(0))
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def _gen_jit(n):
+    return jax.jit(lambda p, t: L.generate(p, t, CFG, max_new_tokens=n))
+
+
+def _ref(params, prompt, n):
+    out = _gen_jit(n)(params, jnp.asarray(prompt)[None])
+    return np.asarray(out)[0, len(prompt):]
+
+
+def _engine(params, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("page_size", 4)
+    kw.setdefault("max_prompt_len", 16)
+    kw.setdefault("max_new_tokens_cap", 32)
+    kw.setdefault("speculative", "ngram")
+    kw.setdefault("spec_k", 3)
+    return ServingEngine(params, CFG, **kw)
+
+
+def _repetitive(seed, n=13):
+    rng = np.random.RandomState(seed)
+    pat = rng.randint(0, CFG.vocab_size, (4,)).astype(np.int32)
+    return np.tile(pat, -(-n // 4))[:n]
+
+
+class OracleDrafter:
+    """Drafts the TRUE greedy continuation (looked up from a reference
+    run): every draft accepted — the deterministic full-accept path."""
+
+    def __init__(self, full_seq):
+        self.full = np.asarray(full_seq, np.int32)
+
+    def propose(self, history, k):
+        h = np.asarray(history, np.int32).reshape(-1)
+        return self.full[h.size: h.size + k]
+
+
+class AntiOracleDrafter(OracleDrafter):
+    """Every draft WRONG by construction (true token + 1 mod V): the
+    deterministic zero-accept / rollback-every-tick path."""
+
+    def propose(self, history, k):
+        d = super().propose(history, k)
+        return (d + 1) % CFG.vocab_size
+
+
+# ---------------------------------------------------------------------------
+# drafter + policy units
+# ---------------------------------------------------------------------------
+
+def test_ngram_drafter_prefers_full_continuations():
+    d = NGramDrafter(max_ngram=3, min_ngram=1)
+    # period-4 history: the suffix trigram recurs one period back with
+    # a full continuation available
+    h = np.tile([5, 9, 2, 7], 4)
+    out = d.propose(h, 3)
+    np.testing.assert_array_equal(out, [5, 9, 2])
+    # period-1 run: the most recent [8] match sits at the edge with a
+    # short continuation; an earlier match yields the full k
+    h = np.asarray([1, 2, 8, 8, 8, 8, 8])
+    np.testing.assert_array_equal(d.propose(h, 4), [8, 8, 8, 8])
+
+
+def test_ngram_drafter_no_match_is_empty():
+    d = NGramDrafter()
+    assert d.propose(np.arange(10, dtype=np.int32), 4).size == 0
+    assert d.propose(np.asarray([3]), 4).size == 0      # too short
+    assert d.propose(np.tile([1, 2], 4), 0).size == 0   # k = 0
+
+
+def test_acceptance_policy_degrades_and_probes():
+    class S:
+        spec_rate = 1.0
+        spec_probe = 0
+
+    pol = AcceptancePolicy(4, probe_every=8)
+    s = S()
+    assert pol.budget(s, remaining=100) == 4     # optimistic start
+    for _ in range(12):
+        pol.update(s, drafted=4, accepted=0)
+    assert s.spec_rate < pol.floor
+    budgets = [pol.budget(s, remaining=100) for _ in range(16)]
+    assert budgets.count(0) == 14 and budgets.count(1) == 2  # probes
+    # recovery: accepted drafts pull the EWMA back up
+    for _ in range(12):
+        pol.update(s, drafted=1, accepted=1)
+    assert pol.budget(s, remaining=100) >= 1
+    # the remaining-budget cap wins near the end of a request
+    s.spec_rate = 1.0
+    assert pol.budget(s, remaining=2) == 2
+    assert pol.budget(s, remaining=0) == 0
+
+
+# ---------------------------------------------------------------------------
+# engine exactness: spec == plain engine == generate() in every state
+# ---------------------------------------------------------------------------
+
+def test_spec_matches_plain_engine_and_generate_cold_warm_partial(params):
+    """The ISSUE acceptance pin: greedy speculative output bitwise-
+    equal to the non-speculative engine and generate() — cold, fully
+    warm (prefix attach), partially warm — with speculation actually
+    engaging (drafted AND accepted tokens non-zero)."""
+    base = _repetitive(2, 13)
+    partial = np.concatenate([base[:9], _repetitive(11, 5)[:4]])
+    outs = {}
+    for spec in (False, True):
+        with _engine(params, speculative="ngram" if spec else None) \
+                as eng:
+            outs[spec] = [
+                eng.submit(base, 8).result(timeout=300),     # cold
+                eng.submit(base, 8).result(timeout=300),     # warm
+                eng.submit(partial, 8).result(timeout=300),  # partial
+            ]
+            snap = eng.stats()
+    for a, b in zip(outs[False], outs[True]):
+        np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(outs[True][0], _ref(params, base, 8))
+    np.testing.assert_array_equal(outs[True][2],
+                                  _ref(params, partial, 8))
+    c = snap["counters"]
+    assert c["draft_tokens"] > 0 and c["draft_accepted"] > 0
+    assert c["spec_ticks"] > 0
+
+
+def test_spec_matches_generate_chunked_prefill(params):
+    """Chunked prefill interleaved with speculation: prefill spans and
+    verify spans share the packed batch; outputs stay exact for
+    aligned and unaligned chunk sizes."""
+    prompts = [_repetitive(s, n) for s, n in ((2, 15), (5, 9), (7, 13))]
+    for chunk in (4, 5):
+        with _engine(params, prefill_chunk=chunk) as eng:
+            handles = [eng.submit(p, 6) for p in prompts]
+            outs = [h.result(timeout=300) for h in handles]
+        for p, out in zip(prompts, outs):
+            np.testing.assert_array_equal(out, _ref(params, p, 6))
+
+
+def test_spec_matches_generate_after_defrag(params):
+    """Mid-stream defrag scatters the speculating slot's page list;
+    verify spans read the remapped tables as data — continuations stay
+    bitwise-equal and the invariant checker stays clean."""
+    p1 = _repetitive(2, 11)
+    p2 = _repetitive(5, 7)
+    with _engine(params, check_invariants=True) as eng:
+        eng.submit(p2, 2).result(timeout=300)
+        h1 = eng.submit(p1, 10)
+        it = iter(h1)
+        next(it)
+        moved = eng.defragment()
+        h2 = eng.submit(p2, 6)
+        out1 = h1.result(timeout=300)
+        out2 = h2.result(timeout=300)
+        assert eng.audit() == []
+    assert moved >= 0
+    np.testing.assert_array_equal(out1, _ref(params, p1, 10))
+    np.testing.assert_array_equal(out2, _ref(params, p2, 6))
+
+
+def test_oracle_drafter_full_accept_path(params):
+    """A drafter proposing the true continuation: every draft accepted
+    (acceptance 1.0), launches collapse toward (mnt-1)/(1+k), output
+    still exact — the deterministic upper bound of the mechanism."""
+    prompt = _repetitive(2, 13)
+    mnt = 25
+    full = np.concatenate([prompt, _ref(params, prompt, mnt)])
+    with _engine(params, speculative=OracleDrafter(full), spec_k=3,
+                 max_new_tokens_cap=32) as eng:
+        out = eng.submit(prompt, mnt).result(timeout=300)
+        c = eng.stats()["counters"]
+    np.testing.assert_array_equal(out, full[len(prompt):])
+    assert c["draft_accepted"] == c["draft_tokens"] > 0
+    # 24 post-prefill tokens at k=3: six 4-token verify launches beats
+    # 24 plain launches by 4x; leave slack for the final short tick
+    assert c["decode_steps"] <= 8
+
+
+def test_anti_oracle_rejects_all_and_degrades(params):
+    """Every draft wrong: acceptance 0, EVERY verify rolls back its
+    whole draft (rejected == drafted), output still bitwise-exact, and
+    the acceptance policy degrades the slot to plain decode (drafted
+    tokens stop well short of one per emitted token)."""
+    prompt = _repetitive(2, 13)
+    mnt = 30
+    full = np.concatenate([prompt, _ref(params, prompt, mnt)])
+    with _engine(params, speculative=AntiOracleDrafter(full), spec_k=3,
+                 max_new_tokens_cap=32) as eng:
+        out = eng.submit(prompt, mnt).result(timeout=300)
+        c = eng.stats()["counters"]
+    np.testing.assert_array_equal(out, full[len(prompt):])
+    assert c["draft_accepted"] == 0
+    assert c["draft_rejected"] == c["draft_tokens"] > 0
+    # degraded: EWMA falls below the floor after ~4 rejected verifies,
+    # then only periodic probes draft — nowhere near one draft/token
+    assert c["spec_ticks"] < mnt // 2
+
+
+def test_spec_with_chunked_prefill_and_parked_sampling_neighbor(params):
+    """The PR 7 regression class, speculative edition: a speculating
+    greedy stream must stay exact (and keep speculating) while a
+    SAMPLING request chunk-prefills in the same ticks, and the
+    sampling request itself completes."""
+    victim = _repetitive(2, 14)
+    intruder = np.arange(1, 17, dtype=np.int32)
+    with _engine(params, max_batch=3, prefill_chunk=3,
+                 check_invariants=True) as eng:
+        h_v = eng.submit(victim, 20)
+        it = iter(h_v)
+        next(it)                    # victim is mid-decode
+        h_s = eng.submit(intruder, 4, temperature=0.7, seed=1)
+        h_g = eng.submit(intruder, 5)
+        out_v = h_v.result(timeout=300)
+        out_s = h_s.result(timeout=300)
+        out_g = h_g.result(timeout=300)
+        assert eng.audit() == []
+        c = eng.stats()["counters"]
+    np.testing.assert_array_equal(out_v, _ref(params, victim, 20))
+    np.testing.assert_array_equal(out_g, _ref(params, intruder, 5))
+    assert len(out_s) == 4          # sampling neighbor completed
+    assert c["spec_ticks"] > 0      # speculation ran alongside
+
+
+def test_close_drain_mid_verify(params):
+    """close(drain=True) while a request is mid-speculation finishes
+    it exactly; drain=False cancels cleanly and the pool ends
+    balanced."""
+    prompt = _repetitive(2, 13)
+    eng = _engine(params, check_invariants=True,
+                  max_new_tokens_cap=64)
+    h = eng.submit(prompt, 40)
+    it = iter(h)
+    next(it)                        # speculation in flight
+    eng.close(drain=True)
+    np.testing.assert_array_equal(h.result(timeout=60),
+                                  _ref(params, prompt, 40))
+    eng2 = _engine(params, max_new_tokens_cap=64)
+    h2 = eng2.submit(prompt, 40)
+    it2 = iter(h2)
+    next(it2)
+    eng2.close(drain=False)
+    assert h2.status in ("cancelled",)
+    assert eng2.pool.free_pages == eng2.pool.total_pages - 1  # - trash
+
+
+# ---------------------------------------------------------------------------
+# static proof + runtime sentinel
+# ---------------------------------------------------------------------------
+
+def test_spec_program_inventory_matches_live_engine(params):
+    """The engine's width grid and program inventory equal the static
+    enumeration (analysis/recompile.py) — the ≤2-programs-per-bucket
+    invariant survives speculation, with exactly ONE verify program
+    per mixed width."""
+    from paddle_tpu.analysis.recompile import (ServingGeometry,
+                                               program_inventory,
+                                               tick_width_grid)
+    with _engine(params, spec_k=3) as eng:
+        geom = ServingGeometry.of_engine(eng)
+        inv = eng.program_inventory
+        grid = list(eng._w_grid)
+        S = eng.scheduler.max_batch
+    assert geom.spec_k == 3
+    assert grid == tick_width_grid(geom)
+    assert inv == program_inventory(geom)
+    assert inv["programs_per_bucket"] <= 2
+    for width, progs in inv["widths"].items():
+        if int(width) == S:
+            assert len(progs) == 2
+        else:
+            assert progs == ["serving_tick[verify,spec_k=3]"]
+
+
+def test_warm_programs_keeps_sentinel_clean(params):
+    """warm_programs() covers the whole speculative inventory, so an
+    armed recompile sentinel stays clean through mixed speculative
+    traffic — the runtime half of the static proof. Fresh jit objects
+    (cleared step-fn cache) so the warmup compiles really fire."""
+    from paddle_tpu.serving import engine as _em
+    _em._JIT_CACHE.clear()
+    with _engine(params, recompile_sentinel=True, prefill_chunk=4,
+                 max_batch=2) as eng:
+        n = eng.warm_programs()
+        assert n == len(eng._w_grid) + 2
+        rep0 = eng.sentinel.report()
+        assert rep0["warmup_compiles"] >= 1
+        eng.arm_sentinel()
+        hs = [eng.submit(_repetitive(s, n), 6)
+              for s, n in ((2, 13), (5, 9), (7, 15))]
+        for h in hs:
+            h.result(timeout=300)
+        rep = eng.sentinel.report()
+    assert rep["clean"], rep["events"]
+
+
+def test_spec_metrics_and_spans_exposed(params, tmp_path):
+    """Acceptance counters ride expose() and the draft/verify/rollback
+    spans land in the exported Perfetto trace (the observability half
+    of the ISSUE acceptance)."""
+    import json
+    prompt = _repetitive(2, 13)
+    full = np.concatenate([prompt, _ref(params, prompt, 20)])
+    # anti-oracle guarantees at least one rollback span
+    with _engine(params, speculative=AntiOracleDrafter(full),
+                 trace=True) as eng:
+        eng.submit(prompt, 20).result(timeout=300)
+        text = eng.expose()
+        path = eng.export_trace(str(tmp_path / "spec.json"))
+        hist = eng.stats()["histograms"]["spec_accept_rate"]
+    for metric in ("paddle_serving_draft_tokens_total",
+                   "paddle_serving_draft_accepted_total",
+                   "paddle_serving_draft_rejected_total",
+                   "paddle_serving_spec_ticks_total"):
+        assert metric in text
+    assert hist["count"] > 0
+    events = json.load(open(path))["traceEvents"]
+    names = {e.get("name") for e in events}
+    assert "spec.verify" in names and "spec.rollback" in names
+    assert "serving.draft" in names
+
+
+# ---------------------------------------------------------------------------
+# spec_ab bench: smoke + the pinned acceptance bar (slow)
+# ---------------------------------------------------------------------------
+
+def _load_bench():
+    import importlib.util
+    import os
+    path = os.path.join(os.path.dirname(__file__), "..", "tools",
+                        "serving_bench.py")
+    spec = importlib.util.spec_from_file_location("serving_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_serving_bench_spec_ab_smoke():
+    """The A/B harness runs end to end on a short horizon: both arms
+    emit launch counts, outputs bitwise-equal across arms, speculation
+    strictly reduces launches (the bar itself is the slow test)."""
+    sb = _load_bench()
+    res = sb.main(["--modes", "spec_ab", "--spec-mnt", "48"])
+    ab = res["spec_ab"]
+    assert ab["bitwise_equal"]
+    assert ab["plain"]["tokens"] == ab["spec"]["tokens"] > 0
+    assert (ab["spec"]["target_launches"]
+            < ab["plain"]["target_launches"])
+    assert ab["launch_reduction"] > 1.0
+
+
+@pytest.mark.slow
+def test_spec_ab_acceptance():
+    """ISSUE r15 acceptance: ≥1.8x reduction in target-model launches
+    per emitted token at acceptance ≥0.7 on the self-drafting
+    repetitive workload — deterministic (seeded weights, seeded
+    prompts, greedy decode), so pinned directly."""
+    sb = _load_bench()
+    res = sb.main(["--modes", "spec_ab", "--check-invariants"])
+    ab = res["spec_ab"]
+    assert ab["bitwise_equal"]
+    assert ab["acceptance"] >= 0.7, ab
+    assert ab["launch_reduction"] >= 1.8, ab
+    assert ab["meets_bar"]
+    assert ab["plain"]["sentinel_clean"] and ab["spec"]["sentinel_clean"]
+
+
+# ---------------------------------------------------------------------------
+# qwen2_moe: the second step-fn family serves speculatively too
+# ---------------------------------------------------------------------------
+
+def test_qwen2_moe_spec_matches_generate():
+    from paddle_tpu.models import qwen2_moe as Q
+    qcfg = Q.Qwen2MoeConfig.tiny(use_flash_attention=False, remat=False)
+    qparams = Q.init_params(qcfg, jax.random.PRNGKey(0))
+    prompt = _repetitive(2, 11)
+    ref = np.asarray(jax.jit(
+        lambda p, t: Q.generate(p, t, qcfg, max_new_tokens=8)
+    )(qparams, jnp.asarray(prompt)[None]))[0, len(prompt):]
+    with ServingEngine(qparams, qcfg, max_batch=2, page_size=4,
+                       max_prompt_len=16, max_new_tokens_cap=16,
+                       speculative="ngram", spec_k=3) as eng:
+        out = eng.submit(prompt, 8).result(timeout=300)
+        c = eng.stats()["counters"]
+    np.testing.assert_array_equal(out, ref)
+    assert c["spec_ticks"] > 0

@@ -50,9 +50,20 @@ ragged A/B arms) and exports them as Perfetto-loadable Chrome-trace
 JSON — the same exporter ``serving_bench --trace`` uses, so a profile
 session and a serving run read in the same UI.
 
+Every variant's JSON additionally carries ``spec_ceiling`` — the
+acceptance-rate-parameterized SPECULATIVE decode ceiling (expected
+tok/s as a function of draft length k, per-token acceptance alpha and
+relative draft cost — ``spec_draft_cost=``, default 0 for the
+host-side n-gram self-drafter): decode's bandwidth ceiling is per
+target LAUNCH, and a verify span emits ``1 + E[accepted]`` tokens per
+launch, so the PERF.md speculative projections are computed here, not
+hand-derived. The measured counterpart is ``serving_bench --modes
+spec_ab``.
+
 Usage:
   python tools/decode_profile.py [flagship|deep|mid|tiny] [int8] [json]
       [rewrites] [ragged] [trace=out.json] [bw=819e9] [steps=64]
+      [spec_draft_cost=0.0]
 
 ``flagship`` is the 1.72B bench model (TPU-sized; expect minutes per
 chain on CPU); ``mid`` (0.17B) profiles the same shape story at
@@ -117,6 +128,45 @@ def slope(run_n, n0, n1, repeats=2):
         run_n(n1)
         t_long = min(t_long, time.perf_counter() - t0)
     return (t_long - t_short) / (n1 - n0)
+
+
+def speculative_ceiling(ceiling_tok_s, ks=(1, 2, 3, 4, 6, 8),
+                        alphas=(0.3, 0.5, 0.7, 0.8, 0.9),
+                        draft_cost: float = 0.0):
+    """Acceptance-rate-parameterized speculative decode ceiling.
+
+    Decode is weight-bandwidth-bound: the ceiling is per target-model
+    LAUNCH (one launch streams every weight once, whether it scores 1
+    token or a k+1-token verify span — the extra span rows are compute,
+    which decode has slack of). Speculation therefore multiplies the
+    per-launch ceiling by expected emitted tokens per launch:
+
+        E[accepted | k, alpha] = alpha (1 - alpha^k) / (1 - alpha)
+        tok/s(k, alpha)       = ceiling * (1 + E) / (1 + k*draft_cost)
+
+    with iid per-token draft acceptance probability ``alpha`` and
+    ``draft_cost`` = the cost of ONE draft token relative to a target
+    launch (0 for the host-side n-gram self-drafter; a draft MODEL
+    pays roughly its size ratio). Emitted in the JSON output so the
+    PERF.md projections are computed, not hand-derived; the measured
+    counterpart of (1 + E) is serving_bench spec_ab's
+    ``launch_reduction``."""
+    table = {}
+    for k in ks:
+        row = {}
+        for a in alphas:
+            e = float(k) if a >= 1.0 else a * (1 - a ** k) / (1 - a)
+            row[f"alpha={a}"] = {
+                "tok_s": round(ceiling_tok_s * (1 + e)
+                               / (1 + k * draft_cost), 1),
+                "launches_per_token": round(1 / (1 + e), 4),
+                "expected_accepted": round(e, 3)}
+        table[f"k={k}"] = row
+    return {"draft_cost_per_token": draft_cost,
+            "model": "iid per-token acceptance; E[acc]="
+                     "a(1-a^k)/(1-a); verify span streams the same "
+                     "weights as one decode step",
+            "table": table}
 
 
 def kv_bytes_per_step(cfg, seq_len, dtype_bytes=None):
@@ -408,6 +458,8 @@ def main():
                if f.startswith("bw=")), 819e9)  # v5e HBM
     steps = next((int(f.split("=")[1]) for f in flags
                   if f.startswith("steps=")), 64)
+    spec_draft_cost = next((float(f.split("=")[1]) for f in flags
+                            if f.startswith("spec_draft_cost=")), 0.0)
     trace_path = next((f.split("=", 1)[1] for f in flags
                        if f.startswith("trace=")), None)
     if trace_path:
@@ -443,6 +495,10 @@ def main():
         })
         out[tag] = {k: (round(v, 4) if isinstance(v, float) else v)
                     for k, v in prof.items()}
+        # the speculative extension of the same ceiling: per-LAUNCH
+        # bandwidth bound x expected emitted tokens per verify launch
+        out[tag]["spec_ceiling"] = speculative_ceiling(
+            ceiling, draft_cost=spec_draft_cost)
     if "fp" in out and "int8" in out:
         out["int8_speedup"] = round(
             out["int8"]["tok_per_s"] / out["fp"]["tok_per_s"], 4)
@@ -472,6 +528,15 @@ def main():
               f"{r['ceiling_fraction']:.3f}")
     if "int8_speedup" in out:
         print(f"int8 speedup: {out['int8_speedup']}x")
+    sc = out[variants[0][0]]["spec_ceiling"]
+    print(f"\n# speculative ceiling ({variants[0][0]}, draft cost "
+          f"{sc['draft_cost_per_token']}/token): expected tok/s at "
+          f"acceptance alpha")
+    alphas = list(next(iter(sc["table"].values())).keys())
+    print("k | " + " | ".join(a.split("=")[1] for a in alphas))
+    for krow, row in sc["table"].items():
+        print(krow.split("=")[1] + " | "
+              + " | ".join(f"{row[a]['tok_s']:.0f}" for a in alphas))
     if "ragged_step_ab" in out:
         ab = out["ragged_step_ab"]
         print(f"\n# ragged tick A/B (serving decode step, "

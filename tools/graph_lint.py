@@ -129,10 +129,10 @@ def main(argv=None):
         # enumerated for the flagship engine geometry (--ci consumers
         # gate on programs_per_bucket <= 2)
         from paddle_tpu.analysis.recompile import program_inventory
-        geom = next((t.meta["geometry"] for t in serving_pool
-                     if t.meta.get("geometry") is not None
-                     and getattr(t.meta["geometry"], "ragged", False)),
-                    None)
+        geoms = [t.meta["geometry"] for t in serving_pool
+                 if t.meta.get("geometry") is not None
+                 and getattr(t.meta["geometry"], "ragged", False)]
+        geom = next((g for g in geoms if not g.spec_k), None)
         if geom is not None:
             inventory = program_inventory(geom)
             out["serving_programs"] = inventory
@@ -151,6 +151,12 @@ def main(argv=None):
                     "metric": RECOMPILES_METRIC,
                     "schema": "paddle_tpu.program_inventory/1",
                 }}
+        # the speculative engine's inventory (ISSUE r15): the same
+        # schema over the draft/verify tick programs — the static
+        # proof that speculation keeps ≤2 programs per width bucket
+        spec_geom = next((g for g in geoms if g.spec_k), None)
+        if spec_geom is not None:
+            out["serving_programs_spec"] = program_inventory(spec_geom)
     if args.suite in ("all", "training"):
         # the training-schedule counterpart of serving_programs: the
         # pipeline schedules' expected trip/phase inventory (tick
