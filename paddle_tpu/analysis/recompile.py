@@ -6,11 +6,12 @@ Two reachability models live here, matching the two engine designs:
 **Ragged one-program tick (r12+, ``geom.ragged``).** The engine's only
 step functions are ``serving_tick`` (decode tokens + prompt spans as
 one program; geometry rides in device arrays) and
-``serving_tick_block`` (the fused greedy decode block). The compiled-
-program key is the packed token width, and the reachable set is fixed
-by construction: width ``S + budget`` runs exactly one program (the
-mixed tick), width ``S`` at most two (the single-step sampling tick
-and the fused block). ``enumerate_tick_programs`` enumerates that set
+``serving_tick_block`` (the fused decode block). The compiled-program
+key is the packed token width, and the reachable set is fixed by
+construction: mixed widths run the tail/no-tail tick pair, width
+``S`` exactly ONE program (the fused block — since r16 sampling rides
+it as data and the single-step sampling tick is gone).
+``enumerate_tick_programs`` enumerates that set
 so the invariant — ≤ 2 programs per width bucket — is *proven* from
 engine dispatch, not asserted, and any future dispatch change that
 silently multiplies the set fails the pass (and warns at engine
@@ -108,30 +109,34 @@ def tick_width_grid(geom: ServingGeometry) -> List[int]:
 def enumerate_tick_programs(geom: ServingGeometry) -> Dict[int,
                                                            Set[str]]:
     """Exact reachable ``{packed_width: {program}}`` under the ragged
-    engine's dispatch (``ServingEngine._decode_tick``):
+    engine's dispatch (``ServingEngine._decode_tick``). Since r16
+    SAMPLING is per-slot DATA to the fused in-graph sampler
+    (temperature/top-k/top-p/keys ride the tick meta), so temperature
+    never selects a program:
 
     * ticks with pending prefill spans run ``serving_tick`` at packed
       width ``max_batch + w`` where ``w`` is the smallest entry of the
       width grid (prompt buckets capped at the budget, plus the budget
       itself) covering the tick's span tokens — span count, span
       offsets, prefix size and cache lengths are all device data.
-      Each width compiles with the fused greedy decode tail
-      (``decode_tail = decode_block-1``) when nobody samples, without
-      it otherwise: at most two compiles per width;
-    * pure-decode ticks run the fused greedy ``serving_tick_block`` at
-      width ``max_batch``, or — when a live request samples — the
-      single-step ``serving_tick`` at the same width.
+      Each width compiles with the fused decode tail
+      (``decode_tail = decode_block-1``; sampling slots ride it via
+      the fused sampler) plus, when ``decode_block > 1``, the
+      tail-less variant for ticks where NO slot is tail-live (pure
+      mid-prefill ticks): at most two compiles per width;
+    * pure-decode ticks — greedy, sampling or mixed — run the fused
+      ``serving_tick_block`` at width ``max_batch``. The pre-r16
+      width-S single-step sampling ``serving_tick[decode]`` program
+      is GONE from the inventory.
 
     A SPECULATIVE geometry (``spec_k > 0``) changes the mixed widths,
     not the bound: every tick carrying spans or drafts — prefill-only
     ticks included — runs the ONE ``spec_k``-static verify program for
-    its width (speculation replaces the fused greedy tail there, so
+    its width (speculation replaces the fused decode tail there, so
     the tail variant is unreachable), and the width grid grows the two
     speculative entries (``tick_width_grid``). Width ``max_batch``
-    keeps its two programs: pure-sampling ticks run the single-step
-    base tick and draft-less pure-greedy ticks still run the fused
-    block — a slot degraded by the acceptance policy is a data state,
-    not a new program.
+    keeps the fused block alone — a slot degraded by the acceptance
+    policy, like a sampling slot, is a data state, not a new program.
 
     Nothing else is reachable, whatever the traffic: the bound is
     1-2 programs per width bucket by construction.
@@ -145,9 +150,12 @@ def enumerate_tick_programs(geom: ServingGeometry) -> Dict[int,
     else:
         mixed = {f"serving_tick[mixed,tail={k - 1}]"}
         if k > 1:
-            mixed.add("serving_tick[mixed,tail=0]")     # sampling ticks
+            # reachable only on ticks with zero tail-live slots (all
+            # spans mid-prefill): the engine drops the tail there
+            # rather than run k-1 all-dead steps
+            mixed.add("serving_tick[mixed,tail=0]")
     out: Dict[int, Set[str]] = {S + w: set(mixed) for w in grid}
-    out[S] = {"serving_tick[decode]", f"serving_tick_block[k={k}]"}
+    out[S] = {f"serving_tick_block[k={k}]"}
     return out
 
 

@@ -21,9 +21,56 @@ from .framework import GraphTarget, trace_graph
 from .recompile import ServingGeometry
 
 __all__ = ["engine_geometry", "serving_targets", "pp_stage_targets",
-           "rewrite_targets", "FLAGSHIP_MODELS"]
+           "rewrite_targets", "ragged_walk_model", "FLAGSHIP_MODELS"]
 
 FLAGSHIP_MODELS = ("llama", "qwen2_moe")
+
+
+def ragged_walk_model(*, kv_len: int, page_size: int, head_dim: int,
+                      num_kv_heads: int, num_heads: int,
+                      num_layers: int, dtype_bytes: int = 2,
+                      kv_tile_pages: int = 0) -> Dict[str, Any]:
+    """Analytic flops/bytes of ONE slot's decode-step KV walk through
+    the ragged kernel (ops/pallas/ragged_paged_attention.py), for the
+    one-shot and tiled formulations alike — the model decode_profile's
+    long-context ceiling prices the tiled walk with.
+
+    Both walks stream each live page exactly once per (slot, kv-head),
+    so HBM bytes are identical — ``2 · L · ceil(kv_len/ps) · ps · Dh``
+    per kv head — and the tiled walk's only cost deltas are (a) the
+    flash-combine flops (one extra exp/mul pair per score — noise next
+    to the dots) and (b) a second in-flight DMA buffer. What changes
+    is VMEM residency: one-shot pins the whole table's scratch, tiled
+    pins O(tile) (``vmem_scratch_bytes``) — which is the quantity that
+    caps context length on-chip, not bandwidth."""
+    from ..ops.pallas.ragged_paged_attention import (
+        ONE_SHOT_VMEM_BUDGET, vmem_scratch_bytes)
+    pages = -(-int(kv_len) // int(page_size))
+    kv_bytes = (2 * num_layers * num_kv_heads * pages * page_size
+                * head_dim * dtype_bytes)
+    # decode q_len=1: scores + weighted sum, 2 dots of [1, Dh] x
+    # [Dh/., kv] per head
+    flops = 2 * 2 * num_layers * num_heads * int(kv_len) * head_dim
+    one_shot = vmem_scratch_bytes(pages, page_size, head_dim,
+                                  jnp_dtype_of(dtype_bytes))
+    tiled = (vmem_scratch_bytes(pages, page_size, head_dim,
+                                jnp_dtype_of(dtype_bytes),
+                                kv_tile_pages=kv_tile_pages)
+             if kv_tile_pages else None)
+    return {
+        "kv_len": int(kv_len), "pages": pages,
+        "kv_bytes_per_step": kv_bytes, "attn_flops_per_step": flops,
+        "vmem_scratch_bytes_oneshot": one_shot,
+        "oneshot_fits_vmem": one_shot <= ONE_SHOT_VMEM_BUDGET,
+        "vmem_scratch_bytes_tiled": tiled,
+    }
+
+
+def jnp_dtype_of(dtype_bytes: int):
+    """bytes-per-element -> the matching pool dtype (the walk model's
+    inputs are geometry numbers, not arrays)."""
+    import jax.numpy as jnp
+    return {1: jnp.int8, 2: jnp.bfloat16, 4: jnp.float32}[int(dtype_bytes)]
 
 
 def engine_geometry(*, page_size: int, max_prompt_len: int,
@@ -76,15 +123,18 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
                     decode_block: int = 4,
                     spec_k: int = 3) -> List[GraphTarget]:
     """GraphTargets for one model's flagship serving programs — the
-    r12 one-program-tick set: ``serving_tick`` at both reachable packed
-    widths (mixed prefill+decode and decode-only/sampling),
-    ``serving_tick_block`` (the fused greedy path) and
-    ``generate_paged`` (the offline batched decode), plus the engine
-    geometry riding the block target for the recompile-hazard pass —
-    and, since r15, the speculative VERIFY tick
-    (``serving_tick[verify]`` at the all-slots-drafting width, spec_k
-    static, draft/acceptance geometry as device data) carrying the
-    SPECULATIVE engine geometry, so the recompile pass statically
+    r12 one-program-tick set as r16 reshaped it: ``serving_tick`` at
+    the mixed packed width, ``serving_tick_block`` (the fused decode
+    path — since r16 the ONLY pure-decode program: sampling slots ride
+    it through the fused in-graph sampler, whose per-slot
+    temperature/top-k/top-p/key/produced state is traced here exactly
+    as the engine passes it, and the width-S single-step sampling tick
+    no longer exists) and ``generate_paged`` (the offline batched
+    decode), plus the engine geometry riding the block target for the
+    recompile-hazard pass — and, since r15, the speculative VERIFY
+    tick (``serving_tick[verify]`` at the all-slots-drafting width,
+    spec_k static, draft/acceptance geometry as device data) carrying
+    the SPECULATIVE engine geometry, so the recompile pass statically
     proves the draft/verify program set keeps the
     ≤2-programs-per-width-bucket invariant too."""
     import jax
@@ -117,31 +167,42 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
 
     targets: List[GraphTarget] = []
 
+    def sampling_meta():
+        # the fused in-graph sampler's per-slot DATA (r16): the engine
+        # passes these with every tick, so the linted graphs carry the
+        # sampling head exactly as production compiles it
+        return {"temp": sds((slots,), jnp.float32),
+                "top_p": sds((slots,), jnp.float32),
+                "top_k": sds((slots,), i32),
+                "key": sds((slots, 2), jnp.uint32),
+                "produced": sds((slots,), i32)}
+
     def tick_meta(T):
         return {"tok_slot": sds((T,), i32), "tok_pos": sds((T,), i32),
                 "tok_page": sds((T,), i32), "tok_off": sds((T,), i32),
                 "tok_qoff": sds((T,), i32), "q_len": sds((slots,), i32),
                 "kv_len": sds((slots,), i32), "last": sds((slots,), i32),
-                "tables": sds((slots, pps), i32)}
+                "tables": sds((slots, pps), i32), **sampling_meta()}
 
-    # --- the ragged tick at both reachable widths ---------------------
-    # widths mirror enumerate_tick_programs: S+budget (mixed ticks) and
-    # S (decode-only sampling ticks). The mixed tick carries prefill,
-    # which legitimately returns one [S, V] logits row set per prompt
-    # completion — in_decode_loop stays False so the host-pull budget
-    # (whose hot-path guard is the block program below) does not charge
-    # it per step; the engine's greedy path pulls only the [S] argmax.
+    # --- the ragged tick at its mixed width ---------------------------
+    # widths mirror enumerate_tick_programs: S+budget (mixed ticks);
+    # the pre-r16 width-S single-step sampling tick is GONE — sampling
+    # rides the fused block below as data. The mixed tick carries
+    # prefill, which legitimately returns one [S, V] logits row set per
+    # prompt completion — in_decode_loop stays False so the host-pull
+    # budget (whose hot-path guard is the block program below) does not
+    # charge it per step; the engine pulls only the [S(,1+tail)] i32
+    # token block whoever samples.
     from .recompile import tick_budget
     budget = tick_budget(geom)
-    for tag, T, tq in (("mixed", slots + budget, budget),
-                       ("decode", slots, 1)):
-        targets.append(trace_graph(
-            f"{model}.serving_tick[{tag}]",
-            mod.serving_tick,
-            (params, sds((T,), i32), tick_meta(T), kp, vp),
-            static_kwargs=dict(cfg=cfg, tq=tq, attn_impl="dense"),
-            compute_dtype=cfg.dtype, slots=slots,
-            donated_outputs=(2, 3), meta=dict(meta)))
+    T = slots + budget
+    targets.append(trace_graph(
+        f"{model}.serving_tick[mixed]",
+        mod.serving_tick,
+        (params, sds((T,), i32), tick_meta(T), kp, vp),
+        static_kwargs=dict(cfg=cfg, tq=budget, attn_impl="dense"),
+        compute_dtype=cfg.dtype, slots=slots,
+        donated_outputs=(2, 3), meta=dict(meta)))
 
     # --- the speculative verify tick (r15): drafted slots as ragged
     # spans + in-graph longest-prefix acceptance. Traced at the
@@ -170,14 +231,19 @@ def serving_targets(model: str = "llama", *, slots: int = 4,
         compute_dtype=cfg.dtype, slots=slots,
         donated_outputs=(3, 4), meta=dict(meta, geometry=spec_geom)))
 
-    # --- fused greedy decode block: the per-tick hot program ---------
+    # --- fused decode block: the per-tick hot program (greedy AND
+    # sampling slots since r16 — the sampling state is a traced arg,
+    # exactly as the engine passes it) ---------------------------------
+    def _block_with_sampling(p, tok, lens, tabs, kp_, vp_, samp):
+        return mod.serving_tick_block(p, tok, lens, tabs, kp_, vp_,
+                                      cfg=cfg, num_steps=decode_block,
+                                      attn_impl="dense", sampling=samp)
+
     targets.append(trace_graph(
         f"{model}.serving_tick_block[k={decode_block}]",
-        mod.serving_tick_block,
+        _block_with_sampling,
         (params, sds((slots,), i32), sds((slots,), i32),
-         sds((slots, pps), i32), kp, vp),
-        static_kwargs=dict(cfg=cfg, num_steps=decode_block,
-                           attn_impl="dense"),
+         sds((slots, pps), i32), kp, vp, sampling_meta()),
         compute_dtype=cfg.dtype, slots=slots,
         steps_per_call=decode_block, in_decode_loop=True,
         # outputs (toks, k_pages, v_pages): the engine donates + rebinds

@@ -21,6 +21,16 @@ lints source, with ruff layered on top when available:
   unpacking, augmented assignment, underscore-prefixed names and
   ``global``/``nonlocal`` names never flag (matching ruff's default
   F841 scope; an unused loop variable is B007's business, not ours).
+* **table-width VMEM scratch** (PT004) — *Pallas kernels only*
+  (``ops/pallas/``): a ``pltpu.VMEM(...)`` scratch shape whose
+  expression references ``pps`` / ``pages_per_slot``. Scratch that
+  scales with the page-table WIDTH caps context length by VMEM — the
+  failure mode the r16 tiled flash combine exists to remove — so only
+  the explicitly one-shot kernel path may do it, behind a
+  ``# noqa: PT004`` with a justification. This is the CI guard that
+  the 100k-token ceiling cannot silently regress: a new kernel (or an
+  edit to the tiled one) that re-introduces O(pages_per_slot) scratch
+  fails ``graph_lint --ci`` at the source level.
 * **host-sync** (PT001/PT002/PT003) — *library code only*
   (``paddle_tpu/``; tools and tests, which legitimately pull results
   to the host, are exempt): the source-level companion of the
@@ -114,11 +124,13 @@ def _noqa_map(src: str):
 
 
 def lint_file(path: Path, src: str = None,
-              host_sync_scope: bool = False) -> List[Tuple]:
+              host_sync_scope: bool = False,
+              pallas_scope: bool = False) -> List[Tuple]:
     """[(rule, lineno, message)] for one file. ``# noqa`` (optionally
     ``# noqa: F401,E711``) on the statement's first line suppresses.
     ``host_sync_scope=True`` (library code under ``paddle_tpu/``)
-    additionally runs the PT00x host-sync rules."""
+    additionally runs the PT00x host-sync rules; ``pallas_scope=True``
+    (``ops/pallas/``) the PT004 VMEM-scratch rule."""
     if src is None:
         src = Path(path).read_text()
     try:
@@ -208,6 +220,26 @@ def lint_file(path: Path, src: str = None,
                 "F841", line,
                 f"local `{bound}` in `{fn.name}()` is assigned but "
                 f"never used"))
+
+    # ---- table-width VMEM scratch in Pallas kernels (PT004) ---------
+    if pallas_scope:
+        _WIDTH_NAMES = {"pps", "pages_per_slot"}
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "VMEM" and node.args):
+                continue
+            used = {n.id for n in ast.walk(node.args[0])
+                    if isinstance(n, ast.Name)}
+            if used & _WIDTH_NAMES and not suppressed("PT004",
+                                                      node.lineno):
+                findings.append((
+                    "PT004", node.lineno,
+                    "VMEM scratch shape scales with the page-table "
+                    "width (pages_per_slot) — this caps context "
+                    "length by VMEM; walk KV in O(tile) scratch (the "
+                    "tiled flash combine) or noqa the explicitly "
+                    "one-shot path with a justification"))
 
     # ---- host syncs in library code (PT001/PT002/PT003) -------------
     if host_sync_scope:
@@ -300,6 +332,7 @@ def lint_tree(root: Path, subdirs=("paddle_tpu", "tools")
             if "__pycache__" in p.parts:
                 continue
             for rule, line, msg in lint_file(
-                    p, host_sync_scope=(sub == "paddle_tpu")):
+                    p, host_sync_scope=(sub == "paddle_tpu"),
+                    pallas_scope=("pallas" in p.parts)):
                 out.append((str(p.relative_to(root)), rule, line, msg))
     return out

@@ -858,6 +858,71 @@ def sample_logits(logits, key, temperature: float = 1.0,
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
+def _fused_sample(logits, temp, top_p, top_k, key, idx):
+    """In-graph per-row sampling head of the serving tick (r16): the
+    generalization of the fused argmax that lets SAMPLING requests
+    ride the same programs as greedy ones. Greedy rows (temp == 0)
+    take ``jnp.argmax`` — BITWISE the pre-r16 fused path, so every
+    greedy==generate() pin survives; sampling rows apply temperature →
+    top-k → top-p masking (``sample_logits`` semantics, but per-row
+    DATA instead of static kwargs) and draw one gumbel/categorical
+    token.
+
+    Determinism discipline: the draw for a slot's token at
+    continuation index ``idx[s]`` uses ``fold_in(key[s], idx[s])`` —
+    the token INDEX keys the draw, not a split chain advanced per
+    device step. A fixed seed therefore emits one token stream
+    whatever the batch composition, fused-block boundaries or
+    speculation around it: tokens a fused block computed past EOS, or
+    drafts a verify rejected, burn no key state — the next launch
+    re-draws the same index with the same key.
+
+    logits ``[S, V]`` f32; temp/top_p ``[S]`` f32; top_k ``[S]`` i32
+    (0 = filter off); key ``[S, 2]`` u32 raw per-slot PRNG keys; idx
+    ``[S]`` i32. Returns ``[S]`` i32.
+
+    Cost discipline: the whole sampling branch (sort, cumsum,
+    categorical) sits behind a ``lax.cond`` on ``any(temp > 0)`` —
+    still ONE program (the predicate is data), but an all-greedy tick
+    executes only the argmax at runtime, so folding sampling into
+    every program does not tax greedy traffic (measured: the sort is
+    the dominant cost on the CPU mesh)."""
+    V = logits.shape[-1]
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    def _draw(_):
+        l = logits / jnp.maximum(temp, 1e-6)[:, None]
+        # top-k with k as data: cutoff at the k-th largest (k=0/off ->
+        # the smallest value, masking nothing; ties at the cutoff
+        # survive, matching sample_logits)
+        srt = jnp.sort(l, axis=-1)[:, ::-1]
+        k_eff = jnp.where(top_k > 0, jnp.minimum(top_k, V), V)
+        kth = jnp.take_along_axis(srt, (k_eff - 1)[:, None], axis=-1)
+        # top-p over the top-k-masked logits (sample_logits order).
+        # ONE sort suffices: the masked row's descending sort is the
+        # original sort with sub-cutoff positions replaced (ties at
+        # the cutoff survive masking in both views). The top-1 token
+        # is always kept so top_p=0 degrades to greedy, and cutoff is
+        # the SMALLEST kept logit.
+        srt2 = jnp.where(srt >= kth, srt, -1e30)
+        probs = jax.nn.softmax(srt2, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = (cum - probs) < top_p[:, None]
+        keep = keep.at[:, 0].set(True)
+        cutoff = jnp.min(jnp.where(keep, srt2, jnp.inf), axis=-1)
+        masked = jnp.where(l < kth, -1e30, l)
+        masked = jnp.where(masked < cutoff[:, None], -1e30, masked)
+
+        def draw(k, n, row):
+            return jax.random.categorical(jax.random.fold_in(k, n), row)
+
+        return jax.vmap(draw)(key, idx, masked).astype(jnp.int32)
+
+    sampled = jax.lax.cond(jnp.any(temp > 0.0), _draw,
+                           lambda _: greedy, None)
+    return jnp.where(temp <= 0.0, greedy, sampled)
+
+
 def _decode_loop(fwd_cache_fn, init_cache_fn, params, prompt,
                  max_new_tokens: int, temperature, top_p, top_k, key,
                  eos_token_id):
@@ -1308,6 +1373,21 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
       host discards);
     * ``tables [S, pps]``: the page-table rows.
 
+    FUSED SAMPLING (r16) — five more optional meta arrays, all DATA,
+    turn every token selection in the tick (last-position pick, fused
+    tail steps, speculative verify) into a per-slot
+    temperature/top-k/top-p gumbel draw via ``_fused_sample``:
+    ``temp [S]`` f32 / ``top_p [S]`` f32 / ``top_k [S]`` i32 (0 =
+    off) / ``key [S, 2]`` u32 raw per-slot PRNG keys / ``produced
+    [S]`` i32 — the continuation index of the token this launch
+    emits; token ``n`` is always drawn with ``fold_in(key, n)``, so a
+    fixed seed yields one stream whatever the batch composition,
+    block fusion or speculation (see ``_fused_sample``). Greedy rows
+    (temp == 0) keep the bitwise argmax. The engine ALWAYS passes
+    these (presence is a trace-time fact): SAMPLING slots ride the
+    same fused programs as greedy ones, and the pre-r16 width-S
+    single-step sampling program is gone from the inventory.
+
     ``tq`` (STATIC — one compile per value; the engine uses exactly
     two: the prefill budget and 1) is the maximum span length, sizing
     the kernel's slot-major query layout.
@@ -1347,12 +1427,14 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
     IS the multi-token lever on a speculative engine).
 
     Returns ``(toks, logits [S, V] f32, k_pages', v_pages')``:
-    ``toks`` is the in-graph greedy argmax of each slot's last-position
-    logits — ``[S]`` i32 when ``decode_tail == 0``, else
-    ``[S, 1+decode_tail]`` (the host pulls only these ints on greedy
-    ticks); ``logits`` is the RAGGED pass's (first step's) logits and
-    stays on device unless a sampling request actually fetches its row
-    (sampling ticks run ``decode_tail=0``). With ``spec_k > 0`` the
+    ``toks`` is each slot's in-graph token pick at its last position
+    (argmax, or the fused sampler's draw) — ``[S]`` i32 when
+    ``decode_tail == 0``, else ``[S, 1+decode_tail]`` (the host pulls
+    only these ints, whoever samples); ``logits`` is the RAGGED
+    pass's (first step's) logits, kept for OFFLINE callers that
+    sample their own way — since r16 the engine never reads it (the
+    fused sampler replaced the host path), it stays on device and is
+    dropped. With ``spec_k > 0`` the
     return is ``(toks [S, 1+spec_k], accept [S], logits [S, V] f32,
     k_pages', v_pages')``: ``toks[s, j]`` is the target argmax after
     consuming span tokens ``0..j``, ``accept[s]`` the number of
@@ -1415,27 +1497,63 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
     h, (kp_new, vp_new) = lax.scan(body, h, (params["layers"], k_pages,
                                              v_pages))
     h = rms_norm(h[0], params["final_norm"], cfg.rms_norm_eps)  # [T, D]
+    # fused sampling (r16): when the meta carries per-slot sampling
+    # state — temp/top_p [S] f32, top_k [S] i32, key [S, 2] u32 raw
+    # PRNG keys, produced [S] i32 (the continuation index of the token
+    # this launch emits) — every token selection below goes through
+    # _fused_sample instead of bare argmax, so SAMPLING slots ride the
+    # same program as greedy ones (the engine always passes the
+    # fields; presence is a trace-time fact, not a per-tick branch).
+    # Greedy rows still take the bitwise argmax path inside.
+    samp = "temp" in meta
+
+    def pick(logits, idx):
+        if samp:
+            return _fused_sample(logits, meta["temp"], meta["top_p"],
+                                 meta["top_k"], meta["key"], idx)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
     if spec_k:
         # logits at EVERY span position of every slot — the verify
         # pass's whole point: one launch prices 1+spec_k predictions
         h_ver = h[meta["ver_idx"]]                  # [S, 1+spec_k, D]
         logits_ver = _mm(h_ver, params["lm_head"]).astype(jnp.float32)
-        toks = jnp.argmax(logits_ver, axis=-1).astype(jnp.int32)
+        if samp:
+            # SAMPLED acceptance (spec_k is no longer greedy-only):
+            # span position j draws the token for continuation index
+            # produced+j — the same fold_in key a plain tick would
+            # use at that index, and conditioning over the accepted
+            # prefix is exact by construction, so the emitted stream
+            # is bitwise the non-speculative engine's whatever the
+            # drafter proposed. Greedy slots still argmax (temp==0).
+            kk = 1 + spec_k
+            idx = (meta["produced"][:, None]
+                   + jnp.arange(kk, dtype=jnp.int32)[None]).reshape(-1)
+            toks = _fused_sample(
+                logits_ver.reshape(S * kk, -1),
+                jnp.repeat(meta["temp"], kk),
+                jnp.repeat(meta["top_p"], kk),
+                jnp.repeat(meta["top_k"], kk),
+                jnp.repeat(meta["key"], kk, axis=0),
+                idx).reshape(S, kk)
+        else:
+            toks = jnp.argmax(logits_ver, axis=-1).astype(jnp.int32)
         # longest-prefix acceptance: draft j is accepted iff every
-        # draft 0..j matched the target argmax at its span position
-        # (cumprod zeroes everything after the first mismatch) and j
-        # is a real draft (j < draft_len — adaptive k is data)
+        # draft 0..j matched the target's token (sampled or argmax) at
+        # its span position (cumprod zeroes everything after the first
+        # mismatch) and j is a real draft (j < draft_len — adaptive k
+        # is data)
         j = jnp.arange(spec_k)
         match = ((toks[:, :spec_k] == meta["draft_tok"])
                  & (j[None, :] < meta["draft_len"][:, None]))
         accept = jnp.cumprod(match.astype(jnp.int32), axis=1) \
                     .sum(axis=1).astype(jnp.int32)
         # row 0 == the plain tick's logits for every non-speculating
-        # slot (ver_idx[:, 0] = last there): sampling slots read it
+        # slot (ver_idx[:, 0] = last there)
         return toks, accept, logits_ver[:, 0], kp_new, vp_new
     h_last = h[meta["last"]]                                    # [S, D]
     logits = _mm(h_last, params["lm_head"]).astype(jnp.float32)
-    toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    toks = pick(logits, meta["produced"] if samp else None)
     if not decode_tail:
         return toks, logits, kp_new, vp_new
 
@@ -1446,7 +1564,7 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
     live = meta["tail_live"].astype(jnp.bool_)
 
     def step(carry, _):
-        tok, lens, kp, vp = carry
+        tok, lens, idx, kp, vp = carry
         slot = lens // ps
         # rows out of pages (retiring overruns), dead all-TRASH rows
         # and tail-dead (mid-prefill) slots land on the trash page,
@@ -1459,13 +1577,20 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
                  tok_off=jnp.where(ok, lens % ps, 0).astype(jnp.int32),
                  tok_qoff=zeros, q_len=live.astype(jnp.int32),
                  kv_len=lens + 1, last=b_idx, tables=meta["tables"])
+        if samp:
+            # step j of the tail samples continuation index
+            # produced + j: the fold_in discipline, not a split chain
+            m.update(temp=meta["temp"], top_p=meta["top_p"],
+                     top_k=meta["top_k"], key=meta["key"],
+                     produced=idx)
         nxt, _, kp, vp = serving_tick(params, tok, m, kp, vp, cfg,
                                       tq=1, attn_impl=attn_impl,
                                       _block_fn=_block_fn)
-        return (nxt, lens + 1, kp, vp), nxt
+        return (nxt, lens + 1, idx + 1, kp, vp), nxt
 
-    (_, _, kp_new, vp_new), tail = lax.scan(
-        step, (toks, meta["kv_len"], kp_new, vp_new), None,
+    idx0 = (meta["produced"] + 1) if samp else zeros
+    (_, _, _, kp_new, vp_new), tail = lax.scan(
+        step, (toks, meta["kv_len"], idx0, kp_new, vp_new), None,
         length=decode_tail)
     toks = jnp.concatenate([toks[:, None], jnp.moveaxis(tail, 0, 1)],
                            axis=1)                    # [S, 1+tail]
@@ -1474,12 +1599,18 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg, tq: int = 1,
 
 def serving_tick_block(params, tok, lengths, tables, k_pages, v_pages,
                        cfg, num_steps: int, attn_impl: str = "auto",
-                       _block_fn=None):
-    """``num_steps`` fused GREEDY decode ticks built on the ragged tick
-    (the multi-step scheduling lever — same contract as the retired
-    ``serving_decode_block``: in-graph argmax, tokens match single-step
-    decode exactly, dead slots write to and read from the trash page).
-    tok/lengths ``[S]`` i32, tables ``[S, pps]``. Returns
+                       _block_fn=None, sampling=None):
+    """``num_steps`` fused decode ticks built on the ragged tick (the
+    multi-step scheduling lever — same contract as the retired
+    ``serving_decode_block``: greedy slots are in-graph argmax and
+    match single-step decode exactly, dead slots write to and read
+    from the trash page). tok/lengths ``[S]`` i32, tables
+    ``[S, pps]``. ``sampling`` (r16): a dict of the fused-sampling
+    meta arrays — ``temp``/``top_p`` f32 [S], ``top_k`` i32 [S],
+    ``key`` u32 [S, 2], ``produced`` i32 [S] — letting SAMPLING slots
+    ride the fused block too (step ``j`` draws continuation index
+    ``produced + j`` via the fold_in discipline); None keeps the
+    all-greedy block. Returns
     ``(toks [S, num_steps] i32, k_pages', v_pages')``."""
     S = tok.shape[0]
     pps = tables.shape[1]
@@ -1495,6 +1626,10 @@ def serving_tick_block(params, tok, lengths, tables, k_pages, v_pages,
                 q_len=jnp.ones((S,), jnp.int32), kv_len=lengths + 1,
                 last=b_idx, tables=tables,
                 tail_live=jnp.ones((S,), jnp.bool_))
+    if sampling is not None:
+        meta.update(temp=sampling["temp"], top_p=sampling["top_p"],
+                    top_k=sampling["top_k"], key=sampling["key"],
+                    produced=sampling["produced"])
     toks, _, kp_new, vp_new = serving_tick(
         params, tok, meta, k_pages, v_pages, cfg, tq=1,
         decode_tail=num_steps - 1, attn_impl=attn_impl,

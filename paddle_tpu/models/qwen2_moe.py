@@ -440,7 +440,9 @@ def serving_tick(params, tokens, meta, k_pages, v_pages, cfg,
 
 
 def serving_tick_block(params, tok, lengths, tables, k_pages, v_pages,
-                       cfg, num_steps: int, attn_impl: str = "auto"):
+                       cfg, num_steps: int, attn_impl: str = "auto",
+                       sampling=None):
     from .llama import serving_tick_block as _impl
     return _impl(params, tok, lengths, tables, k_pages, v_pages, cfg,
-                 num_steps, attn_impl=attn_impl, _block_fn=_decode_block)
+                 num_steps, attn_impl=attn_impl, _block_fn=_decode_block,
+                 sampling=sampling)

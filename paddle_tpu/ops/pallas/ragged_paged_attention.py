@@ -42,8 +42,28 @@ accumulator) is deliberate: it makes the kernel bitwise-equal to the
 dense-gather reference below, which is the verification story the
 engine's exactness bar rests on (tests/test_ragged_attention.py). At
 serving shapes ``KV_max = pages_per_slot · page_size`` fits VMEM
-comfortably; a production long-context variant would tile KV with the
-flash combine at the cost of the bitwise pin.
+comfortably.
+
+**Tiled flash combine (r16 — the long-context walk).** The one-shot
+scratch is ``O(pages_per_slot · page_size)``, so max context is capped
+by VMEM. Past that knee the kernel switches to a TILED walk (the
+Ragged Paged Attention paper's formulation, arxiv 2604.15464): the
+slot's live pages are walked in fixed ``kv_tile_pages``-sized tiles
+with double-buffered DMA (tile ``t+1``'s copies start while tile ``t``
+computes), carrying running max / denominator / accumulator in f32 —
+VMEM scratch becomes ``O(tile)``, independent of ``pages_per_slot``,
+so a 100k-token page table costs the same on-chip bytes as a 2k one.
+Exactness discipline: the tiled KERNEL is bitwise-equal to the tiled
+dense reference (the same ``_flash_tile`` math at two call sites —
+the one-shot kernel's own pin, replayed), and tiled-vs-one-shot is
+held to a measured ulp-at-row-scale bound (``TILED_ULP_BOUND`` /
+``tiled_ulp_error``, the fused-rmsnorm measured-sweep contract style
+from analysis/rewrite.py) — the flash combine reassociates the
+softmax reductions, so bitwise is off the table by construction, and
+the bound is what the tests enforce across the geometry grid. Selection is by geometry (``default_kv_tile_pages``):
+one-shot stays the bitwise-pinned fast path while its K+V scratch
+fits ``ONE_SHOT_VMEM_BUDGET``; the tiled walk takes over past the
+knee. ``kv_tile_pages=`` overrides (0 forces one-shot).
 
 Off-TPU the kernel runs in interpreter mode (CPU-testable, like the
 int8/flash kernels); ``impl="dense"`` selects the reference gather
@@ -61,9 +81,91 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 __all__ = ["ragged_paged_attention", "ragged_paged_attention_reference",
-           "ragged_paged_attention_packed"]
+           "ragged_paged_attention_packed", "default_kv_tile_pages",
+           "vmem_scratch_bytes", "ONE_SHOT_VMEM_BUDGET",
+           "TILED_ULP_BOUND", "tiled_ulp_error"]
 
 _MASK = -1e30  # matches the repo's dense-attention mask value
+
+# K+V VMEM scratch budget of the ONE-SHOT walk: past this the kernel
+# auto-selects the tiled flash combine. 4 MiB leaves headroom for the
+# q/out blocks and the compiler's own allocations inside ~16 MiB/core;
+# at Dh=128/bf16 the knee sits at 8k KV tokens.
+ONE_SHOT_VMEM_BUDGET = 4 * 2 ** 20
+# default tile of the flash walk, in KV TOKENS (converted to pages by
+# default_kv_tile_pages): big enough that the per-tile dot amortizes
+# the DMA turnaround, small enough that double-buffered K+V scratch
+# stays ~512 KiB at Dh=128/bf16. The kernel_bench ragged sweep is the
+# measured A/B over this choice (the first entry of the KForge-style
+# autotune loop, PAPERS.md 2606.02963).
+DEFAULT_TILE_KV_TOKENS = 512
+# tiled-vs-one-shot exactness contract (the fused-rmsnorm measured-
+# sweep style, analysis/rewrite.py): the flash combine reassociates
+# the softmax sum and rescales the accumulator per tile, so bitwise
+# equality is structurally off the table. A PER-ELEMENT ulp bound is
+# the wrong metric here and provably cannot hold: attention output
+# components are weighted averages whose terms CANCEL, so a component
+# can be 1e-4 of its slot's scale while both formulations carry
+# O(scale) rounding — measured 35k "ulp" at such elements with the
+# absolute error still ~1 ulp of the row scale. The contract is
+# therefore ulp AT THE SLOT'S OUTPUT SCALE:
+#
+#     |tiled - oneshot|  <=  TILED_ULP_BOUND · eps(dtype) · linf(slot)
+#
+# (``tiled_ulp_error`` computes the left side in those units).
+# Measured worst case across the tests/test_ragged_attention.py
+# geometry grid — f32, both matmul precisions, mixed prefill+decode
+# spans, non-dividing tiles, empty slots, input scales 0.01-10 —
+# is 6.5; the contract pins <= 16 for headroom on untested shapes.
+TILED_ULP_BOUND = 16
+
+
+def tiled_ulp_error(got, ref) -> float:
+    """Max error of ``got`` vs ``ref`` in units-in-the-last-place of
+    each leading-axis row's (slot's) largest reference component —
+    the tiled walk's contract metric (see TILED_ULP_BOUND). Inputs
+    are same-shape float arrays, slot-major on axis 0."""
+    got = np.asarray(got)
+    ref = np.asarray(ref)
+    axes = tuple(range(1, ref.ndim))
+    linf = np.maximum(
+        np.max(np.abs(ref), axis=axes, keepdims=True), 1e-30)
+    eps = np.finfo(ref.dtype).eps
+    return float((np.abs(got.astype(np.float64)
+                         - ref.astype(np.float64))
+                  / (eps * linf)).max())
+
+
+def vmem_scratch_bytes(pages_per_slot: int, page_size: int,
+                       head_dim: int, dtype=jnp.bfloat16,
+                       kv_tile_pages: int = 0) -> int:
+    """K+V VMEM scratch one grid program pins, straight from the
+    kernels' ``scratch_shapes``: the one-shot walk holds the whole
+    table (``2 · pps · ps · Dh``), the tiled walk two double-buffer
+    tiles (``2 · 2 · tile · ps · Dh``) — independent of
+    ``pages_per_slot``, which is the whole point. Shared by the
+    kernel_bench sweep's ``vmem_scratch_bytes`` column and the
+    decode_profile long-context ceiling."""
+    item = jnp.dtype(dtype).itemsize
+    if kv_tile_pages:
+        return 2 * 2 * int(kv_tile_pages) * page_size * head_dim * item
+    return 2 * int(pages_per_slot) * page_size * head_dim * item
+
+
+def default_kv_tile_pages(pages_per_slot: int, page_size: int,
+                          head_dim: int, dtype=jnp.bfloat16,
+                          budget_bytes: int = ONE_SHOT_VMEM_BUDGET
+                          ) -> int:
+    """Geometry selection of the KV walk: 0 (one-shot — the
+    bitwise-pinned fast path) while the one-shot K+V scratch fits the
+    VMEM budget, else the default flash-combine tile in pages. The
+    engine never chooses: ``serving_tick`` passes geometry through and
+    this picks per (pages_per_slot, page_size, Dh, dtype)."""
+    if vmem_scratch_bytes(pages_per_slot, page_size, head_dim,
+                          dtype) <= budget_bytes:
+        return 0
+    return min(int(pages_per_slot),
+               max(1, DEFAULT_TILE_KV_TOKENS // int(page_size)))
 
 
 def _on_tpu() -> bool:
@@ -109,6 +211,91 @@ def _attend(qs, ks, vs, q_len, kv_len, tq: int):
                             (((1,), (0,)), ((), ())))
     # fully-masked rows (padding, empty slots): l == 0 -> emit 0, not NaN
     return (o / jnp.where(l > 0, l, 1.0).astype(o.dtype)).astype(vs.dtype)
+
+
+def _flash_tile(qs, ks_t, vs_t, k0, q_len, kv_len, tq: int, m, l, acc):
+    """One TILE of the online-softmax (flash-combine) KV walk — the
+    single source of the tiled math, shared verbatim by the tiled
+    kernel body and the tiled dense reference (the bitwise pin
+    compares two call sites of THIS function, exactly like
+    ``_attend``'s).
+
+    qs ``[G*Tq, Dh]`` pre-scaled; ks_t/vs_t ``[tile_kv, Dh]`` — the
+    tile's keys/values, covering global KV positions
+    ``k0 .. k0+tile_kv-1`` (positions >= kv_len may hold garbage —
+    stale double-buffer contents, un-DMA'd pages — and are masked /
+    zeroed here exactly as ``_attend`` does for its dead span).
+    m/l ``[G*Tq, 1]`` f32 running max / denominator, acc
+    ``[G*Tq, Dh]`` f32 running accumulator. A tile fully past
+    ``kv_len`` is an exact no-op (alpha == 1, p == 0), which is why
+    the reference may walk a static tile count while the kernel walks
+    only live tiles and the two stay bitwise-equal."""
+    gt = qs.shape[0]
+    tile_kv = ks_t.shape[0]
+    k_idx = k0 + jax.lax.broadcasted_iota(jnp.int32, (gt, tile_kv), 1)
+    vmask = (k0 + jax.lax.broadcasted_iota(jnp.int32, (tile_kv, 1), 0)
+             < kv_len)
+    vs_t = jnp.where(vmask, vs_t, 0)
+    # scores dot in the operand dtype, f32 from the combine on — the
+    # same dtype convention as _attend
+    s = jax.lax.dot_general(qs, ks_t,
+                            (((1,), (1,)), ((), ()))).astype(jnp.float32)
+    t = jax.lax.broadcasted_iota(jnp.int32, (gt, tile_kv), 0) % tq
+    mask = (t < q_len) & (k_idx <= (kv_len - q_len) + t)
+    s = jnp.where(mask, s, _MASK)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * alpha + jax.lax.dot_general(
+        p.astype(vs_t.dtype), vs_t,
+        (((1,), (0,)), ((), ()))).astype(jnp.float32)
+    return m_new, l_new, acc_new
+
+
+def _flash_init(gt: int, dh: int):
+    """Flash-combine carry init: running max starts at the MASK value
+    (not -inf — ``exp(_MASK - _MASK)`` must be a defined 1.0 for rows
+    that never see a live key, so fully-masked rows emit 0, not NaN —
+    the same dead-row contract as ``_attend``)."""
+    return (jnp.full((gt, 1), _MASK, jnp.float32),
+            jnp.zeros((gt, 1), jnp.float32),
+            jnp.zeros((gt, dh), jnp.float32))
+
+
+def _flash_final(m, l, acc, dtype):
+    del m  # fully-masked rows: l == 0 -> emit 0, not NaN
+    return (acc / jnp.where(l > 0, l, 1.0)).astype(dtype)
+
+
+def _attend_tiled(qs, ks, vs, q_len, kv_len, tq: int, tile_kv: int):
+    """Tiled (flash-combine) counterpart of ``_attend``: the SAME per
+    (slot, kv-head) block, but the KV axis walked in ``tile_kv``-sized
+    tiles through ``_flash_tile``. This is the tiled DENSE REFERENCE —
+    the Pallas tiled kernel is proven bitwise-equal to it, and IT is
+    held to the ulp contract vs ``_attend`` (one-shot). Walks every
+    tile of the padded KV_max statically; tiles past ``kv_len`` are
+    exact no-ops (see ``_flash_tile``)."""
+    kv_max, dh = ks.shape
+    n_tiles = -(-kv_max // tile_kv)
+    pad = n_tiles * tile_kv - kv_max
+    if pad:
+        ks = jnp.concatenate(
+            [ks, jnp.zeros((pad, dh), ks.dtype)], axis=0)
+        vs = jnp.concatenate(
+            [vs, jnp.zeros((pad, dh), vs.dtype)], axis=0)
+
+    def body(t, carry):
+        k0 = t * tile_kv
+        ks_t = jax.lax.dynamic_slice_in_dim(ks, k0, tile_kv)
+        vs_t = jax.lax.dynamic_slice_in_dim(vs, k0, tile_kv)
+        return _flash_tile(qs, ks_t, vs_t, k0, q_len, kv_len, tq,
+                           *carry)
+
+    m, l, acc = jax.lax.fori_loop(0, n_tiles, body,
+                                  _flash_init(qs.shape[0], dh))
+    return _flash_final(m, l, acc, vs.dtype)
 
 
 def _kernel(qlen_ref, kvlen_ref, tab_ref, q_ref, kp_ref, vp_ref, o_ref,
@@ -177,8 +364,11 @@ def _pallas_impl(qs, k_pages, v_pages, q_len, kv_len, tables, tq, g,
             ],
             out_specs=block,
             scratch_shapes=[
-                pltpu.VMEM((pps, page_size, Dh), k_pages.dtype),
-                pltpu.VMEM((pps, page_size, Dh), v_pages.dtype),
+                # the explicitly ONE-SHOT path: scratch deliberately
+                # scales with the table width to keep the bitwise pin;
+                # every other walk must be O(tile) (PT004)
+                pltpu.VMEM((pps, page_size, Dh), k_pages.dtype),  # noqa: PT004 — one-shot by design
+                pltpu.VMEM((pps, page_size, Dh), v_pages.dtype),  # noqa: PT004 — one-shot by design
                 pltpu.SemaphoreType.DMA((2, pps)),
             ]),
         compiler_params=getattr(pltpu, "CompilerParams",
@@ -189,27 +379,147 @@ def _pallas_impl(qs, k_pages, v_pages, q_len, kv_len, tables, tq, g,
     )(q_len, kv_len, tables.reshape(-1), qs, k_pages, v_pages)
 
 
-def _reference_impl(qs, k_pages, v_pages, q_len, kv_len, tables, tq, g):
+def _tiled_kernel(qlen_ref, kvlen_ref, tab_ref, q_ref, kp_ref, vp_ref,
+                  o_ref, k_scr, v_scr, sems, *, pps: int, page_size: int,
+                  tq: int, tile_pages: int):
+    """Flash-combine walk: live pages in ``tile_pages``-sized tiles,
+    DOUBLE-BUFFERED — tile ``t+1``'s K/V page copies start while tile
+    ``t`` computes, so past the first tile the DMA hides under the
+    dots. Scratch is ``(2, tile_pages, page_size, Dh)`` per pool —
+    O(tile), independent of ``pps`` — plus the f32 (m, l, acc) carry
+    in registers/VMEM via the fori_loop."""
+    s = pl.program_id(0)
+    h = pl.program_id(1)
+    qn = qlen_ref[s]
+    kn = kvlen_ref[s]
+    n_pages = pl.cdiv(kn, page_size)
+    tile_kv = tile_pages * page_size
+    n_tiles = pl.cdiv(kn, tile_kv)
+
+    def tile_dma(t, buf, p, pages_ref, scr, lane):
+        page = tab_ref[s * pps + t * tile_pages + p]
+        return pltpu.make_async_copy(pages_ref.at[h, page],
+                                     scr.at[buf, p],
+                                     sems.at[lane, buf, p])
+
+    def start_tile(t, buf):
+        # static unroll over the tile's page slots; a slot past the
+        # live range moves no bytes (its stale scratch is masked by
+        # kv_len in _flash_tile)
+        for p in range(tile_pages):
+            @pl.when((t * tile_pages + p) < n_pages)
+            def _(p=p):
+                tile_dma(t, buf, p, kp_ref, k_scr, 0).start()
+                tile_dma(t, buf, p, vp_ref, v_scr, 1).start()
+
+    def wait_tile(t, buf):
+        for p in range(tile_pages):
+            @pl.when((t * tile_pages + p) < n_pages)
+            def _(p=p):
+                tile_dma(t, buf, p, kp_ref, k_scr, 0).wait()
+                tile_dma(t, buf, p, vp_ref, v_scr, 1).wait()
+
+    @pl.when(qn > 0)
+    def _():
+        dh = k_scr.shape[-1]
+        qs = q_ref[...]
+        start_tile(0, 0)
+
+        def body(t, carry):
+            buf = jax.lax.rem(t, 2)
+
+            @pl.when(t + 1 < n_tiles)
+            def _():
+                start_tile(t + 1, jax.lax.rem(t + 1, 2))
+
+            wait_tile(t, buf)
+            ks_t = k_scr[buf].reshape(tile_kv, dh)
+            vs_t = v_scr[buf].reshape(tile_kv, dh)
+            return _flash_tile(qs, ks_t, vs_t, t * tile_kv, qn, kn,
+                               tq, *carry)
+
+        m, l, acc = jax.lax.fori_loop(
+            0, n_tiles, body, _flash_init(qs.shape[0], dh))
+        o_ref[...] = _flash_final(m, l, acc, o_ref.dtype)
+
+    @pl.when(qn == 0)
+    def _():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("tq", "g", "tile_pages", "interpret"))
+def _pallas_tiled_impl(qs, k_pages, v_pages, q_len, kv_len, tables, tq,
+                       g, tile_pages, interpret):
+    """The tiled walk behind the same slot-major entry contract as
+    ``_pallas_impl``; scratch shapes are the whole VMEM story —
+    O(tile), never O(pps)."""
+    S, Hkv, GT, Dh = qs.shape
+    pps = tables.shape[1]
+    page_size = k_pages.shape[2]
+    tile_pages = min(int(tile_pages), pps)
+    kernel = functools.partial(_tiled_kernel, pps=pps,
+                               page_size=page_size, tq=tq,
+                               tile_pages=tile_pages)
+    block = pl.BlockSpec((None, None, GT, Dh),
+                         lambda s, h, *_: (s, h, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=3,
+            grid=(S, Hkv),
+            in_specs=[
+                block,
+                pl.BlockSpec(memory_space=pltpu.ANY),
+                pl.BlockSpec(memory_space=pltpu.ANY),
+            ],
+            out_specs=block,
+            scratch_shapes=[
+                pltpu.VMEM((2, tile_pages, page_size, Dh), k_pages.dtype),
+                pltpu.VMEM((2, tile_pages, page_size, Dh), v_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, 2, tile_pages)),
+            ]),
+        compiler_params=getattr(pltpu, "CompilerParams",
+                                getattr(pltpu, "TPUCompilerParams", None))(
+            dimension_semantics=("arbitrary", "arbitrary")),
+        out_shape=jax.ShapeDtypeStruct(qs.shape, k_pages.dtype),
+        interpret=interpret,
+    )(q_len, kv_len, tables.reshape(-1), qs, k_pages, v_pages)
+
+
+def _reference_impl(qs, k_pages, v_pages, q_len, kv_len, tables, tq, g,
+                    tile_pages: int = 0):
     """Dense-gather reference with identical semantics: per slot,
     gather the table's pages and run the SAME ``_attend`` block per kv
     head. vmapped over (slot, head) — proven bitwise-equal to the
-    kernel's sequential grid by tests/test_ragged_attention.py."""
+    kernel's sequential grid by tests/test_ragged_attention.py.
+    ``tile_pages > 0`` selects the TILED dense reference (the same
+    gather, attended through ``_attend_tiled``'s flash combine) — the
+    off-chip twin of the tiled kernel."""
     S, Hkv, GT, Dh = qs.shape
     pps = tables.shape[1]
     ps = k_pages.shape[2]
+    if tile_pages:
+        tile_kv = min(int(tile_pages), pps) * ps
+        attend = lambda qh, kh, vh, qn, kn: _attend_tiled(  # noqa: E731
+            qh, kh, vh, qn, kn, tq, tile_kv)
+    else:
+        attend = lambda qh, kh, vh, qn, kn: _attend(  # noqa: E731
+            qh, kh, vh, qn, kn, tq)
 
     def per_slot(q_s, qn, kn, tab):
         ks = k_pages[:, tab].reshape(Hkv, pps * ps, Dh)
         vs = v_pages[:, tab].reshape(Hkv, pps * ps, Dh)
         return jax.vmap(
-            lambda qh, kh, vh: _attend(qh, kh, vh, qn, kn, tq)
+            lambda qh, kh, vh: attend(qh, kh, vh, qn, kn)
         )(q_s, ks, vs)
 
     return jax.vmap(per_slot)(qs, q_len, kv_len, tables)
 
 
 def ragged_paged_attention(q, k_pages, v_pages, q_len, kv_len, tables,
-                           sm_scale=None, impl: str = "auto"):
+                           sm_scale=None, impl: str = "auto",
+                           kv_tile_pages=None):
     """One-launch attention for a mixed ragged batch over paged KV.
 
     q: ``[S, Tq, H, Dh]`` slot-major query spans (see module
@@ -219,6 +529,14 @@ def ragged_paged_attention(q, k_pages, v_pages, q_len, kv_len, tables,
 
     impl: "auto" (pallas kernel on TPU, dense-gather reference
     elsewhere), "pallas" (strict — interpreter mode off-TPU), "dense".
+
+    kv_tile_pages: the KV walk. None (default) = geometry AUTO on the
+    pallas path — one-shot while its scratch fits the VMEM budget,
+    the tiled flash combine past the knee (``default_kv_tile_pages``;
+    the dense path stays one-shot, it has no VMEM to protect);
+    0 forces one-shot; N > 0 forces the tiled walk at an N-page tile
+    (dense included — the tiled dense reference the kernel's bitwise
+    pin runs against).
     """
     if impl not in ("auto", "pallas", "dense"):
         raise ValueError(f"impl must be auto|pallas|dense, got {impl!r}")
@@ -239,12 +557,26 @@ def ragged_paged_attention(q, k_pages, v_pages, q_len, kv_len, tables,
     qs = qs.reshape(S, Tq, Hkv, G, Dh).transpose(0, 2, 3, 1, 4)
     qs = qs.reshape(S, Hkv, G * Tq, Dh)
     use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu())
+    tile = kv_tile_pages
+    if tile is None:
+        tile = (default_kv_tile_pages(tables.shape[1],
+                                      k_pages.shape[2], Dh,
+                                      k_pages.dtype)
+                if use_pallas else 0)
+    tile = int(tile)
     if use_pallas:
-        out = _pallas_impl(qs, k_pages, v_pages, q_len, kv_len, tables,
-                           tq=Tq, g=G, interpret=not _on_tpu())
+        if tile:
+            out = _pallas_tiled_impl(qs, k_pages, v_pages, q_len,
+                                     kv_len, tables, tq=Tq, g=G,
+                                     tile_pages=tile,
+                                     interpret=not _on_tpu())
+        else:
+            out = _pallas_impl(qs, k_pages, v_pages, q_len, kv_len,
+                               tables, tq=Tq, g=G,
+                               interpret=not _on_tpu())
     else:
         out = _reference_impl(qs, k_pages, v_pages, q_len, kv_len,
-                              tables, tq=Tq, g=G)
+                              tables, tq=Tq, g=G, tile_pages=tile)
     out = out.reshape(S, Hkv, G, Tq, Dh).transpose(0, 3, 1, 2, 4)
     return out.reshape(S, Tq, H, Dh).astype(q.dtype)
 
@@ -310,7 +642,8 @@ def _packed_impl(q, k_pages, v_pages, tok_slot, tok_qoff, q_len, kv_len,
 
 def ragged_paged_attention_packed(q, k_pages, v_pages, tok_slot, tok_qoff,
                                   q_len, kv_len, tables, tq: int,
-                                  sm_scale=None, impl: str = "auto"):
+                                  sm_scale=None, impl: str = "auto",
+                                  kv_tile_pages=None):
     """Packed-layout entry for the serving tick: ``q [T, H, Dh]`` is
     the tick's token stream with per-token owner/offset metadata
     (``tok_slot [T]`` — ``S`` = padding sentinel; ``tok_qoff [T]``).
@@ -320,6 +653,9 @@ def ragged_paged_attention_packed(q, k_pages, v_pages, tok_slot, tok_qoff,
     the Pallas kernel (scatter to the slot-major layout at the
     boundary) on TPU; "pallas"/"dense" force the slot-major kernel /
     reference; "packed" forces the packed formulation.
+    ``kv_tile_pages`` rides through to the slot-major walk selection
+    (None = geometry auto — the serving tick passes nothing and a
+    100k-token table picks the tiled walk by itself on TPU).
     """
     if impl not in ("auto", "pallas", "dense", "packed"):
         raise ValueError(
@@ -342,7 +678,8 @@ def ragged_paged_attention_packed(q, k_pages, v_pages, tok_slot, tok_qoff,
     qs = jnp.zeros((S + 1, int(tq), H, Dh), q.dtype)
     qs = qs.at[tok_slot, tok_qoff].set(q)
     o = ragged_paged_attention(qs[:S], k_pages, v_pages, q_len, kv_len,
-                               tables, sm_scale=sm_scale, impl=impl)
+                               tables, sm_scale=sm_scale, impl=impl,
+                               kv_tile_pages=kv_tile_pages)
     o = jnp.concatenate([o, jnp.zeros((1,) + o.shape[1:], o.dtype)],
                         axis=0)
     return o[tok_slot, tok_qoff].astype(q.dtype)

@@ -251,13 +251,15 @@ class ServingEngine:
     speculative decoding (serving/speculative.py NGramDrafter — prompt
     lookup over the request's own history, zero model cost); or any
     object with ``propose(history, k) -> tokens`` / bare callable (the
-    pluggable draft-model hook). Each tick, every live GREEDY slot may
-    submit its current token plus up to ``spec_k`` draft tokens as an
-    ordinary ragged span of the one-program tick; the target model
-    verifies the whole span in ONE launch (in-graph longest-prefix
-    acceptance against its own argmax) and the slot emits
-    ``1 + accepted`` tokens. Greedy outputs stay bitwise-equal to the
-    non-speculative engine and to ``generate()`` whatever the drafter
+    pluggable draft-model hook). Each tick, every live slot — greedy
+    AND sampling since r16 — may submit its current token plus up to
+    ``spec_k`` draft tokens as an ordinary ragged span of the
+    one-program tick; the target model verifies the whole span in ONE
+    launch (in-graph longest-prefix acceptance against its own token
+    pick: argmax for greedy slots, the fused sampler's draw for
+    sampling ones) and the slot emits ``1 + accepted`` tokens.
+    Outputs stay bitwise-equal to the non-speculative engine — and,
+    for greedy requests, to ``generate()`` — whatever the drafter
     proposes (tests/test_speculative.py pins every cache state);
     rejected draft KV needs no rollback — the stale rows sit past the
     slot's length, masked until real tokens overwrite them (the same
@@ -312,10 +314,11 @@ class ServingEngine:
         # optional pacing between decode ticks (tests / co-tenant CPU
         # politeness); 0 = run ticks back to back
         self._tick_interval = float(tick_interval_s)
-        # >1: fuse this many GREEDY decode steps per tick (multi-step
+        # >1: fuse this many decode steps per tick (multi-step
         # scheduling — per-tick dispatch/host work amortizes over the
-        # block at the cost of admission/retirement granularity; ticks
-        # fall back to single steps whenever a live request samples)
+        # block at the cost of admission/retirement granularity;
+        # sampling slots ride the block through the fused in-graph
+        # sampler since r16, so nobody forces single steps)
         if decode_block_size < 1:
             raise ValueError("decode_block_size must be >= 1")
         self._decode_block = int(decode_block_size)
@@ -448,7 +451,15 @@ class ServingEngine:
 
         self._cur_tok = np.zeros((max_batch,), np.int32)
         self._produced = np.zeros((max_batch,), np.int64)
-        self._keys = [None] * max_batch  # per-slot PRNG key (sampling)
+        # per-slot raw PRNG key data (fused in-graph sampling, r16):
+        # PRNGKey(seed) at admission, CONSTANT for the request's whole
+        # life — the tick folds the token's continuation index in
+        # (fold_in(key, produced)), so no host-side split chain exists
+        # to drift with batch composition
+        self._key_data = np.zeros((max_batch, 2), np.uint32)
+        # device-side cache of the composition-dependent sampling
+        # arrays (see _sampling_arrays); None = rebuild next tick
+        self._samp_cache = None
 
         self._cond = threading.Condition()
         self._tick_lock = threading.Lock()
@@ -463,16 +474,22 @@ class ServingEngine:
     def submit(self, prompt, max_new_tokens: int, *,
                eos_token_id: Optional[int] = None,
                timeout: Optional[float] = None,
-               temperature: float = 0.0, seed: int = 0) -> RequestHandle:
+               temperature: float = 0.0, top_p: float = 1.0,
+               top_k: int = 0, seed: int = 0) -> RequestHandle:
         """Queue one request; returns a streaming handle. Raises
         RuntimeError when the request is REJECTED (queue full, or its
-        prompt/page budget can never fit this engine)."""
+        prompt/page budget can never fit this engine).
+        ``temperature``/``top_p``/``top_k``/``seed`` are per-request
+        sampling state carried to the fused in-graph sampler as DATA
+        (r16): a sampling request rides the same tick programs as its
+        greedy neighbours, and a fixed seed reproduces its token
+        stream exactly whatever else shares the batch."""
         if self._dead is not None:
             raise RuntimeError("engine worker died") from self._dead
         deadline = None if timeout is None else time.monotonic() + timeout
         req = Request(prompt, max_new_tokens, eos_token_id=eos_token_id,
                       deadline_s=deadline, temperature=temperature,
-                      seed=seed)
+                      top_p=top_p, top_k=top_k, seed=seed)
         self.metrics.inc("submitted")
         with self._cond:
             if self._closing:
@@ -611,6 +628,11 @@ class ServingEngine:
         with self._tick_lock:
             tabs = np.full((S, pps), PagePool.TRASH, np.int32)
             zs = np.zeros((S,), np.int32)
+            samp = dict(temp=jnp.asarray(np.zeros((S,), np.float32)),
+                        top_p=jnp.asarray(np.ones((S,), np.float32)),
+                        top_k=jnp.asarray(zs),
+                        key=jnp.asarray(np.zeros((S, 2), np.uint32)),
+                        produced=jnp.asarray(zs))
 
             def pad_meta(T):
                 m = dict(
@@ -622,7 +644,8 @@ class ServingEngine:
                     tok_qoff=jnp.asarray(np.zeros((T,), np.int32)),
                     q_len=jnp.asarray(zs), kv_len=jnp.asarray(zs),
                     last=jnp.asarray(zs), tables=jnp.asarray(tabs),
-                    tail_live=jnp.asarray(np.zeros((S,), bool)))
+                    tail_live=jnp.asarray(np.zeros((S,), bool)),
+                    **samp)
                 return m
 
             def spec_meta(T):
@@ -636,7 +659,9 @@ class ServingEngine:
                 return m
 
             # mixed widths (the spans tick — verify program on a
-            # speculative engine, tail/no-tail variants otherwise)
+            # speculative engine, tail/no-tail variants otherwise;
+            # sampling state is part of EVERY program, so no per-
+            # temperature variant exists to warm)
             for w in self._w_grid:
                 T = S + w
                 tok = jnp.asarray(np.zeros((T,), np.int32))
@@ -653,15 +678,15 @@ class ServingEngine:
                             self._params, tok, pad_meta(T), self._kp,
                             self._vp, tq=w, decode_tail=tail)
                         n += 1
-            # width S: the single-step (sampling) tick + fused block
+            # width S: the fused block — the ONLY pure-decode program
+            # since r16 (the single-step sampling tick is gone: its
+            # traffic rides the block through the in-graph sampler)
             tok = jnp.asarray(zs)
-            _, _, self._kp, self._vp = self._tick_jit(
-                self._params, tok, pad_meta(S), self._kp, self._vp,
-                tq=1, decode_tail=0)
             _, self._kp, self._vp = self._block_jit(
                 self._params, tok, jnp.asarray(zs), jnp.asarray(tabs),
-                self._kp, self._vp, num_steps=self._decode_block)
-            n += 2
+                self._kp, self._vp, num_steps=self._decode_block,
+                sampling=samp)
+            n += 1
         return n
 
     def audit(self):
@@ -811,16 +836,36 @@ class ServingEngine:
         return self.postmortem_path
 
     # ------------------------------------------------------------ worker ----
-    def _sample(self, slot: int, req: Request, logits_row: np.ndarray) -> int:
-        if req.temperature == 0.0:
-            return int(np.argmax(logits_row))
-        from ..models.llama import sample_logits
-        if self._keys[slot] is None:
-            self._keys[slot] = self._jax.random.PRNGKey(req.seed)
-        self._keys[slot], sub = self._jax.random.split(self._keys[slot])
-        tok = sample_logits(self._jnp.asarray(logits_row)[None], sub,
-                            req.temperature)
-        return int(tok[0])
+    def _sampling_arrays(self):
+        """The fused sampler's per-slot DATA (r16): temperature /
+        top_p / top_k from each occupied slot's request, the constant
+        per-slot PRNG key, and the produced-token count that keys each
+        draw. Passed with EVERY tick (greedy slots carry temp 0 and
+        take the bitwise argmax path in-graph), so sampling is never a
+        different program. The composition-dependent arrays
+        (params + keys) change only at admission/retirement, so they
+        are cached on-device and rebuilt on invalidation (``_park`` /
+        ``_retire``); only ``produced`` uploads per tick — the hot
+        path pays one tiny transfer, not five."""
+        jnp = self._jnp
+        if self._samp_cache is None:
+            S = self.scheduler.max_batch
+            temp = np.zeros((S,), np.float32)
+            top_p = np.ones((S,), np.float32)
+            top_k = np.zeros((S,), np.int32)
+            for slot, req in enumerate(self.scheduler.slots):
+                if req is None:
+                    continue
+                temp[slot] = req.temperature
+                top_p[slot] = req.top_p
+                top_k[slot] = req.top_k
+            self._samp_cache = dict(
+                temp=jnp.asarray(temp), top_p=jnp.asarray(top_p),
+                top_k=jnp.asarray(top_k),
+                key=jnp.asarray(self._key_data))
+        return dict(self._samp_cache,
+                    produced=jnp.asarray(
+                        self._produced.astype(np.int32)))
 
     def _emit(self, slot: int, req: Request, tok: int) -> bool:
         """Stream one token; returns True when the request just
@@ -847,7 +892,8 @@ class ServingEngine:
         req = self.scheduler.retire(slot, state)
         self._cur_tok[slot] = 0
         self._produced[slot] = 0
-        self._keys[slot] = None
+        self._key_data[slot] = 0
+        self._samp_cache = None
         self.metrics.inc({COMPLETED: "completed", CANCELLED: "cancelled",
                           TIMED_OUT: "timed_out"}[state])
         # whole-lifecycle span, submit -> retirement, on the slot track
@@ -855,11 +901,15 @@ class ServingEngine:
                         req.finish_t, req=req.id, state=state,
                         tokens=len(req.tokens))
 
-    def _emit_greedy(self, slot: int, req: Request, toks_row,
-                     j0: int, j1: int) -> None:
-        """Emit ``toks_row[j0:j1]`` (fused greedy block/tail tokens)
-        for (slot, req), retiring at the first completion — remaining
-        block tokens are discarded (they landed on the trash page)."""
+    def _emit_toks(self, slot: int, req: Request, toks_row,
+                   j0: int, j1: int) -> None:
+        """Emit ``toks_row[j0:j1]`` (fused block/tail/verify tokens —
+        greedy or in-graph-sampled) for (slot, req), retiring at the
+        first completion — remaining tokens are discarded (their KV
+        landed on the trash page or past the length, and discarded
+        sampled tokens burn no key state: draws are keyed by
+        continuation index, so the next launch re-draws them
+        identically)."""
         for j in range(j0, j1):
             t = int(toks_row[j])
             self._cur_tok[slot] = t
@@ -886,6 +936,18 @@ class ServingEngine:
         req.chunk_done = 0
         req.table_row = self.scheduler.tables[slot].copy()
         self.scheduler.tables[slot, :] = PagePool.TRASH
+        # the slot's constant sampling key (fused sampler, r16); the
+        # tick folds each token's continuation index in, so this never
+        # advances host-side. Built as raw threefry key DATA —
+        # [0, seed & 0xffffffff], bit-identical to
+        # jax.random.PRNGKey(seed) under the default (x64-off) config
+        # for negative and >32-bit seeds too (pinned by test; the mask
+        # runs on the PYTHON int — np.uint64(-1) raises on NumPy 2) —
+        # because a jax call here would put a jit dispatch + device
+        # sync on the admission path (measured as a real
+        # engine-throughput hit on admission-heavy traffic)
+        self._key_data[slot] = (0, req.seed & 0xffffffff)
+        self._samp_cache = None
         self._prefill_q.append((slot, req))
 
     def _collect_spans(self):
@@ -942,11 +1004,17 @@ class ServingEngine:
     def _collect_drafts(self, live):
         """The tick's draft side (host, model-free by default): ask the
         drafter for up to ``policy.budget(...)`` next tokens per live
-        GREEDY slot. Returns ``{slot: int32[k_s]}`` with ``1 <= k_s <=
-        spec_k``; slots with no entry decode plainly this tick.
-        Drafting never blocks correctness — an arbitrarily wrong draft
-        only costs the wasted span rows (verification emits the
-        target's own tokens)."""
+        slot — SAMPLING slots included since r16: the verify pass
+        draws the target's own sampled token at every span position
+        (same fold_in key a plain tick would use), accepts while the
+        draft matches it, and the emitted stream stays bitwise the
+        non-speculative engine's; low acceptance on an unpredictable
+        sampled stream just degrades the slot to plain decode through
+        the ordinary acceptance EWMA. Returns ``{slot: int32[k_s]}``
+        with ``1 <= k_s <= spec_k``; slots with no entry decode
+        plainly this tick. Drafting never blocks correctness — an
+        arbitrarily wrong draft only costs the wasted span rows
+        (verification emits the target's own tokens)."""
         drafts = {}
         t0 = time.monotonic()
         # a drafter that declares its history window (NGramDrafter
@@ -956,8 +1024,6 @@ class ServingEngine:
         # without the attribute keep the whole-history contract
         window = getattr(self._drafter, "max_history", None)
         for slot, req in live:
-            if req.temperature != 0.0:
-                continue    # speculation is a greedy-only lever
             remaining = req.max_new_tokens - int(self._produced[slot]) - 1
             k = self._spec_policy.budget(req, remaining)
             if k <= 0:
@@ -987,13 +1053,14 @@ class ServingEngine:
         plus the collected prompt spans. Geometry is data: the program
         compiles once per packed width (S when no prefill work is
         pending, S + the smallest width-grid entry covering the span
-        tokens otherwise). ``tail`` (> 0 only when every
-        participating request is greedy) fuses that many extra decode
-        steps into the same program for tail-live slots — decoding
-        slots plus spans COMPLETING their prompt this tick — so an
-        admission tick still produces a full decode block for in-flight
-        streams (mid-prefill slots sit the tail out on the trash
-        page).
+        tokens otherwise). ``tail`` fuses that many extra decode steps
+        into the same program for tail-live slots — decoding slots
+        plus spans COMPLETING their prompt this tick — so an admission
+        tick still produces a full decode block for in-flight streams
+        (mid-prefill slots sit the tail out on the trash page). Since
+        r16 sampling slots ride the tail too: token selection is the
+        in-graph fused sampler, per-slot params and keys are meta
+        DATA.
 
         ``drafts`` (``{slot: draft tokens}``, speculative engines only)
         turns drafted slots into ordinary ragged SPANS: current token
@@ -1009,8 +1076,8 @@ class ServingEngine:
         pps = self.scheduler.pages_per_slot
         drafts = drafts or {}
         # speculative engines route every span-carrying tick through
-        # the verify program (one program per mixed width); plain
-        # width-S ticks (pure sampling) stay on the shared base program
+        # the verify program (one program per mixed width); draft-less
+        # pure-decode ticks run the fused block instead (_decode_tick)
         spec = self._spec_k if (drafts or spans) else 0
         if spec:
             tail = 0    # speculation replaces the fused greedy tail
@@ -1085,7 +1152,8 @@ class ServingEngine:
                     tok_qoff=jnp.asarray(tok_qoff),
                     q_len=jnp.asarray(q_len), kv_len=jnp.asarray(kv_len),
                     last=jnp.asarray(last), tables=jnp.asarray(tabs),
-                    tail_live=jnp.asarray(tail_live))
+                    tail_live=jnp.asarray(tail_live),
+                    **self._sampling_arrays())
         if spec:
             # verify geometry: per-slot span-position indices + drafts
             # (all DATA — non-speculating slots point at `last`, so
@@ -1110,7 +1178,7 @@ class ServingEngine:
                                  live=len(live), span_tokens=int(span_tok),
                                  tail=int(tail), spec=len(spec_rows)):
             if spec:
-                toks_d, accept_d, logits_d, self._kp, self._vp = \
+                toks_d, accept_d, _logits_d, self._kp, self._vp = \
                     self._tick_jit(self._params, jnp.asarray(tok), meta,
                                    self._kp, self._vp, tq=tq,
                                    decode_tail=0, spec_k=spec)
@@ -1118,10 +1186,12 @@ class ServingEngine:
                 toks = np.asarray(toks_d)
                 accept = np.asarray(accept_d)
             else:
-                toks_d, logits_d, self._kp, self._vp = self._tick_jit(
+                toks_d, _logits_d, self._kp, self._vp = self._tick_jit(
                     self._params, jnp.asarray(tok), meta, self._kp,
                     self._vp, tq=tq, decode_tail=tail)
-                # [S] (tail=0) or [S, 1+tail] i32 — the only eager pull
+                # [S] (tail=0) or [S, 1+tail] i32 — the only eager
+                # pull: sampling happens IN-GRAPH (r16), so no [S, V]
+                # logits row ever crosses to the host
                 toks = np.asarray(toks_d)
         m1 = time.monotonic()
         if toks.ndim == 1:
@@ -1132,11 +1202,6 @@ class ServingEngine:
                                  (time.perf_counter() - t0) / (1 + tail))
         if spec_rows:
             self.metrics.inc("spec_ticks")
-
-        def next_tok(slot, req):
-            if req.temperature == 0.0:
-                return int(toks[slot, 0])  # in-graph argmax
-            return self._sample(slot, req, np.asarray(logits_d[slot]))
 
         for slot, req in live:
             d = drafts.get(slot)
@@ -1161,37 +1226,40 @@ class ServingEngine:
                         self.tracer.add("spec.rollback", f"slot{slot}",
                                         m1, m1, req=req.id,
                                         rejected=k_s - a)
-                self._emit_greedy(slot, req, toks[slot], 0, a + 1)
+                self._emit_toks(slot, req, toks[slot], 0, a + 1)
                 continue
             self.scheduler.lengths[slot] += 1 + tail
-            t = next_tok(slot, req)
+            t = int(toks[slot, 0])     # in-graph argmax OR fused sample
             self._cur_tok[slot] = t
             if self._emit(slot, req, t):
                 self._retire(slot, COMPLETED)
                 continue
-            self._emit_greedy(slot, req, toks[slot], 1, 1 + tail)
+            self._emit_toks(slot, req, toks[slot], 1, 1 + tail)
         for slot, req, start, take in spans:
             req.chunk_done += take
             self.metrics.inc("prefill_chunks")
             if req.cached_len + req.chunk_done >= req.prompt.size:
                 if self._prefill_q and self._prefill_q[0][1] is req:
                     self._prefill_q.popleft()
-                self._finish_prefill(slot, req, next_tok(slot, req))
+                self._finish_prefill(slot, req, int(toks[slot, 0]))
                 if tail and self.scheduler.slots[slot] is req:
                     # the completing slot rode the tail too: its first
-                    # 1+tail greedy tokens landed in this same program
+                    # 1+tail tokens landed in this same program
                     self.scheduler.lengths[slot] += tail
-                    self._emit_greedy(slot, req, toks[slot], 1, 1 + tail)
+                    self._emit_toks(slot, req, toks[slot], 1, 1 + tail)
 
     def _block_tick(self, live) -> None:
-        """Fast path when no prefill work is pending and every live
-        request is greedy: ``num_steps`` fused decode ticks in one
-        program — sampling is in-graph argmax, so the device→host pull
-        is [S, k] i32 tokens instead of [S, V] f32 logits. Fused ticks
+        """Fast path when no prefill work is pending: ``num_steps``
+        fused decode ticks in one program — token selection is
+        in-graph (argmax for greedy slots, the fused
+        temperature/top-k/top-p sampler for sampling ones, r16), so
+        the device→host pull is [S, k] i32 tokens and NO [S, V] f32
+        logits row ever crosses, whoever is sampling. Fused ticks
         always run the FULL block — capping at the remaining tokens
         would compile one program per distinct cap; at worst K-1 cheap
         steps run past the last retirement and their tokens are
-        discarded (budget overruns land on the trash page)."""
+        discarded (budget overruns land on the trash page, and
+        discarded sampled tokens burn no key state)."""
         jnp = self._jnp
         k = self._decode_block
         t0 = time.perf_counter()
@@ -1203,47 +1271,43 @@ class ServingEngine:
                 self._params, jnp.asarray(self._cur_tok),
                 jnp.asarray(self.scheduler.lengths),
                 jnp.asarray(self.scheduler.tables), self._kp,
-                self._vp, num_steps=k)
-            toks = np.asarray(toks)        # [S, k] greedy tokens
+                self._vp, num_steps=k,
+                sampling=self._sampling_arrays())
+            toks = np.asarray(toks)        # [S, k] i32 tokens
         self.metrics.inc("decode_steps", k)
         self.metrics.observe("decode_step_s",
                              (time.perf_counter() - t0) / k)
         for slot, req in live:
             self.scheduler.lengths[slot] += k  # block's KV just landed
-            self._emit_greedy(slot, req, toks[slot], 0, k)
+            self._emit_toks(slot, req, toks[slot], 0, k)
 
     def _decode_tick(self, live, spans) -> None:
-        """Tick dispatch: the fused greedy block when the tick is pure
-        decode, else the ragged one-program tick (with the fused greedy
-        decode tail when nobody riding it samples). Only live decoders
-        and spans COMPLETING their prompt this tick gate the tail —
-        mid-prefill spans sit it out on the trash page regardless
-        (``tail_live``), so a parked sampling request must not throttle
-        in-flight greedy streams to one token per tick for the length
-        of its prefill.
+        """Tick dispatch (r16 — sampling is DATA, so temperature never
+        picks a program): the fused block when the tick is pure
+        decode, else the ragged one-program tick with the fused decode
+        tail. Only live decoders and spans COMPLETING their prompt
+        this tick gate the tail — mid-prefill spans sit it out on the
+        trash page (``tail_live``). The pre-r16 width-S single-step
+        sampling program and the sampling-disables-the-tail rule are
+        both gone: SAMPLING slots ride the block/tail through the
+        in-graph fused sampler.
 
         Speculative engines add one branch on top: any tick with
         drafts or prefill spans runs the verify program (drafted slots
         as ragged spans, everything else riding along); a tick with
-        neither falls through to the plain paths — pure-greedy live
-        slots whose acceptance degraded them to k=0 still get the
-        fused block, so 'speculation off' is a per-slot data state,
-        not a different program set."""
+        neither falls through to the plain paths — live slots whose
+        acceptance degraded them to k=0 still get the fused block, so
+        'speculation off' is a per-slot data state, not a different
+        program set."""
         if self._drafter is not None:
             drafts = self._collect_drafts(live)
             if drafts or spans:
                 self._ragged_tick(live, spans, 0, drafts)
                 return
-        greedy_live = all(r.temperature == 0.0 for _, r in live)
-        if not spans and greedy_live and live:
+        if not spans and live:
             self._block_tick(live)
-        else:
-            greedy_completing = all(
-                r.temperature == 0.0 for _, r, start, take in spans
-                if start + take >= r.prompt.size)
-            tail = (self._decode_block - 1
-                    if greedy_live and greedy_completing else 0)
-            self._ragged_tick(live, spans, tail)
+        elif spans:
+            self._ragged_tick(live, spans, self._decode_block - 1)
 
     def _sweep(self, now: float) -> None:
         """Apply cancellations + deadlines to queued and occupied

@@ -49,7 +49,8 @@ class Request:
     callers hold the RequestHandle)."""
 
     __slots__ = ("id", "prompt", "max_new_tokens", "eos_token_id",
-                 "deadline_s", "temperature", "seed", "state", "tokens",
+                 "deadline_s", "temperature", "top_p", "top_k", "seed",
+                 "state", "tokens",
                  "submit_t", "admit_t", "first_token_t", "finish_t",
                  "slot", "pages", "cancel_flag", "stream", "done",
                  "error", "prefix_nodes", "cached_len", "prefilling",
@@ -58,7 +59,8 @@ class Request:
     def __init__(self, prompt, max_new_tokens: int,
                  eos_token_id: Optional[int] = None,
                  deadline_s: Optional[float] = None,
-                 temperature: float = 0.0, seed: int = 0):
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 top_k: int = 0, seed: int = 0):
         self.id = next(_ids)
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
         if self.prompt.size < 1:
@@ -71,6 +73,10 @@ class Request:
         # absolute monotonic completion deadline (None = never)
         self.deadline_s = deadline_s
         self.temperature = float(temperature)
+        # top-k/top-p ride the fused in-graph sampler as per-slot DATA
+        # (r16); 0 / 1.0 = filters off
+        self.top_p = float(top_p)
+        self.top_k = int(top_k)
         self.seed = int(seed)
         self.state = QUEUED
         self.tokens: List[int] = []

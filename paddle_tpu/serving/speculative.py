@@ -9,10 +9,14 @@ DRAFTER proposes up to ``k`` next tokens, the target model scores the
 whole draft as one ragged span through the SAME ``serving_tick``
 program (models/llama.py ``spec_k`` verify mode), and the in-graph
 longest-prefix acceptance emits ``1 + accepted`` tokens per launch.
-Greedy outputs stay bitwise-equal to plain decode whatever the drafter
-proposes: accepted drafts equal the target argmax BY CONSTRUCTION
-(that is the acceptance test), and the first non-matching position
-emits the target's own correction token.
+Outputs stay bitwise-equal to plain decode whatever the drafter
+proposes: a draft is accepted only while it equals the target's OWN
+token pick at that span position — the greedy argmax, or (r16, so
+``spec_k`` is no longer greedy-only) the fused sampler's draw, whose
+fold_in-by-token-index key is exactly the one a plain tick would use
+— and the first non-matching position emits the target's own
+correction token. Acceptance on an unpredictable sampled stream is
+naturally low; the policy below degrades such slots to plain decode.
 
 Drafting here is HOST-side and model-free by default
 (:class:`NGramDrafter` — prompt-lookup / self-drafting: the

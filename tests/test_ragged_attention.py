@@ -199,6 +199,102 @@ def test_bottom_right_causal_prefill_equals_whole():
 
 
 # ---------------------------------------------------------------------------
+# tiled flash-combine walk (r16): bitwise vs the tiled reference,
+# ulp-at-row-scale contract vs the one-shot kernel, O(tile) scratch
+# ---------------------------------------------------------------------------
+
+from paddle_tpu.ops.pallas.ragged_paged_attention import (  # noqa: E402
+    ONE_SHOT_VMEM_BUDGET, TILED_ULP_BOUND, default_kv_tile_pages,
+    tiled_ulp_error, vmem_scratch_bytes)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("tile", [1, 3, 5])
+def test_tiled_kernel_matches_tiled_reference_bitwise(seed, tile):
+    """The tiled Pallas kernel (double-buffered DMA walk, interpret
+    off-TPU) is BITWISE-equal to the tiled dense reference — the same
+    ``_flash_tile`` math at two call sites, the one-shot kernel's own
+    verification story replayed. tile=3 does not divide pps=5 (ragged
+    last tile); tile=5 is the whole table in one tile."""
+    case = _ragged_case(seed)
+    out_k = ragged_paged_attention(*case, impl="pallas",
+                                   kv_tile_pages=tile)
+    out_r = ragged_paged_attention(*case, impl="dense",
+                                   kv_tile_pages=tile)
+    assert out_k.dtype == out_r.dtype
+    np.testing.assert_array_equal(np.asarray(out_k), np.asarray(out_r))
+
+
+def test_tiled_kernel_post_defrag_and_degenerate_slots():
+    """Scattered page tables, kv_len=0 (dead slot -> exact zeros),
+    kv_len=1 and single-page slots through the tiled walk."""
+    case = _ragged_case(7, scatter_tables=True)
+    a = ragged_paged_attention(*case, impl="pallas", kv_tile_pages=2)
+    b = ragged_paged_attention(*case, impl="dense", kv_tile_pages=2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    q, kp, vp, _, _, tables = _ragged_case(3)
+    zeros = jnp.zeros((4,), jnp.int32)
+    out = ragged_paged_attention(q, kp, vp, zeros, zeros, tables,
+                                 impl="pallas", kv_tile_pages=2)
+    assert not np.asarray(out).any()
+    # kv_len 1 and single-page (kv_len <= page_size) slots
+    q_len = jnp.asarray([1, 1, 1, 1], jnp.int32)
+    kv_len = jnp.asarray([1, 4, 2, 3], jnp.int32)
+    a = ragged_paged_attention(q, kp, vp, q_len, kv_len, tables,
+                               impl="pallas", kv_tile_pages=2)
+    b = ragged_paged_attention(q, kp, vp, q_len, kv_len, tables,
+                               impl="dense", kv_tile_pages=2)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 5])
+@pytest.mark.parametrize("pps,ps", [(5, 4), (32, 8)])
+def test_tiled_vs_oneshot_ulp_contract(seed, pps, ps):
+    """The tiled walk's exactness contract vs the one-shot kernel
+    (TILED_ULP_BOUND — ulp measured at the slot's output scale; a raw
+    per-element ulp bound cannot survive the flash combine's
+    reassociation at cancellation-small components, see the kernel
+    module). Mixed prefill+decode spans, empty slots, partial tail
+    pages, tiles that do not divide the live page count."""
+    case = _ragged_case(seed, pps=pps, ps=ps)
+    one = np.asarray(ragged_paged_attention(*case, impl="dense",
+                                            kv_tile_pages=0))
+    for tile in (1, 3, max(pps // 2, 1), pps):
+        tiled = np.asarray(ragged_paged_attention(
+            *case, impl="pallas", kv_tile_pages=tile))
+        err = tiled_ulp_error(tiled, one)
+        assert err <= TILED_ULP_BOUND, (seed, pps, ps, tile, err)
+
+
+def test_tiled_scratch_independent_of_table_width():
+    """The acceptance property in numbers, straight from the scratch
+    shapes: one-shot K+V scratch grows with pages_per_slot; the tiled
+    walk's does not — a 100k-token table pins the same VMEM as a 2k
+    one — and the geometry auto-selection flips to tiled exactly at
+    the budget knee."""
+    ps, dh = 16, 128
+    tiles = [vmem_scratch_bytes(pps, ps, dh, jnp.bfloat16,
+                                kv_tile_pages=32)
+             for pps in (128, 512, 6250)]
+    assert len(set(tiles)) == 1
+    shots = [vmem_scratch_bytes(pps, ps, dh, jnp.bfloat16)
+             for pps in (128, 512, 6250)]
+    assert shots == sorted(shots) and shots[0] < shots[-1]
+    # knee: <= budget -> one-shot (0); past it -> a tile
+    assert default_kv_tile_pages(128, ps, dh, jnp.bfloat16) == 0
+    big = default_kv_tile_pages(6250, ps, dh, jnp.bfloat16)
+    assert big > 0
+    assert vmem_scratch_bytes(6250, ps, dh, jnp.bfloat16,
+                              kv_tile_pages=big) \
+        <= ONE_SHOT_VMEM_BUDGET
+    # the knee itself sits at the budget boundary
+    knee_pps = ONE_SHOT_VMEM_BUDGET // (2 * ps * dh * 2)
+    assert default_kv_tile_pages(knee_pps, ps, dh, jnp.bfloat16) == 0
+    assert default_kv_tile_pages(knee_pps + 1, ps, dh,
+                                 jnp.bfloat16) > 0
+
+
+# ---------------------------------------------------------------------------
 # engine exactness: greedy == generate() in every cache state
 # ---------------------------------------------------------------------------
 
@@ -364,6 +460,74 @@ def test_serving_bench_ragged_ab_smoke():
     assert ps["ragged_worst_per_bucket"] <= 2
     assert ps["ragged_worst_per_bucket"] < ps["bucketed_worst_per_bucket"]
     assert ps["ragged"] < ps["bucketed"]
+
+
+@pytest.mark.slow
+def test_100k_token_page_table_serves_end_to_end(params):
+    """The r16 acceptance scenario: a page table spanning ~100k tokens
+    serves through the engine end-to-end, bitwise-equal to
+    ``generate()`` — the geometry the one-shot walk cannot hold
+    on-chip (its K+V scratch would be ~100 MB at serving dims; the
+    auto-selection proves it flips to the tiled walk there), kept out
+    of tier-1 for runtime.
+
+    Three layers of evidence:
+    * kernel: tiled == one-shot at kv_len = 100_000 under the
+      ulp-at-row-scale contract (dense formulations — off-TPU there
+      is no VMEM, the formulation is what's under test), and the
+      tiled PALLAS walk (interpret) bitwise == the tiled reference at
+      an 8k-token table (512 pages, 32 double-buffered tiles);
+    * geometry: ``default_kv_tile_pages`` picks the tiled walk at the
+      100k table and its scratch equals the 2k table's;
+    * engine: a request decodes against the 100k-capacity table
+      (pages_per_slot=6253) bitwise-equal to ``generate()``
+      (attn_impl='dense' — the slot-major gather; the packed CPU
+      formulation gathers per TOKEN and would thrash, which is
+      exactly the work-scaling story docs/PERF.md records)."""
+    # --- kernel at kv = 100_000 --------------------------------------
+    rng = np.random.RandomState(0)
+    Hkv, Dh, ps = 2, 16, 16
+    pps = -(-100_000 // ps)                      # 6250 pages
+    P = pps + 2
+    q = jnp.asarray(rng.randn(1, 1, 4, Dh).astype(np.float32))
+    kp = jnp.asarray(rng.randn(Hkv, P, ps, Dh).astype(np.float32))
+    vp = jnp.asarray(rng.randn(Hkv, P, ps, Dh).astype(np.float32))
+    ql = jnp.ones((1,), jnp.int32)
+    kl = jnp.full((1,), 100_000, jnp.int32)
+    tabs = jnp.asarray(1 + np.arange(pps, dtype=np.int32)[None])
+    tile = default_kv_tile_pages(pps, ps, Dh, jnp.float32)
+    assert tile > 0                              # past the VMEM knee
+    assert vmem_scratch_bytes(pps, ps, Dh, jnp.float32,
+                              kv_tile_pages=tile) == \
+        vmem_scratch_bytes(128, ps, Dh, jnp.float32,
+                           kv_tile_pages=tile)
+    one = np.asarray(ragged_paged_attention(
+        q, kp, vp, ql, kl, tabs, impl="dense", kv_tile_pages=0))
+    tiled = np.asarray(ragged_paged_attention(
+        q, kp, vp, ql, kl, tabs, impl="dense", kv_tile_pages=tile))
+    assert tiled_ulp_error(tiled, one) <= TILED_ULP_BOUND
+    # tiled PALLAS (interpret) at an 8k table: the real kernel's
+    # double-buffered DMA walk, bitwise vs the tiled reference
+    kl8 = jnp.full((1,), 8000, jnp.int32)
+    a = ragged_paged_attention(q, kp[:, :514], vp[:, :514], ql, kl8,
+                               tabs[:, :512], impl="pallas",
+                               kv_tile_pages=16)
+    b = ragged_paged_attention(q, kp[:, :514], vp[:, :514], ql, kl8,
+                               tabs[:, :512], impl="dense",
+                               kv_tile_pages=16)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # --- engine over the 100k-capacity table -------------------------
+    prompt = np.random.RandomState(1).randint(
+        0, CFG.vocab_size, (12,)).astype(np.int32)
+    with ServingEngine(params, CFG, max_batch=1, page_size=ps,
+                       max_prompt_len=32, max_new_tokens_cap=100_000,
+                       attn_impl="dense", decode_block_size=8,
+                       prefix_cache=False) as eng:
+        assert eng.scheduler.pages_per_slot >= 6250
+        out = eng.submit(prompt, 24).result(timeout=600)
+        assert eng.audit() == []
+    np.testing.assert_array_equal(out, _ref(params, prompt, 24))
 
 
 @pytest.mark.slow

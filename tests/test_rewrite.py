@@ -420,6 +420,36 @@ def test_source_lint_host_sync_noqa_suppresses():
                 if r.startswith("PT")]
 
 
+def test_source_lint_pt004_table_width_vmem_scratch():
+    """PT004 (r16): a Pallas kernel allocating VMEM scratch that
+    scales with pages_per_slot flags — the CI guard that the
+    long-context ceiling cannot silently regress — while noqa'd
+    (explicitly one-shot) and O(tile) shapes stay clean, and the rule
+    only runs in pallas scope."""
+    from paddle_tpu.analysis.source_lint import lint_file
+    bad = (
+        "from jax.experimental.pallas import tpu as pltpu\n\n\n"
+        "def shapes(pps, page_size, dh, tile, dt):\n"
+        "    return [pltpu.VMEM((pps, page_size, dh), dt),\n"
+        "            pltpu.VMEM((2, tile, page_size, dh), dt)]\n"
+    )
+    hits = [r for r, _, _ in lint_file("fake.py", src=bad,
+                                       pallas_scope=True)
+            if r == "PT004"]
+    assert hits == ["PT004"]        # the O(tile) shape did not flag
+    assert not [r for r, _, _ in lint_file("fake.py", src=bad)
+                if r == "PT004"]    # non-pallas scope: rule off
+    ok = (
+        "from jax.experimental.pallas import tpu as pltpu\n\n\n"
+        "def shapes(pps, page_size, dh, dt):\n"
+        "    return pltpu.VMEM((pps, page_size, dh), dt)"
+        "  # noqa: PT004 — one-shot by design\n"
+    )
+    assert not [r for r, _, _ in lint_file("fake.py", src=ok,
+                                           pallas_scope=True)
+                if r == "PT004"]
+
+
 def test_source_lint_conservative_on_locals():
     # coercions of locals it cannot prove jax-rooted do not flag
     from paddle_tpu.analysis.source_lint import lint_file

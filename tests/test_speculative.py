@@ -311,7 +311,10 @@ def test_spec_program_inventory_matches_live_engine(params):
     assert inv["programs_per_bucket"] <= 2
     for width, progs in inv["widths"].items():
         if int(width) == S:
-            assert len(progs) == 2
+            # r16: the fused block ALONE — the width-S single-step
+            # sampling tick is gone (sampling rides the block as data)
+            assert len(progs) == 1
+            assert progs[0].startswith("serving_tick_block")
         else:
             assert progs == ["serving_tick[verify,spec_k=3]"]
 
@@ -326,7 +329,9 @@ def test_warm_programs_keeps_sentinel_clean(params):
     with _engine(params, recompile_sentinel=True, prefill_chunk=4,
                  max_batch=2) as eng:
         n = eng.warm_programs()
-        assert n == len(eng._w_grid) + 2
+        # r16: one verify compile per mixed width + the fused block
+        # (the single-step sampling tick no longer exists to warm)
+        assert n == len(eng._w_grid) + 1
         rep0 = eng.sentinel.report()
         assert rep0["warmup_compiles"] >= 1
         eng.arm_sentinel()

@@ -9,8 +9,10 @@ runs, in seconds and with zero XLA compiles:
 
   * the jaxpr lint passes (dtype-drift, host-sync,
     collective-consistency) over the flagship llama + qwen2_moe
-    serving programs (the r12 one-program tick: `serving_tick` at its
-    mixed and decode widths, the fused `serving_tick_block`,
+    serving programs (the r12 one-program tick as r16 reshaped it:
+    `serving_tick` at the mixed width, the fused `serving_tick_block`
+    with the in-graph sampling state traced as data — the width-S
+    single-step sampling program no longer exists — and
     `generate_paged`) and the llama pp stage chunks;
   * the recompile-hazard pass over the flagship engine geometry —
     statically proving the ≤2-programs-per-packed-width one-program-

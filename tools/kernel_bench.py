@@ -7,15 +7,36 @@ jit_* device events / iterations). Wall-clock through the tunnelled
 runtime carries ~70 ms/call dispatch overhead that would swamp
 sub-millisecond kernels; device time is what the hardware actually
 spends. Results recorded in docs/PERF.md.
+
+``--ragged-sweep`` (r16) runs the tiled-vs-one-shot ragged
+paged-attention A/B instead: a sweep over (pages_per_slot, page_size,
+kv_tile_pages) geometries, ONE JSON LINE PER CONFIG on stdout (and
+``--out=path`` as JSONL), each carrying a ``vmem_scratch_bytes``
+column computed from the kernels' actual scratch shapes — the
+evidence that tiled scratch is O(tile) while one-shot scratch grows
+with the table. Per geometry the fastest variant is then recorded
+through ``ops.autotune`` (key ``("ragged_kv_walk", ...)``) — the
+first entry of the KForge-style autotune loop (PAPERS.md
+2606.02963): block shapes searched against the bench harness, cache
+picks the winner per geometry. On TPU it times device events; off
+TPU it still runs end-to-end in interpreter mode (wall-clock,
+``timing_honest: false`` — the smoke path; the overdue on-chip round,
+ROADMAP item 3, reruns it unmodified for real numbers).
 """
+import functools
 import glob
 import gzip
 import json
+import os
+import sys
 import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def devtime(f, args, tag, n=5):
@@ -125,8 +146,119 @@ def bench_rms():
     print(f"  speedup      : {tx/tf:.2f}x")
 
 
+def _walltime(f, args, n=3):
+    """best-of wall-clock ms/call (the off-TPU fallback — honest
+    enough for interpret-mode smoke, not for perf claims)."""
+    y = f(*args)
+    jax.block_until_ready(y)
+    best = float("inf")
+    for _ in range(n):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(*args))
+        best = min(best, time.perf_counter() - t0)
+    return best * 1e3
+
+
+def ragged_tiling_sweep(out=None, iters=3):
+    """Tiled-vs-one-shot ragged paged-attention A/B (module
+    docstring). Returns the list of per-config result dicts."""
+    from paddle_tpu.ops import autotune as at
+    from paddle_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention, vmem_scratch_bytes)
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        dt = jnp.bfloat16
+        S, H, Hkv, Dh = 8, 32, 8, 128
+        # pps x page_size spans the knee: 2k tokens (one-shot
+        # territory) to 100k (tiled-only)
+        geoms = [(128, 16), (512, 16), (2048, 16), (6250, 16)]
+        tiles = (0, 8, 16, 32, 64)
+    else:
+        dt = jnp.float32
+        S, H, Hkv, Dh = 2, 4, 2, 8
+        geoms = [(8, 4), (32, 4)]
+        tiles = (0, 2, 4, 8)
+    rng = np.random.RandomState(0)
+    results = []
+    for pps, ps in geoms:
+        P = S * pps + 1
+        kv_len = pps * ps
+        q = jnp.asarray(rng.randn(S, 1, H, Dh), dt)       # decode spans
+        kp = jnp.asarray(rng.randn(Hkv, P, ps, Dh), dt)
+        vp = jnp.asarray(rng.randn(Hkv, P, ps, Dh), dt)
+        ql = jnp.ones((S,), jnp.int32)
+        kl = jnp.full((S,), kv_len, jnp.int32)
+        tabs = jnp.asarray(
+            1 + np.arange(S * pps, dtype=np.int32).reshape(S, pps))
+        args = (q, kp, vp, ql, kl, tabs)
+
+        def make(tile):
+            return jax.jit(functools.partial(
+                ragged_paged_attention, impl="pallas",
+                kv_tile_pages=tile))
+
+        cands, rows = [], []
+        for tile in tiles:
+            if tile > pps:
+                continue
+            scratch = vmem_scratch_bytes(pps, ps, Dh, dt,
+                                         kv_tile_pages=tile)
+            row = {
+                "bench": "ragged_kv_walk", "pps": pps, "page_size": ps,
+                "kv_len": kv_len, "slots": S, "heads": H,
+                "kv_heads": Hkv, "head_dim": Dh, "dtype": str(jnp.dtype(dt)),
+                "kv_tile_pages": tile,
+                "walk": "tiled" if tile else "oneshot",
+                "vmem_scratch_bytes": scratch,
+                "timing_honest": on_tpu,
+            }
+            # the one-shot variant past the VMEM knee cannot even
+            # compile on the chip — that IS the result (the row the
+            # tiled walk exists for), not a reason to abort the sweep
+            if on_tpu and tile == 0 and scratch > 12 * 2 ** 20:
+                rows.append(dict(row, ms=None,
+                                 skipped="oneshot scratch exceeds VMEM"))
+                continue
+            fn = make(tile)
+            try:
+                if on_tpu:
+                    ms = devtime(fn, args, f"rg_{pps}_{ps}_{tile}",
+                                 n=iters)
+                else:
+                    ms = _walltime(fn, args, n=iters)
+            except Exception as e:   # compile/scratch failure = a row
+                rows.append(dict(row, ms=None, error=str(e)[:200]))
+                continue
+            rows.append(dict(row, ms=round(ms, 4)))
+            cands.append((len(rows) - 1, fn))
+        # the KForge-style loop's first entry: cache the measured
+        # winner per geometry so a runtime dispatcher can pick it
+        # (skipped/failed variants never become candidates)
+        if cands:
+            key = ("ragged_kv_walk", pps, ps, Dh, Hkv,
+                   str(jnp.dtype(dt)))
+            at.autotune(key, [f for _, f in cands], args,
+                        iters=max(iters, 2))
+            win_row = cands[at.cache_info()[0][key]][0]
+            for i, row in enumerate(rows):
+                row["autotune_winner"] = bool(i == win_row)
+        results.extend(rows)
+    for row in results:
+        print(json.dumps(row))
+    if out:
+        with open(out, "w") as f:
+            for row in results:
+                f.write(json.dumps(row) + "\n")
+    return results
+
+
 if __name__ == "__main__":
-    assert jax.default_backend() == "tpu", "run on the TPU chip"
-    bench_moe()
-    bench_rope()
-    bench_rms()
+    if "--ragged-sweep" in sys.argv:
+        path = next((a.split("=", 1)[1] for a in sys.argv
+                     if a.startswith("--out=")), None)
+        ragged_tiling_sweep(out=path)
+    else:
+        assert jax.default_backend() == "tpu", "run on the TPU chip"
+        bench_moe()
+        bench_rope()
+        bench_rms()

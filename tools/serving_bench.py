@@ -227,13 +227,22 @@ class Bench:
         # and the fused block; from here any compile is a warmed-run
         # regression the sentinel must name
         eng.arm_sentinel()
+        # --sample-frac: that fraction of requests submit with
+        # temperature/top-p sampling (fused in-graph sampler, r16) —
+        # deterministic per bench seed. Sampling is DATA to the tick,
+        # so the armed sentinel doubles as the proof that sampled
+        # traffic compiles NOTHING beyond the warmed inventory.
+        sampled = (np.random.RandomState(a.seed).rand(len(trace))
+                   < a.sample_frac)
         t0 = time.perf_counter()
         handles = []
-        for arrival, prompt, mnt in trace:
+        for i, (arrival, prompt, mnt) in enumerate(trace):
             now = time.perf_counter() - t0
             if now < arrival:
                 time.sleep(arrival - now)
-            handles.append(eng.submit(prompt, mnt))
+            kw = (dict(temperature=a.temperature, top_p=0.95, seed=i)
+                  if sampled[i] else {})
+            handles.append(eng.submit(prompt, mnt, **kw))
         outs = [h.result(timeout=600) for h in handles]
         wall = time.perf_counter() - t0
         snap = eng.stats()
@@ -900,6 +909,13 @@ def main(argv=None):
     ap.add_argument("--admission-window", type=int, default=0,
                     help="queued requests allowed to overtake a "
                          "non-fitting head (0 = strict FIFO)")
+    ap.add_argument("--sample-frac", type=float, default=0.0,
+                    help="fraction of engine-mode requests submitted "
+                         "with temperature/top-p sampling (r16 fused "
+                         "sampler: rides the same programs — the "
+                         "sentinel gate proves it)")
+    ap.add_argument("--temperature", type=float, default=0.8,
+                    help="temperature for --sample-frac requests")
     ap.add_argument("--speculative", action="store_true",
                     help="serve the engine mode with self-drafting "
                          "(n-gram) speculative decoding")
