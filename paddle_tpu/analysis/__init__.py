@@ -10,6 +10,11 @@ through) and over the serving stack's host-side state. Entry points:
   llama + qwen2_moe serving graphs and the llama train-step graphs at
   the dp / dp×mp / pp(1F1B) / zero-sharded geometries (the pre-merge
   check).
+* ``tools/auto_parallel.py`` — the auto-parallel planner
+  (``analysis/planner.py``): search + rank the legal
+  (dp, tp, pp, V, M, schedule, zero, dtype) space with a composed
+  static cost model, then trace-verify the winner through the full
+  pass stack under the ``planner-contract`` tolerance.
 * ``ServingEngine(check_invariants=True)`` — per-tick paged-KV
   invariant checking (race-detector-style debug mode).
 * ``audit_engine(engine)`` — standalone audit of a live engine;
@@ -21,7 +26,8 @@ See docs/ANALYSIS.md for each pass's invariant and how to add one.
 """
 from .collectives import (CollectiveConsistencyPass,
                           check_stage_consistency,
-                          collective_signature, scan_trip_counts)
+                          collective_cost_bytes, collective_signature,
+                          scan_trip_counts)
 from .donation import DonationAuditPass, jit_donation_flags
 from .dtype_drift import DtypeDriftPass
 from .framework import (ExactnessContract, Finding, GraphTarget,
@@ -31,11 +37,15 @@ from .framework import (ExactnessContract, Finding, GraphTarget,
                         register_pass, register_rewrite, run_passes,
                         trace_graph)
 from .hbm import (HbmEstimate, HbmPeakPass, estimate_hbm_peak,
-                  xla_peak_bytes)
+                  xla_cost_analysis, xla_peak_bytes)
 from .host_sync import HostSyncPass
 from .kv_invariants import (KVInvariantError, Violation,
                             audit_defrag_plan, audit_engine,
                             audit_serving_state)
+from .planner import (CostModel, PlanCost, PlanPoint,
+                      PlannerContractPass, enumerate_plan_points,
+                      plan_auto_parallel, price_plan_point,
+                      verify_plan)
 from .recompile import (RecompileHazardPass, ServingGeometry,
                         enumerate_chunk_programs,
                         enumerate_tick_programs)
@@ -47,30 +57,36 @@ from .serving_graphs import (engine_geometry, pp_stage_targets,
                              rewrite_targets, serving_targets)
 from .sharding_lint import (ShardingLintPass, audit_engine_plan,
                             spec_shard_factor)
-from .training_graphs import (TRAIN_GEOMETRIES, flagship_train_objects,
+from .training_graphs import (TRAIN_GEOMETRIES, build_train_target,
+                              flagship_train_objects,
                               train_stage_targets, train_step_target,
                               training_targets)
 
 __all__ = [
-    "CollectiveConsistencyPass", "DonationAuditPass", "DtypeDriftPass",
+    "CollectiveConsistencyPass", "CostModel", "DonationAuditPass",
+    "DtypeDriftPass",
     "ExactnessContract", "Finding", "FusedRmsNormPass", "GraphTarget",
     "HbmEstimate", "HbmPeakPass", "HostSyncPass",
     "Int8EpilogueFusePass", "KVInvariantError", "LintPass",
-    "LintReport", "PASS_REGISTRY", "REWRITE_REGISTRY",
+    "LintReport", "PASS_REGISTRY", "PlanCost", "PlanPoint",
+    "PlannerContractPass", "REWRITE_REGISTRY",
     "RecompileHazardPass", "RewritePass", "RewriteResult",
     "ServingGeometry", "Severity", "ShardingLintPass",
     "TRAIN_GEOMETRIES", "VerifyOutcome", "Violation",
     "audit_defrag_plan", "audit_engine", "audit_engine_plan",
-    "audit_serving_state", "check_stage_consistency",
+    "audit_serving_state", "build_train_target",
+    "check_stage_consistency", "collective_cost_bytes",
     "collective_signature", "count_matches", "default_passes",
     "default_rewrites", "engine_geometry", "enumerate_chunk_programs",
-    "enumerate_tick_programs", "estimate_hbm_peak",
-    "flagship_train_objects",
-    "jit_donation_flags", "pp_stage_targets", "register_pass",
+    "enumerate_plan_points", "enumerate_tick_programs",
+    "estimate_hbm_peak", "flagship_train_objects",
+    "jit_donation_flags", "plan_auto_parallel", "pp_stage_targets",
+    "price_plan_point", "register_pass",
     "register_rewrite", "rewrite_callable", "rewrite_jaxpr",
     "rewrite_target", "rewrite_targets", "run_passes",
     "run_rewrite_suite", "scan_trip_counts", "serving_targets",
     "spec_shard_factor", "trace_graph", "train_stage_targets",
-    "train_step_target", "training_targets", "verify_rewrite",
-    "verify_site", "xla_peak_bytes",
+    "train_step_target", "training_targets", "verify_plan",
+    "verify_rewrite", "verify_site", "xla_cost_analysis",
+    "xla_peak_bytes",
 ]

@@ -31,7 +31,7 @@ from .framework import (Finding, GraphTarget, LintPass, Severity,
 
 __all__ = ["COLLECTIVE_PRIMS", "collective_signature",
            "CollectiveConsistencyPass", "check_stage_consistency",
-           "scan_trip_counts"]
+           "collective_cost_bytes", "scan_trip_counts"]
 
 COLLECTIVE_PRIMS = {
     "psum", "psum2", "pmax", "pmin", "pmean", "ppermute", "pbroadcast",
@@ -97,6 +97,56 @@ def collective_signature(jaxpr, include_loops: bool = False
         return sig
 
     return walk(jaxpr, ())
+
+
+#: wire-traffic weight per collective primitive: how many times the
+#: payload crosses a link relative to its size (ring all-reduce moves
+#: ~2(n-1)/n ≈ 2 payloads, a permute moves 1, gather/scatter families
+#: ~1). Deliberately topology-free — the planner's comms term is a
+#: RANKING proxy, not a wall-clock model.
+_COLLECTIVE_WIRE_FACTOR = {
+    "psum": 2.0, "psum2": 2.0, "pmax": 2.0, "pmin": 2.0, "pmean": 2.0,
+    "ppermute": 1.0, "pbroadcast": 1.0, "all_gather": 1.0,
+    "all_to_all": 1.0, "reduce_scatter": 1.0, "psum_scatter": 1.0,
+    "pgather": 1.0, "pshuffle": 1.0,
+}
+
+
+def collective_cost_bytes(jaxpr) -> int:
+    """Wire bytes the program's EXPLICIT collectives move, scan trip
+    counts included: each collective contributes (output bytes) x
+    (enclosing scan trips) x (per-prim wire factor). This prices what
+    the trace can see — shard_map programs (the async pipeline
+    schedules' per-tick ppermute pair) and manual psums; collectives
+    GSPMD inserts at compile time are invisible here and the planner
+    adds them analytically from the declared specs. A ``while`` body
+    has no static trip count, so its collectives count once (a lower
+    bound, stated rather than guessed). One number per graph so the
+    planner's comms term and a test can pin it."""
+    from ..core.graph_trace import sub_jaxprs
+    from jax._src import core as jax_core
+    from .framework import aval_nbytes
+
+    total = 0.0
+
+    def walk(j, mult: int):
+        nonlocal total
+        if isinstance(j, jax_core.ClosedJaxpr):
+            j = j.jaxpr
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if name in COLLECTIVE_PRIMS:
+                out_b = sum(aval_nbytes(o.aval) for o in eqn.outvars)
+                total += (out_b * mult
+                          * _COLLECTIVE_WIRE_FACTOR.get(name, 1.0))
+            for _label, sub in sub_jaxprs(eqn):
+                trips = (eqn.params.get("length") if name == "scan"
+                         else None)
+                walk(sub, mult * int(trips) if trips is not None
+                     else mult)
+
+    walk(jaxpr, 1)
+    return int(total)
 
 
 def scan_trip_counts(jaxpr) -> List[int]:

@@ -34,18 +34,9 @@ from typing import List, Optional, Sequence
 import numpy as np
 
 from .framework import (Finding, GraphTarget, LintPass, Severity,
-                        register_pass)
+                        aval_nbytes as _nbytes, register_pass)
 
 __all__ = ["DonationAuditPass", "jit_donation_flags"]
-
-
-def _nbytes(aval) -> int:
-    shape = getattr(aval, "shape", None)
-    dtype = getattr(aval, "dtype", None)
-    if dtype is None:
-        return 0
-    n = int(np.prod(shape)) if shape else 1
-    return n * np.dtype(dtype).itemsize
 
 
 @register_pass

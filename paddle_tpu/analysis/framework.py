@@ -25,7 +25,21 @@ __all__ = ["Severity", "Finding", "GraphTarget", "LintPass",
            "LintReport", "PASS_REGISTRY", "register_pass",
            "default_passes", "run_passes", "trace_graph",
            "ExactnessContract", "RewritePass", "REWRITE_REGISTRY",
-           "register_rewrite", "default_rewrites"]
+           "register_rewrite", "default_rewrites", "aval_nbytes"]
+
+
+def aval_nbytes(aval) -> int:
+    """Bytes of one abstract value (0 for token/effect avals without a
+    dtype) — the ONE byte-accounting helper every pass uses (hbm peak,
+    donation audit, sharding lint, planner cost model), so the passes
+    cannot disagree on what a buffer weighs."""
+    import numpy as np
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if dtype is None:
+        return 0
+    n = int(np.prod(shape)) if shape else 1
+    return n * np.dtype(dtype).itemsize
 
 #: name -> LintPass subclass; every pass registers itself here so the
 #: CLI (tools/graph_lint.py) and the tests build the same pass set —

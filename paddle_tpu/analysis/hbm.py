@@ -36,23 +36,13 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
-import numpy as np
-
 from ..core.graph_trace import sub_jaxprs as _sub_jaxprs
-from .framework import (GraphTarget, LintPass, Severity, register_pass)
+from .framework import (GraphTarget, LintPass, Severity,
+                        aval_nbytes as _nbytes, register_pass)
 from .sharding_lint import spec_shard_factor
 
 __all__ = ["HbmEstimate", "estimate_hbm_peak", "HbmPeakPass",
            "xla_cost_analysis", "xla_peak_bytes"]
-
-
-def _nbytes(aval) -> int:
-    shape = getattr(aval, "shape", None)
-    dtype = getattr(aval, "dtype", None)
-    if dtype is None:
-        return 0
-    n = int(np.prod(shape)) if shape else 1
-    return n * np.dtype(dtype).itemsize
 
 
 @dataclass

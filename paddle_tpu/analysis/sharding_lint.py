@@ -37,11 +37,9 @@ from __future__ import annotations
 
 from typing import List
 
-import numpy as np
-
 from ..core.graph_trace import iter_jaxpr_eqns
 from .framework import (Finding, GraphTarget, LintPass, Severity,
-                        register_pass)
+                        aval_nbytes as _nbytes, register_pass)
 
 __all__ = ["ShardingLintPass", "audit_engine_plan", "spec_shard_factor"]
 
@@ -64,15 +62,6 @@ def spec_shard_factor(spec, mesh_axes) -> int:
     for ax in _spec_axes(spec):
         f *= int(mesh_axes.get(ax, 1))
     return f
-
-
-def _nbytes(aval) -> int:
-    shape = getattr(aval, "shape", None)
-    dtype = getattr(aval, "dtype", None)
-    if dtype is None:
-        return 0
-    n = int(np.prod(shape)) if shape else 1
-    return n * np.dtype(dtype).itemsize
 
 
 @register_pass

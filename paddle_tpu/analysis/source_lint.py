@@ -31,6 +31,16 @@ lints source, with ruff layered on top when available:
   the 100k-token ceiling cannot silently regress: a new kernel (or an
   edit to the tiled one) that re-introduces O(pages_per_slot) scratch
   fails ``graph_lint --ci`` at the source level.
+* **serving hot-path host sync** (PT005) — *serving code only*
+  (``paddle_tpu/serving/``): the idioms that silently serialize the
+  tick loop on a device→host round-trip — ``.item()`` on anything,
+  and bare single-argument ``np.asarray(x)`` / ``np.array(x)`` (the
+  device-pull shape: converting a host container passes a dtype,
+  pulling a tick result does not). The engine's sanctioned pull sites — THE per-tick token
+  read-back, which must sync by design — carry
+  ``# noqa: PT005`` with a justification; everything else in the
+  serving tree is a hot path where an extra sync is the
+  [S,V]-logits-pull bug class all over again.
 * **host-sync** (PT001/PT002/PT003) — *library code only*
   (``paddle_tpu/``; tools and tests, which legitimately pull results
   to the host, are exempt): the source-level companion of the
@@ -125,12 +135,14 @@ def _noqa_map(src: str):
 
 def lint_file(path: Path, src: str = None,
               host_sync_scope: bool = False,
-              pallas_scope: bool = False) -> List[Tuple]:
+              pallas_scope: bool = False,
+              serving_scope: bool = False) -> List[Tuple]:
     """[(rule, lineno, message)] for one file. ``# noqa`` (optionally
     ``# noqa: F401,E711``) on the statement's first line suppresses.
     ``host_sync_scope=True`` (library code under ``paddle_tpu/``)
     additionally runs the PT00x host-sync rules; ``pallas_scope=True``
-    (``ops/pallas/``) the PT004 VMEM-scratch rule."""
+    (``ops/pallas/``) the PT004 VMEM-scratch rule; ``serving_scope=True``
+    (``paddle_tpu/serving/``) the PT005 hot-path host-sync rule."""
     if src is None:
         src = Path(path).read_text()
     try:
@@ -241,6 +253,39 @@ def lint_file(path: Path, src: str = None,
                     "tiled flash combine) or noqa the explicitly "
                     "one-shot path with a justification"))
 
+    # ---- serving hot-path host syncs (PT005) ------------------------
+    if serving_scope:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "item"
+                    and not node.args and not node.keywords):
+                if not suppressed("PT005", node.lineno):
+                    findings.append((
+                        "PT005", node.lineno,
+                        "`.item()` in serving hot-path code — a "
+                        "blocking per-value device→host pull; batch "
+                        "the read-back (one np.asarray at the "
+                        "sanctioned pull site) or keep the value "
+                        "device-side"))
+            elif (isinstance(f, ast.Attribute)
+                  and f.attr in ("asarray", "array")
+                  and isinstance(f.value, ast.Name)
+                  and f.value.id in ("np", "numpy")
+                  and len(node.args) == 1 and not node.keywords):
+                # a dtype argument marks a host-container conversion;
+                # the bare single-arg form is the device-pull shape
+                if not suppressed("PT005", node.lineno):
+                    findings.append((
+                        "PT005", node.lineno,
+                        f"bare `np.{f.attr}(...)` in serving hot-path "
+                        "code — if the argument is a device value "
+                        "this is a blocking sync; pull once at the "
+                        "sanctioned site (# noqa: PT005 with a "
+                        "justification) or pass a dtype if this "
+                        "converts a host container"))
+
     # ---- host syncs in library code (PT001/PT002/PT003) -------------
     if host_sync_scope:
         def _jax_rooted(expr) -> bool:
@@ -333,6 +378,7 @@ def lint_tree(root: Path, subdirs=("paddle_tpu", "tools")
                 continue
             for rule, line, msg in lint_file(
                     p, host_sync_scope=(sub == "paddle_tpu"),
-                    pallas_scope=("pallas" in p.parts)):
+                    pallas_scope=("pallas" in p.parts),
+                    serving_scope=("serving" in p.parts)):
                 out.append((str(p.relative_to(root)), rule, line, msg))
     return out

@@ -24,8 +24,8 @@ from typing import Dict, List, Optional
 from .framework import GraphTarget
 
 __all__ = ["TRAIN_GEOMETRIES", "training_targets", "train_step_target",
-           "train_stage_targets", "flagship_train_objects",
-           "schedule_inventory"]
+           "build_train_target", "train_stage_targets",
+           "flagship_train_objects", "schedule_inventory"]
 
 #: name -> mesh degrees + schedule knobs. The acceptance geometries:
 #: plain dp, dp x mp(tp), pp (lockstep 1F1B + interleaved VPP),
@@ -110,7 +110,25 @@ def train_step_target(geometry: str = "dp", *,
                       seq_len: int = 8, dtype=None,
                       hbm_budget_bytes: Optional[int] = None
                       ) -> GraphTarget:
-    """One geometry's train-step GraphTarget (abstract, zero compiles)."""
+    """One flagship geometry's train-step GraphTarget (abstract, zero
+    compiles)."""
+    return build_train_target(
+        TRAIN_GEOMETRIES[geometry], geometry,
+        batch_size=batch_size, seq_len=seq_len, dtype=dtype,
+        hbm_budget_bytes=hbm_budget_bytes)
+
+
+def build_train_target(g: Dict, geometry: str, *,
+                       batch_size: Optional[int] = None,
+                       seq_len: int = 8, dtype=None, cfg=None,
+                       hbm_budget_bytes: Optional[int] = None
+                       ) -> GraphTarget:
+    """Trace ``make_train_step`` at an ARBITRARY geometry dict (same
+    keys as ``TRAIN_GEOMETRIES`` entries) — the builder behind
+    :func:`train_step_target`, exported separately so the auto-parallel
+    planner (analysis/planner.py) can price and verify search points
+    that are not in the flagship set. ``cfg`` overrides the tiny model
+    config (the planner passes the user's model)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -119,8 +137,8 @@ def train_step_target(geometry: str = "dp", *,
     from ..parallel.mesh import init_hybrid_mesh
     from ..parallel.pipeline_1f1b import schedule_ticks
 
-    g = TRAIN_GEOMETRIES[geometry]
-    cfg = _train_cfg(g, dtype)
+    if cfg is None:
+        cfg = _train_cfg(g, dtype)
     hm = init_hybrid_mesh(dp=g["dp"], pp=g["pp"], tp=g["tp"],
                           set_global=False)
     mesh = hm.mesh

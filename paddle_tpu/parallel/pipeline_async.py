@@ -87,6 +87,104 @@ PP_SCHEDULES = {
 
 
 @dataclasses.dataclass(frozen=True)
+class ScheduleInfo:
+    """Queryable metadata for one ``pp_schedule`` value — the legality
+    constraints and cost facts that used to live as raise-sites inside
+    the builders and prose inside docstrings. The auto-parallel planner
+    (analysis/planner.py) enumerates its search space from this table;
+    ``schedule_legality`` below is derived from the same fields the
+    executors enforce, so a constraint added to one cannot silently
+    miss the other.
+
+    ``work_units_per_mb_stage``: relative compute units one microbatch
+    costs one stage (F=1, fused backward=3). The zb variant's B/W split
+    re-runs the stage forward inside each ``jax.vjp`` — 5 units vs 4
+    (docs/PERF.md r14) — which the planner prices as a flop multiplier.
+    ``lockstep_masked_work``: the schedule executes every slot every
+    tick, so (1 - efficiency) is REAL extra compute, not idle time.
+    """
+    name: str                   # LlamaConfig.pp_schedule value
+    model: str                  # schedule_ticks/schedule_efficiency name
+    executor: Optional[str]     # pipeline_async variant; None = lockstep
+    requires_dp1_tp1: bool      # shard_map stage body is single-device
+    supports_vpp: bool          # virtual_chunks > 1 allowed
+    vpp_needs_divisible_M: bool  # V>1 requires M % S == 0
+    min_stages: int
+    work_units_per_mb_stage: int
+    lockstep_masked_work: bool
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+#: pp_schedule name -> ScheduleInfo. Consistent with PP_SCHEDULES by
+#: construction (asserted at import below).
+SCHEDULE_INFO: Dict[str, ScheduleInfo] = {
+    "1f1b": ScheduleInfo(
+        name="1f1b", model="lockstep", executor=None,
+        requires_dp1_tp1=False, supports_vpp=True,
+        vpp_needs_divisible_M=False, min_stages=1,
+        work_units_per_mb_stage=4, lockstep_masked_work=True),
+    "1f1b_async": ScheduleInfo(
+        name="1f1b_async", model="1f1b", executor="1f1b",
+        requires_dp1_tp1=True, supports_vpp=True,
+        vpp_needs_divisible_M=True, min_stages=2,
+        work_units_per_mb_stage=4, lockstep_masked_work=False),
+    "zb": ScheduleInfo(
+        name="zb", model="zb", executor="zb",
+        requires_dp1_tp1=True, supports_vpp=False,
+        vpp_needs_divisible_M=True, min_stages=2,
+        work_units_per_mb_stage=5, lockstep_masked_work=False),
+}
+assert set(SCHEDULE_INFO) == set(PP_SCHEDULES) and all(
+    (i.model, i.executor) == PP_SCHEDULES[n]
+    for n, i in SCHEDULE_INFO.items())
+
+#: executor variant -> pp_schedule name (build_schedule speaks variant)
+_VARIANT_TO_SCHEDULE = {v: n for n, (_, v) in PP_SCHEDULES.items()
+                        if v is not None}
+
+
+def schedule_legality(name: str, *, num_stages: int,
+                      num_microbatches: int, virtual_chunks: int = 1,
+                      dp: int = 1, tp: int = 1) -> Optional[str]:
+    """None when ``(schedule, geometry)`` is legal, else the reason it
+    is not — the ONE statement of schedule legality. ``build_schedule``
+    raises exactly these reasons for its subset (asserted by the
+    rejection tests), ``pipeline_train_async`` enforces the mesh-axis
+    restriction at run time, and the planner prunes its search space
+    with the same answers, so legality cannot drift between the three.
+    """
+    info = SCHEDULE_INFO.get(name)
+    if info is None:
+        return (f"variant must be one of {tuple(SCHEDULE_INFO)}, "
+                f"got {name!r}")
+    S, M, V = int(num_stages), int(num_microbatches), int(virtual_chunks)
+    if M < 1 or V < 1:
+        return "need num_microbatches >= 1, virtual_chunks >= 1"
+    if S < info.min_stages:
+        if info.min_stages >= 2:
+            return ("rank-asymmetric schedules need num_stages >= 2 "
+                    "(pp=1 has no pipeline bubble — use the plain or "
+                    "lockstep path)")
+        return f"need num_stages >= {info.min_stages}"
+    if V > 1 and not info.supports_vpp:
+        return ("zb W-deferral with virtual_chunks > 1 (ZB-V-style "
+                "schedules) is not supported — the reference's "
+                "pipeline_zero_bubble.py ZB-H1 is V=1 too; use "
+                "variant='1f1b' for interleaved VPP")
+    if V > 1 and info.vpp_needs_divisible_M and M % S:
+        return (f"interleaved V>1 needs num_microbatches divisible by "
+                f"num_stages (the reference's VPP constraint), got "
+                f"M={M} S={S}")
+    if info.requires_dp1_tp1 and (int(dp) > 1 or int(tp) > 1):
+        return (f"schedule {name!r} currently requires every non-pp "
+                f"mesh axis to be size 1 (the shard_map stage body is "
+                f"a single-device program); got dp={dp} tp={tp}")
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
 class Schedule:
     """One built rank-asymmetric schedule: the static op/routing tables
     the traced executor consumes, plus the bookkeeping tests pin.
@@ -421,23 +519,14 @@ def build_schedule(num_stages: int, num_microbatches: int,
     if variant not in VARIANTS:
         raise ValueError(f"variant must be one of {VARIANTS}, "
                          f"got {variant!r}")
-    if S < 2:
-        raise ValueError("rank-asymmetric schedules need num_stages >= 2"
-                         " (pp=1 has no pipeline bubble — use the plain"
-                         " or lockstep path)")
-    if M < 1 or V < 1:
-        raise ValueError("need num_microbatches >= 1, virtual_chunks >= 1")
-    if V > 1 and variant == "zb":
-        raise ValueError(
-            "zb W-deferral with virtual_chunks > 1 (ZB-V-style "
-            "schedules) is not supported — the reference's "
-            "pipeline_zero_bubble.py ZB-H1 is V=1 too; use "
-            "variant='1f1b' for interleaved VPP")
-    if V > 1 and M % S:
-        raise ValueError(
-            f"interleaved V>1 needs num_microbatches divisible by "
-            f"num_stages (the reference's VPP constraint), got "
-            f"M={M} S={S}")
+    # legality lives in ONE queryable table (schedule_legality /
+    # SCHEDULE_INFO) shared with the planner's search-space pruning;
+    # the builder raises exactly its reasons
+    reason = schedule_legality(
+        _VARIANT_TO_SCHEDULE[variant], num_stages=S,
+        num_microbatches=M, virtual_chunks=V)
+    if reason is not None:
+        raise ValueError(reason)
     zb = variant == "zb"
     if V > 1:
         grid = _fixed_order_schedule(S, M, V)

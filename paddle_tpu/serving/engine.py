@@ -1183,8 +1183,8 @@ class ServingEngine:
                                    self._kp, self._vp, tq=tq,
                                    decode_tail=0, spec_k=spec)
                 # [S, 1+spec_k] i32 + [S] i32 — the eager pulls
-                toks = np.asarray(toks_d)
-                accept = np.asarray(accept_d)
+                toks = np.asarray(toks_d)      # noqa: PT005 - THE sanctioned per-tick verify read-back
+                accept = np.asarray(accept_d)  # noqa: PT005 - rides the same sync
             else:
                 toks_d, _logits_d, self._kp, self._vp = self._tick_jit(
                     self._params, jnp.asarray(tok), meta, self._kp,
@@ -1192,7 +1192,7 @@ class ServingEngine:
                 # [S] (tail=0) or [S, 1+tail] i32 — the only eager
                 # pull: sampling happens IN-GRAPH (r16), so no [S, V]
                 # logits row ever crosses to the host
-                toks = np.asarray(toks_d)
+                toks = np.asarray(toks_d)  # noqa: PT005 - THE sanctioned per-tick token read-back
         m1 = time.monotonic()
         if toks.ndim == 1:
             toks = toks[:, None]
@@ -1273,7 +1273,7 @@ class ServingEngine:
                 jnp.asarray(self.scheduler.tables), self._kp,
                 self._vp, num_steps=k,
                 sampling=self._sampling_arrays())
-            toks = np.asarray(toks)        # [S, k] i32 tokens
+            toks = np.asarray(toks)  # noqa: PT005 - sanctioned per-block token read-back ([S, k] i32)
         self.metrics.inc("decode_steps", k)
         self.metrics.observe("decode_step_s",
                              (time.perf_counter() - t0) / k)

@@ -66,7 +66,7 @@ class Histogram:
             return {"count": 0, "mean": 0.0, "window_count": 0,
                     "window_mean": 0.0, "p50": 0.0, "p99": 0.0,
                     "max": 0.0}
-        a = np.asarray(self._vals)
+        a = np.asarray(self._vals, np.float64)  # host deque, no sync
         return {"count": self._count,
                 "mean": self._sum / self._count,
                 "window_count": int(a.size),

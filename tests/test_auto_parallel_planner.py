@@ -1,0 +1,277 @@
+"""Auto-parallel planner (ISSUE 13, ROADMAP item 4): search, rank,
+trace-verify.
+
+The CI wiring the issue asks for: ``tools/auto_parallel.py --smoke``
+runs as a subprocess (the real CLI entry, own 2x2 virtual mesh) and
+its JSON is asserted — non-empty ranked plan, >= 20 legal
+configurations, winner trace-verified under the planner contract.
+Everything else is in-process: enumeration legality is pinned against
+the schedule builder (the no-drift contract), and the contract pass is
+MUTATION-tested — a corrupted HBM prediction and a corrupted tick
+count must each fail verification (the vacuous-pass lesson: detection
+is proven, not assumed). The xla_cost_analysis/xla_peak_bytes
+normalizer coverage (satellite) lives here too: finite counters for a
+compiled train step on the CPU backend, graceful degradation (empty
+dict / None, never a crash) when a backend omits the introspection.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.analysis import (PlanPoint, Severity,
+                                 enumerate_plan_points,
+                                 estimate_hbm_peak, verify_plan,
+                                 xla_cost_analysis, xla_peak_bytes)
+from paddle_tpu.analysis.planner import (point_config,
+                                         reference_step_costs)
+from paddle_tpu.analysis.training_graphs import build_train_target
+from paddle_tpu.models import llama as L
+from paddle_tpu.parallel.pipeline_1f1b import schedule_ticks
+from paddle_tpu.parallel.pipeline_async import (SCHEDULE_INFO,
+                                                build_schedule,
+                                                schedule_legality)
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+CFG = L.LlamaConfig.tiny()
+
+
+# ---------------------------------------------------------------------------
+# the CLI smoke: one subprocess run, several assertions on its JSON
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def smoke_plan():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "auto_parallel.py"),
+         "--smoke", "--json"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=420)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    return json.loads(proc.stdout)
+
+
+def test_smoke_ranks_nonempty_plan(smoke_plan):
+    out = smoke_plan
+    assert out["schema"] == "paddle_tpu.auto_parallel_plan/1"
+    assert out["legal"] >= 20, "flagship smoke space collapsed"
+    assert out["priced"] >= 20
+    assert out["plans"], "ranked plan is empty"
+    # ranking is by the step-time proxy among fitting plans
+    times = [p["cost"]["step_time_proxy_s"] for p in out["plans"]]
+    assert times == sorted(times)
+    assert all(p["cost"]["fits"] for p in out["plans"])
+    # the pruned space is auditable: the current dp=tp=1 restriction
+    # on the async schedules must show up as a counted reason
+    assert any("1f1b_async" in r for r in out["pruned"]), out["pruned"]
+
+
+def test_smoke_winner_trace_verifies(smoke_plan):
+    ver = smoke_plan["verification"]
+    assert ver["ok"], ver
+    d = ver["deltas"]
+    # predicted HBM peak within the contract tolerance of the traced
+    # HbmPeakPass estimate (the acceptance bar is ±15%)
+    assert abs(d["hbm_rel_delta"]) <= ver["tolerance"] <= 0.15
+    assert d["traced_hbm_peak_bytes"] > 0
+    # deltas ride the shared Finding JSON schema
+    findings = ver["report"]["findings"]
+    assert any(f["pass"] == "planner-contract" for f in findings)
+    assert {"pass", "severity", "graph", "message"} <= set(findings[0])
+    # zero sharding/donation findings at error severity on the winner
+    assert not [f for f in findings
+                if f["severity"] == "error"
+                and f["pass"] in ("sharding-lint", "donation-audit")]
+
+
+# ---------------------------------------------------------------------------
+# enumeration: legality matches the executors (no-drift contract)
+# ---------------------------------------------------------------------------
+
+def test_enumeration_points_are_legal():
+    points, pruned = enumerate_plan_points(4, CFG, batch_size=16)
+    assert len(points) >= 20
+    for p in points:
+        assert p.dp * p.tp * p.pp == 4
+        assert CFG.num_hidden_layers % (p.pp * p.vpp) == 0
+        assert 16 % p.microbatches == 0
+        assert (16 // p.microbatches) % p.dp == 0
+        if p.zero_stage >= 1:
+            assert p.dp > 1
+        if p.pp > 1:
+            assert schedule_legality(
+                p.schedule, num_stages=p.pp,
+                num_microbatches=p.microbatches,
+                virtual_chunks=p.vpp, dp=p.dp, tp=p.tp) is None
+        else:
+            assert (p.schedule, p.vpp, p.microbatches) == ("none", 1, 1)
+    # the known-illegal classes are counted, not silently skipped
+    assert pruned.get("zero-needs-dp>1")
+    assert any(r.startswith("schedule[") for r in pruned)
+
+
+def test_schedule_legality_matches_builder():
+    """The queryable table and the builder must agree point for point —
+    a constraint added to one without the other fails here."""
+    for S in (2, 3, 4):
+        for M in (1, 2, 4, 5, 8):
+            for V in (1, 2):
+                for name in ("1f1b_async", "zb"):
+                    variant = SCHEDULE_INFO[name].executor
+                    reason = schedule_legality(
+                        name, num_stages=S, num_microbatches=M,
+                        virtual_chunks=V)
+                    try:
+                        build_schedule(S, M, V, variant)
+                        built = True
+                    except ValueError:
+                        built = False
+                    assert built == (reason is None), (
+                        f"{name} S={S} M={M} V={V}: builder "
+                        f"{'accepts' if built else 'rejects'} but "
+                        f"legality says {reason!r}")
+
+
+def test_schedule_legality_dp_tp_restriction():
+    assert schedule_legality("1f1b_async", num_stages=2,
+                             num_microbatches=4, dp=2) is not None
+    assert schedule_legality("zb", num_stages=2,
+                             num_microbatches=4, tp=2) is not None
+    assert schedule_legality("1f1b", num_stages=2,
+                             num_microbatches=4, dp=2, tp=2) is None
+
+
+# ---------------------------------------------------------------------------
+# the planner contract is a real check: corrupted predictions fail
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def pp_point_and_target():
+    pt = PlanPoint(dp=1, tp=1, pp=2, vpp=1, microbatches=4,
+                   schedule="1f1b", zero_stage=0, dtype="bfloat16")
+    tgt = build_train_target(
+        pt.geometry(), f"planner.winner[{pt.label()}]",
+        batch_size=8, seq_len=8, cfg=point_config(CFG, pt))
+    return pt, tgt
+
+
+def _verify_with(pt, tgt, prediction):
+    cache = {(pt, 8, 8): tgt}
+    return verify_plan(pt, CFG, batch_size=8, seq_len=8,
+                       hbm_budget_bytes=None, prediction=prediction,
+                       trace_cache=cache)
+
+
+def test_contract_accepts_honest_prediction(pp_point_and_target):
+    pt, tgt = pp_point_and_target
+    peak = estimate_hbm_peak(tgt).peak_bytes
+    ticks = schedule_ticks(2, 4, 1, schedule="lockstep")
+    ver = _verify_with(pt, tgt, {"hbm_peak_bytes": peak,
+                                 "ticks": ticks})
+    assert ver["ok"], ver["report"]
+    assert ver["deltas"]["hbm_rel_delta"] == 0.0
+    assert ver["deltas"]["predicted_ticks"] == ticks
+
+
+def test_contract_catches_bad_hbm_prediction(pp_point_and_target):
+    pt, tgt = pp_point_and_target
+    peak = estimate_hbm_peak(tgt).peak_bytes
+    ver = _verify_with(pt, tgt, {"hbm_peak_bytes": 2 * peak})
+    assert not ver["ok"]
+    errs = [f for f in ver["report"]["findings"]
+            if f["severity"] == Severity.ERROR
+            and f["pass"] == "planner-contract"]
+    assert errs and "untrustworthy" in errs[0]["message"]
+
+
+def test_contract_catches_bad_tick_prediction(pp_point_and_target):
+    pt, tgt = pp_point_and_target
+    peak = estimate_hbm_peak(tgt).peak_bytes
+    ticks = schedule_ticks(2, 4, 1, schedule="lockstep")
+    ver = _verify_with(pt, tgt, {"hbm_peak_bytes": peak,
+                                 "ticks": ticks + 3})
+    assert not ver["ok"]
+    assert any("not the schedule that runs" in f["message"]
+               for f in ver["report"]["findings"])
+
+
+# ---------------------------------------------------------------------------
+# xla_cost_analysis / xla_peak_bytes coverage (satellite)
+# ---------------------------------------------------------------------------
+
+def test_xla_cost_analysis_finite_for_jitted_train_step():
+    """The CPU backend exposes the counters the step-time proxy reads:
+    finite positive flops/bytes for a compiled tiny train step."""
+    ref = reference_step_costs(CFG, "bfloat16", seq_len=8)
+    assert ref["source"] == "xla_cost_analysis"
+    assert np.isfinite(ref["flops_per_row"]) and ref["flops_per_row"] > 0
+    assert np.isfinite(ref["bytes_per_row"]) and ref["bytes_per_row"] > 0
+
+
+def test_xla_cost_analysis_normalizes_versions_and_degrades():
+    """List-of-dicts (current jax), plain dict (older), None, and a
+    raising backend all normalize without version branches — and
+    without crashing (the degrade-to-None satellite)."""
+    class ListCA:
+        def cost_analysis(self):
+            return [{"flops": 7.0}]
+
+    class DictCA:
+        def cost_analysis(self):
+            return {"flops": 7.0}
+
+    class NoneCA:
+        def cost_analysis(self):
+            return None
+
+    class RaisingCA:
+        def cost_analysis(self):
+            raise NotImplementedError("backend omits cost analysis")
+
+    assert xla_cost_analysis(ListCA()) == {"flops": 7.0}
+    assert xla_cost_analysis(DictCA()) == {"flops": 7.0}
+    assert xla_cost_analysis(NoneCA()) == {}
+    assert xla_cost_analysis(RaisingCA()) == {}
+    assert xla_cost_analysis(object()) == {}  # no method at all
+
+
+def test_xla_peak_bytes_real_and_degraded():
+    c = jax.jit(lambda x: (x @ x).sum()).lower(
+        jnp.zeros((64, 64), jnp.float32)).compile()
+    pb = xla_peak_bytes(c)
+    assert pb is None or pb > 0  # CPU exposes it on current jax
+
+    class NoMA:
+        def memory_analysis(self):
+            raise NotImplementedError
+
+    class PartialMA:
+        # backend returns an object missing the size fields
+        def memory_analysis(self):
+            return object()
+
+    assert xla_peak_bytes(NoMA()) is None
+    assert xla_peak_bytes(PartialMA()) is None
+    assert xla_peak_bytes(object()) is None
+
+
+def test_reference_costs_analytic_fallback():
+    """A dtype whose compile path dies degrades to the closed-form
+    transformer estimate instead of crashing the whole plan."""
+    import paddle_tpu.analysis.planner as P
+    from paddle_tpu.analysis import hbm as H
+    real = H.xla_cost_analysis
+    try:
+        H.xla_cost_analysis = lambda compiled: {}
+        ref = P.reference_step_costs(CFG, "bfloat16", seq_len=8)
+    finally:
+        H.xla_cost_analysis = real
+    assert ref["source"] == "analytic-fallback"
+    assert np.isfinite(ref["flops_per_row"]) and ref["flops_per_row"] > 0
+    assert np.isfinite(ref["bytes_per_row"]) and ref["bytes_per_row"] > 0

@@ -450,6 +450,42 @@ def test_source_lint_pt004_table_width_vmem_scratch():
                 if r == "PT004"]
 
 
+def test_source_lint_pt005_serving_host_sync():
+    """PT005 (ISSUE 13 satellite): host-sync idioms inside the serving
+    hot paths flag — `.item()` and the bare single-arg `np.asarray`
+    device-pull shape — while dtype'd container conversions, noqa'd
+    sanctioned pull sites, and non-serving scope stay clean."""
+    from paddle_tpu.analysis.source_lint import lint_file
+    src = (
+        "import numpy as np\n\n\n"
+        "def tick(toks_d, host_list):\n"
+        "    n = toks_d.sum().item()\n"
+        "    toks = np.asarray(toks_d)\n"
+        "    also = np.array(toks_d)\n"
+        "    ok = np.asarray(host_list, np.int32)\n"
+        "    ok2 = np.array(host_list, np.int32)\n"
+        "    return n, toks, also, ok, ok2\n"
+    )
+    hits = [r for r, _, _ in lint_file("fake.py", src=src,
+                                       serving_scope=True)
+            if r == "PT005"]
+    assert hits == ["PT005"] * 3  # dtype'd conversions did not flag
+    assert not [r for r, _, _ in lint_file("fake.py", src=src)
+                if r == "PT005"]       # non-serving scope: rule off
+    noqa = (
+        "import numpy as np\n\n\n"
+        "def tick(toks_d):\n"
+        "    return np.asarray(toks_d)"
+        "  # noqa: PT005 - the sanctioned pull\n"
+    )
+    assert not [r for r, _, _ in lint_file("fake.py", src=noqa,
+                                           serving_scope=True)
+                if r == "PT005"]
+    # the live serving tree staying clean (engine read-backs noqa'd
+    # with justifications) is covered by
+    # test_library_tree_is_clean_of_host_syncs below
+
+
 def test_source_lint_conservative_on_locals():
     # coercions of locals it cannot prove jax-rooted do not flag
     from paddle_tpu.analysis.source_lint import lint_file

@@ -34,7 +34,11 @@ runs, in seconds and with zero XLA compiles:
   * (--ci) the AST source lint over paddle_tpu/ + tools/
     (analysis/source_lint.py), plus `ruff check` when the binary is
     installed (the container image does not ship it; the AST subset
-    always runs so the gate can never silently no-op).
+    always runs so the gate can never silently no-op);
+  * (--planner) the auto-parallel planner smoke (analysis/planner.py:
+    tiny config, 2x2 mesh): a non-empty ranked plan whose winner
+    passes trace-verification under the planner contract, emitted as
+    the `planner` section of `--json`.
 
 Exit status: non-zero on any ERROR finding. `--json` emits a
 machine-readable report including the per-geometry HBM peak estimates;
@@ -98,6 +102,11 @@ def main(argv=None):
     ap.add_argument("--ci", action="store_true",
                     help="also run the source lint (+ruff if installed)"
                          " — the pre-merge configuration")
+    ap.add_argument("--planner", action="store_true",
+                    help="also run the auto-parallel planner smoke "
+                         "(tiny config, 2x2 mesh) and emit the ranked "
+                         "plan + winner verification as a `planner` "
+                         "section (~20s)")
     ap.add_argument("--json", action="store_true")
     ap.add_argument("--verbose", action="store_true",
                     help="include INFO findings")
@@ -171,6 +180,22 @@ def main(argv=None):
         out["pipeline_schedules"] = schedule_inventory()
     if rw_table is not None:
         out["rewrite"] = rw_table
+    if args.planner:
+        # the auto-parallel planner as a CI section: the ONE shared
+        # smoke space (planner.SMOKE_KNOBS — the same knobs
+        # `tools/auto_parallel.py --smoke` plans) must produce a
+        # non-empty ranked plan whose winner trace-verifies under the
+        # planner contract — prediction-vs-trace deltas ride the same
+        # Finding JSON schema as every other pass
+        from paddle_tpu.analysis.planner import (SMOKE_KNOBS,
+                                                 plan_auto_parallel)
+        from paddle_tpu.models import llama as L
+        kn = dict(SMOKE_KNOBS)
+        plan = plan_auto_parallel(
+            L.LlamaConfig.tiny(), kn.pop("devices"), **kn)
+        out["planner"] = plan
+        ok = ok and bool(plan["plans"]) and bool(
+            plan.get("verification", {}).get("ok"))
     out["hbm"] = [
         {"graph": name, "peak_bytes": est.peak_bytes,
          "input_bytes": est.args_bytes,
@@ -213,6 +238,12 @@ def main(argv=None):
                   f"{'' if r['ran'] else ' (not installed)'}")
             if not r["ok"]:
                 print(out["ruff"].get("output", ""))
+        if args.planner:
+            pl = out["planner"]
+            win = pl["winner"]["label"] if pl["winner"] else "<none>"
+            ver = pl.get("verification", {}).get("ok")
+            print(f"planner: {pl['legal']} legal plans, winner {win} "
+                  f"verification {'OK' if ver else 'FAIL'}")
         print(f"graph lint: {report.summary()} in {out['seconds']}s -> "
               f"{'OK' if ok else 'FAIL'}")
     return 0 if ok else 1
