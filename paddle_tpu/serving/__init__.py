@@ -19,6 +19,10 @@ shape (PAPERS.md).
     NGramDrafter    — self-drafting n-gram proposer for speculative
                       decoding; AcceptancePolicy — the adaptive draft
                       budget (serving/speculative.py)
+    ServingFleet    — N replicas behind a prefix-affinity router with
+                      prefill/decode disaggregation and
+                      drain-on-failure (serving/fleet/; FleetRouter,
+                      Replica ride along)
 
 Runtime observability (span tracer, flight-recorder postmortems, the
 live recompile sentinel) lives in paddle_tpu/observability/ and is
@@ -27,8 +31,10 @@ wired through the engine's ``trace=`` / ``flight_ticks=`` /
 and docs/OBSERVABILITY.md for the span taxonomy and postmortem format.
 """
 from .engine import ServingEngine  # noqa: F401
-from .metrics import Histogram, ServingMetrics  # noqa: F401
-from .prefix_cache import PrefixCache  # noqa: F401
+from .fleet import FleetRouter, Replica, ServingFleet  # noqa: F401
+from .metrics import (Histogram, ServingMetrics,  # noqa: F401
+                      merge_exposition)
+from .prefix_cache import PrefixCache, prefix_fingerprints  # noqa: F401
 from .scheduler import (Request, RequestHandle, Scheduler,  # noqa: F401
                         CANCELLED, COMPLETED, QUEUED, REJECTED, RUNNING,
                         TIMED_OUT)
@@ -36,5 +42,7 @@ from .speculative import (AcceptancePolicy, NGramDrafter)  # noqa: F401
 
 __all__ = ["ServingEngine", "Scheduler", "PrefixCache", "Request",
            "RequestHandle", "ServingMetrics", "Histogram",
-           "NGramDrafter", "AcceptancePolicy", "QUEUED",
+           "NGramDrafter", "AcceptancePolicy", "ServingFleet",
+           "FleetRouter", "Replica", "merge_exposition",
+           "prefix_fingerprints", "QUEUED",
            "RUNNING", "COMPLETED", "CANCELLED", "TIMED_OUT", "REJECTED"]

@@ -465,6 +465,11 @@ class ServingEngine:
         self._tick_lock = threading.Lock()
         self._closing = False
         self._drain = True
+        # hand-back drain (the fleet drain protocol): when set, the
+        # drain stops admission and returns queued-but-unadmitted
+        # requests through close() instead of serving them
+        self._hand_back = False
+        self._returned: list = []
         self._dead: Optional[BaseException] = None
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="serving-engine")
@@ -523,20 +528,101 @@ class ServingEngine:
         tokens (no prompt prefix, same contract as generate_paged)."""
         return self.submit(prompt, max_new_tokens, **kw).result()
 
-    def close(self, drain: bool = True) -> None:
-        """Stop admission and shut down. drain=True finishes every
-        queued + running request first; drain=False cancels them."""
+    @property
+    def alive(self) -> bool:
+        """Worker thread running with no recorded death — the public
+        liveness surface fleet replicas (and any future RPC health
+        endpoint) key routing eligibility on."""
+        return self._dead is None and self._worker.is_alive()
+
+    def inject(self, req: Request) -> bool:
+        """Enqueue an EXISTING :class:`Request` object (the fleet
+        router's dispatch/re-dispatch path — serving/fleet/router.py):
+        same admission checks as :meth:`submit`, but non-raising, so a
+        router can try the next replica. The request object carries
+        its own stream/done machinery, so a caller's
+        ``RequestHandle`` keeps working across re-dispatch to a
+        different engine — tokens simply start arriving from the new
+        replica. Returns False (and finalizes NOTHING) when this
+        engine cannot take it: closed/closing, dead worker, queue
+        full, or a prompt/page budget that can never fit this
+        geometry. Counter contract: ``submitted`` counts only ACCEPTED
+        injections (a router's dispatch walk trying several replicas
+        must not inflate fleet-aggregated submit totals); a refusal
+        counts ``rejected`` on the refusing replica."""
+        if self._dead is not None:
+            self.metrics.inc("rejected")
+            return False
+        with self._cond:
+            if self._closing:
+                self.metrics.inc("rejected")
+                return False
+            ok = self.scheduler.submit(req)
+            if ok:
+                self._cond.notify_all()
+        if not ok:
+            self.metrics.inc("rejected")
+            return False
+        if self._dead is not None and not req.done.is_set():
+            # worker died between the liveness check and the enqueue.
+            # Safe to hand back ONLY if we can pull the request out of
+            # the queue untouched — if it is not there, the worker
+            # already moved it to a slot (or _fail_all is finalizing
+            # it): the engine owns it, so report accepted and let the
+            # fail-fast contract resolve the handle; returning False
+            # here would let the router dispatch the SAME object into
+            # a second engine while this one still mutates it.
+            if self.scheduler.drop_queued(lambda r: r is req):
+                # counter contract: every refusal counts as rejected
+                self.metrics.inc("rejected")
+                return False
+        self.metrics.inc("submitted")
+        return True
+
+    def close(self, drain: bool = True,
+              hand_back: bool = False) -> "list[Request]":
+        """Stop admission and shut down; returns the requests handed
+        back for re-dispatch (empty unless ``hand_back``).
+
+        drain=True (default) finishes every queued + running request
+        first; drain=False cancels them all. ``hand_back=True`` is the
+        fleet drain protocol (serving/fleet/): admission stops
+        IMMEDIATELY, in-flight slots (decoding or parked mid-prefill)
+        run to completion, and queued-but-unadmitted requests are
+        returned — still QUEUED, never finalized as failed — so a
+        router can re-dispatch them to another replica and the
+        caller's handles resolve there. Without hand-back a drain
+        serves its whole queue, so nothing is ever silently dropped
+        either way; hand-back just trades queue latency on a dying
+        replica for a re-dispatch.
+
+        The hand-back list is returned ONCE: each request appears in
+        exactly one close() return (a second close on a drained
+        engine returns ``[]``), so a caller can never re-dispatch a
+        request that an earlier close already surfaced."""
+        if hand_back and not drain:
+            raise ValueError("hand_back requires drain=True (a cancel "
+                             "close finalizes, it cannot hand back)")
         with self._cond:
             if self._dead is not None and not self._worker.is_alive():
                 if self.sentinel is not None:
                     self.sentinel.close()
-                return
+                return self._take_returned()
             self._closing = True
             self._drain = drain
+            self._hand_back = bool(hand_back)
             self._cond.notify_all()
         self._worker.join()
         if self.sentinel is not None:
             self.sentinel.close()
+        return self._take_returned()
+
+    def _take_returned(self) -> "list[Request]":
+        """Drain the hand-back list atomically (worker is not running
+        when this is called; the cond lock guards racing closers)."""
+        with self._cond:
+            out, self._returned = self._returned, []
+        return out
 
     def __enter__(self):
         return self
@@ -577,10 +663,12 @@ class ServingEngine:
         """Alias of :meth:`snapshot` (the pre-r13 name)."""
         return self.snapshot()
 
-    def expose(self) -> str:
-        """Prometheus text exposition of counters + histograms + live
-        gauges (``ServingMetrics.expose`` — dependency-free; serve it
-        from any HTTP handler). Thread-safe like :meth:`snapshot`."""
+    def gauges(self) -> dict:
+        """Flat ``{name: number}`` view of the live pool/queue gauges
+        (nested dicts like the prefix-cache stats flattened to
+        ``prefix_cache_<k>``). Thread-safe like :meth:`snapshot` —
+        this is the health feed a fleet replica polls
+        (serving/fleet/replica.py) and what :meth:`expose` renders."""
         with self._tick_lock:
             g = self._gauges()
         flat = {}
@@ -590,7 +678,27 @@ class ServingEngine:
                              if isinstance(vv, (int, float))})
             elif isinstance(v, (int, float)):
                 flat[k] = v
-        return self.metrics.expose(gauges=flat)
+        return flat
+
+    def expose(self, labels: Optional[dict] = None) -> str:
+        """Prometheus text exposition of counters + histograms + live
+        gauges (``ServingMetrics.expose`` — dependency-free; serve it
+        from any HTTP handler). Thread-safe like :meth:`snapshot`.
+        ``labels`` (raw, unescaped) are stamped on every sample — the
+        fleet aggregator passes ``{"replica": ...}`` and relies on
+        escape-once at render time."""
+        return self.metrics.expose(gauges=self.gauges(), labels=labels)
+
+    def affinity_summary(self, max_depth: int = 2) -> dict:
+        """The prefix cache's hot-chain fingerprint summary
+        (``PrefixCache.affinity_summary``) read under the tick lock —
+        safe from any thread; ``{}`` when the prefix cache is off.
+        This is the warmth signal the fleet router matches prompts
+        against."""
+        if self.prefix_cache is None:
+            return {}
+        with self._tick_lock:
+            return self.prefix_cache.affinity_summary(max_depth)
 
     def export_trace(self, path: str) -> str:
         """Write the span tracer's ring as Perfetto-loadable
@@ -1331,6 +1439,16 @@ class ServingEngine:
                     self._sweep(now)
                     if self._closing and not self._drain:
                         break
+                    if self._closing and self._hand_back:
+                        # hand-back drain (fleet protocol): admission
+                        # stops NOW — queued requests go back to the
+                        # caller un-finalized for re-dispatch, while
+                        # in-flight slots below run to completion
+                        handed = self.scheduler.drop_queued(
+                            lambda r: True)
+                        if handed:
+                            self._returned.extend(handed)
+                            self.metrics.inc("handed_back", len(handed))
                     t_adm = time.monotonic()
                     with RecordEvent("serving.admit"):
                         admitted = self.scheduler.admit()

@@ -1,0 +1,30 @@
+"""paddle_tpu.serving.fleet — multi-replica serving (ROADMAP item 1).
+
+N ``ServingEngine`` replicas behind a prefix-affinity router with
+prefill/decode disaggregation and drain-on-failure:
+
+    ServingFleet — N replicas + membership generations + aggregated
+                   observability (fleet.py)
+    FleetRouter  — prefix-affinity / least-loaded / round-robin
+                   routing, role pools, exactly-once re-dispatch
+                   (router.py)
+    Replica      — one engine under the JOINING → SERVING → DRAINING
+                   → GONE lifecycle, health view, drain protocol
+                   (replica.py)
+
+The affinity signal is ``PrefixCache.affinity_summary`` (rolling-hash
+fingerprints of each replica's hot trie chains) matched against
+``prefix_cache.prefix_fingerprints(prompt, ...)``. The drain contract
+is ``ServingEngine.close(drain=True, hand_back=True)``: stop
+admission, finish in-flight slots, hand queued-but-unadmitted
+requests back for re-dispatch. See docs/SERVING.md "Serving fleet"
+and ``tools/serving_bench.py --replicas N``.
+"""
+from .fleet import ServingFleet  # noqa: F401
+from .replica import (DRAINING, GONE, JOINING, ROLE_DECODE,  # noqa: F401
+                      ROLE_GENERAL, ROLE_PREFILL, SERVING, Replica)
+from .router import FleetRouter  # noqa: F401
+
+__all__ = ["ServingFleet", "FleetRouter", "Replica", "JOINING",
+           "SERVING", "DRAINING", "GONE", "ROLE_GENERAL",
+           "ROLE_PREFILL", "ROLE_DECODE"]

@@ -1,0 +1,298 @@
+"""ServingFleet: N engine replicas + router + elastic membership.
+
+Reference capability: the serving product's multi-replica deployments
+(a scheduler fronting many predictor instances), grown from this
+repo's pieces: ``ServingEngine`` (the one-program tick),
+:class:`~..fleet.replica.Replica` (lifecycle + health),
+:class:`~..fleet.router.FleetRouter` (prefix affinity +
+prefill/decode disaggregation + exactly-once re-dispatch), and the
+PR-8 observability layer (per-replica expose/flight/sentinel) as the
+health/drain substrate.
+
+Membership follows the multi-node launcher's GENERATION idiom
+(distributed/launch/): every join/drain/kill bumps
+``fleet.generation``, and each replica records the generation it
+joined at — so logs, health views and the aggregated exposition can
+always say WHICH fleet shape a number belongs to, exactly like
+elastic training runs name their rendezvous generation.
+
+Replicas are threads over the CPU mesh here (each engine owns its
+worker thread; jitted step fns are shared per config, so N replicas
+compile once), but every cross-replica interface is process-shaped —
+plain-data health dicts, Prometheus text, fingerprint dicts,
+handed-back request lists — so a real multi-host launch replaces the
+in-process engine handle with an RPC stub and keeps this file.
+
+Failure handling = drain-on-failure: ``kill()`` (operator action or a
+health sweep catching a dead worker) runs the SAME drain protocol as
+a graceful leave — stop admission, finish in-flight slots, hand
+queued requests back — then re-dispatches the handed-back requests
+through the router. No accepted request is dropped by a drain: the
+kill-one-replica bench scenario (tools/serving_bench.py --replicas N)
+pins that end to end. The one hole is a hard engine crash
+(worker died mid-tick): the engine's fail-fast contract errors those
+handles immediately (flight recorder dumps a postmortem) rather than
+silently retrying work whose KV state is suspect — re-dispatch there
+is the caller's explicit choice, not the fleet's.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+from ..metrics import merge_exposition
+from ..scheduler import RequestHandle
+from .replica import (DRAINING, GONE, JOINING, ROLE_GENERAL, SERVING,
+                      Replica)
+from .router import FleetRouter
+
+__all__ = ["ServingFleet"]
+
+
+class ServingFleet:
+    """N replicas behind a :class:`FleetRouter`.
+
+        fleet = ServingFleet(lambda: ServingEngine(params, cfg, ...),
+                             replicas=4)
+        h = fleet.submit(prompt, max_new_tokens=16)
+        toks = h.result()
+        fleet.drain("r0")          # graceful leave; queued re-dispatch
+        fleet.join(role="decode")  # elastic join, generation bumped
+        fleet.close()
+
+    engine_factory: zero-arg callable building ONE ServingEngine; each
+    replica calls it once. Identical configs share jitted step fns, so
+    only the first replica pays XLA compiles.
+    replicas: initial replica count. roles: optional per-replica role
+    list (``general``/``prefill``/``decode``) cycled over the initial
+    replicas — role-tagging turns on the router's prefill/decode
+    disaggregation.
+    policy / summary_depth / prefill_len_ratio: see FleetRouter.
+    warm: warm each engine's program inventory at join (leave True —
+    it is what makes later joins and the armed sentinels clean).
+    """
+
+    def __init__(self, engine_factory: Callable, *, replicas: int = 2,
+                 roles: Optional[List[str]] = None,
+                 policy: str = "affinity", summary_depth: int = 2,
+                 prefill_len_ratio: float = 1.0, warm: bool = True,
+                 name_prefix: str = "r"):
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        self._factory = engine_factory
+        self._prefix = str(name_prefix)
+        self._lock = threading.Lock()
+        self._n = 0
+        self.generation = 0
+        self._replicas: Dict[str, Replica] = {}   # join order, ALL states
+        self._leaving: set = set()      # names mid-_leave: makes the
+        # leave accounting (generation bump + drain/kill counter)
+        # exactly-once under concurrent drain/kill/reap of one replica
+        self.router = FleetRouter(policy=policy,
+                                  summary_depth=summary_depth,
+                                  prefill_len_ratio=prefill_len_ratio)
+        self.counters = {"joins": 0, "drains": 0, "kills": 0,
+                         "handed_back": 0, "closed": 0}
+        for i in range(replicas):
+            role = roles[i % len(roles)] if roles else ROLE_GENERAL
+            self.join(role=role, warm=warm)
+
+    # -------------------------------------------------------- membership ----
+    def _inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def replica(self, name: str) -> Replica:
+        with self._lock:
+            return self._replicas[name]
+
+    def replicas(self, state: Optional[str] = None) -> List[Replica]:
+        with self._lock:
+            reps = list(self._replicas.values())
+        if state is not None:
+            reps = [r for r in reps if r.state == state]
+        return reps
+
+    def join(self, role: str = ROLE_GENERAL, *,
+             warm: bool = True) -> Replica:
+        """Elastic join: bump the generation, build + warm the engine,
+        open it to the router. Returns the new replica."""
+        with self._lock:
+            name = f"{self._prefix}{self._n}"
+            self._n += 1
+            self.generation += 1
+            gen = self.generation
+        rep = Replica(name, self._factory, role=role, generation=gen)
+        with self._lock:
+            self._replicas[name] = rep
+        rep.start(warm=warm)
+        self.router.add(rep)
+        self._inc("joins")
+        return rep
+
+    def _leave(self, name: str, counter: str) -> List:
+        rep = self.replica(name)
+        with self._lock:
+            # exactly-once accounting: concurrent drain/kill/reap of
+            # one replica (and post-completion retries) are ONE leave
+            if name in self._leaving or rep.state in (DRAINING, GONE):
+                return []
+            self._leaving.add(name)
+        try:
+            # flip to DRAINING through the replica itself so the
+            # router stops selecting it the moment the leave begins
+            handed = rep.drain()
+            # prune the router's membership + TTL caches: a GONE
+            # replica must not cost every future submit a filter pass
+            self.router.remove(name)
+            with self._lock:
+                self.generation += 1
+                self.counters[counter] += 1
+            if handed:
+                self._inc("handed_back", len(handed))
+                self.router.redispatch(handed, exclude=(name,))
+            return handed
+        finally:
+            with self._lock:
+                self._leaving.discard(name)
+
+    def drain(self, name: str) -> List:
+        """Graceful leave: drain protocol + re-dispatch of the
+        handed-back queue to survivors. Returns the handed-back
+        requests (already re-dispatched — callers usually just want
+        the count)."""
+        return self._leave(name, "drains")
+
+    def kill(self, name: str) -> List:
+        """Drain-on-failure: identical mechanics to :meth:`drain`
+        (stop admission, finish in-flight, hand back + re-dispatch
+        queued) but accounted as a failure — the kill-one-replica
+        bench scenario and any health sweep reaping a sick replica go
+        through here."""
+        return self._leave(name, "kills")
+
+    def reap(self) -> List[str]:
+        """Health sweep: drain-on-failure for every replica whose
+        engine worker died (their queued requests were already failed
+        by the engine's fail-fast contract; this closes them out and
+        bumps the generation so the fleet shape is honest). Returns
+        the reaped names."""
+        reaped = []
+        for rep in self.replicas():
+            if rep.state in (SERVING, JOINING) and rep.engine is not None \
+                    and not rep.alive:
+                self.kill(rep.name)
+                reaped.append(rep.name)
+        return reaped
+
+    # --------------------------------------------------------- admission ----
+    def submit(self, prompt, max_new_tokens: int,
+               **kw) -> RequestHandle:
+        """Route one request into the fleet (see FleetRouter.submit)."""
+        return self.router.submit(prompt, max_new_tokens, **kw)
+
+    def generate(self, prompt, max_new_tokens: int, **kw):
+        """Blocking convenience: submit + wait (engine parity)."""
+        return self.submit(prompt, max_new_tokens, **kw).result()
+
+    # ----------------------------------------------------- observability ----
+    def arm_sentinels(self) -> None:
+        """Declare fleet warmup done: any later XLA compile trips the
+        per-replica recompile sentinels (engine.arm_sentinel). Call
+        after every replica joined and warmed — replicas share jitted
+        step fns, so an elastic join AFTER arming stays clean too."""
+        for rep in self.replicas(SERVING):
+            eng = rep.engine        # tolerate a concurrent drain
+            if eng is not None:     # nulling the handle mid-walk
+                eng.arm_sentinel()
+
+    def snapshot(self) -> dict:
+        """Fleet-level plain-dict view: generation, per-replica health
+        (+ key lifecycle counters), router counters, fleet counters."""
+        reps = {}
+        for rep in self.replicas():
+            h = rep.health()
+            eng = rep.engine
+            src = rep.final_snapshot() if eng is None \
+                else eng.snapshot()
+            if src is not None:
+                c = src["counters"]
+                h["counters"] = {k: c[k] for k in
+                                 ("submitted", "admitted", "completed",
+                                  "handed_back", "tokens_out",
+                                  "prefix_hits", "prefix_misses")}
+            reps[rep.name] = h
+        with self._lock:
+            counters = dict(self.counters)
+            gen = self.generation
+        return {"generation": gen, "policy": self.router.policy,
+                "replicas": reps, "router": dict(self.router.counters),
+                "fleet": counters}
+
+    def expose(self) -> str:
+        """ONE Prometheus scrape for the whole fleet: every live
+        replica's counters/histograms/gauges labeled
+        ``{replica, role}`` (escape-once structured merging —
+        metrics.merge_exposition), plus fleet-level gauges
+        (generation, membership, router counters)."""
+        entries = []
+        reps = self.replicas()      # ONE membership snapshot: the
+        # scrape's per-state counts and per-replica samples must
+        # describe the same instant, and a replica whose engine a
+        # concurrent drain nulls mid-scrape degrades to omission, not
+        # a crashed endpoint
+        for rep in reps:
+            eng = rep.engine
+            if eng is None or rep.state == GONE:
+                continue
+            labels = {"replica": rep.name, "role": rep.role}
+            try:
+                entries.append((labels, eng.metrics, eng.gauges()))
+            except Exception:
+                entries.append((labels, eng.metrics, None))
+        with self._lock:
+            gen = self.generation
+            fleet_g = {f"fleet_{k}": v for k, v in self.counters.items()}
+        fleet_g["fleet_generation"] = gen
+        for state in (JOINING, SERVING, DRAINING, GONE):
+            fleet_g[f"fleet_replicas_{state}"] = sum(
+                1 for r in reps if r.state == state)
+        for k, v in self.router.counters.items():
+            fleet_g[f"router_{k}"] = v
+        entries.append(({}, None, fleet_g))
+        return merge_exposition(entries)
+
+    def flight_view(self, last: int = 8) -> dict:
+        """Fleet-level flight view: each replica's lifecycle state plus
+        its flight recorder's last ``last`` tick records — the
+        postmortem-shaped answer to "what was every replica doing just
+        now", GONE replicas included (their recorders survive the
+        engine close)."""
+        out = {}
+        for rep in self.replicas():
+            out[rep.name] = {
+                "state": rep.state, "role": rep.role,
+                "generation": rep.generation,
+                "ticks": rep.flight_ticks()[-last:],
+                "postmortem": rep.postmortem_path}
+        return out
+
+    # ----------------------------------------------------------- shutdown ----
+    def close(self, drain: bool = True) -> None:
+        """Shut the whole fleet down. drain=True finishes every
+        replica's queued + running requests (full engine drain — with
+        no survivors there is nobody to hand a queue back to);
+        drain=False cancels everything. Goes through
+        ``Replica.close`` so the lifecycle state machine, its
+        idempotence guard (a concurrent drain/reap cannot double-close
+        an engine) and the GONE-replica snapshot/sentinel capture hold
+        on this path too."""
+        for rep in self.replicas():
+            rep.close(drain=drain, hand_back=False)
+        self._inc("closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -18,7 +18,7 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
-__all__ = ["Histogram", "ServingMetrics"]
+__all__ = ["Histogram", "ServingMetrics", "merge_exposition"]
 
 
 class Histogram:
@@ -94,7 +94,10 @@ class ServingMetrics:
     decoding (spec_ticks — verify launches; draft_tokens /
     draft_accepted / draft_rejected — per-draft-token outcomes:
     launches-per-emitted-token is decode_steps / tokens_out, mean
-    acceptance draft_accepted / draft_tokens).
+    acceptance draft_accepted / draft_tokens), and handed_back
+    (queued-but-unadmitted requests a hand-back drain returned to the
+    caller for re-dispatch instead of finalizing — the fleet drain
+    protocol, serving/fleet/).
     Labeled counters (``inc_labeled``): the same monotonic semantics
     with a small label set — e.g. ``recompiles{during="serving.tick"}``
     names WHAT a post-warmup compile interrupted. Kept separate from
@@ -121,7 +124,7 @@ class ServingMetrics:
                 "prefix_misses", "prefix_hit_tokens",
                 "prefix_pages_saved", "invariant_violations",
                 "recompiles", "spec_ticks", "draft_tokens",
-                "draft_accepted", "draft_rejected")
+                "draft_accepted", "draft_rejected", "handed_back")
     HISTOGRAMS = ("queue_wait_s", "ttft_s", "decode_step_s",
                   "decode_stall_s", "batch_occupancy",
                   "page_utilization", "chunk_queue_depth",
@@ -165,8 +168,21 @@ class ServingMetrics:
                                    for k, h in self.histograms.items()}}
 
     # -------------------------------------------------- prometheus text ----
+    def _collect(self):
+        """One consistent read of every series under the lock:
+        ``(counters, labeled, {hist: (summary, lifetime_sum)})`` —
+        the raw material both :meth:`expose` and the fleet-level
+        :func:`merge_exposition` render from (values stay RAW here;
+        label escaping happens exactly once, at render time)."""
+        with self._lock:
+            return (dict(self.counters),
+                    {n: dict(s) for n, s in self.labeled.items()},
+                    {k: (h.summary(), h.lifetime_sum)
+                     for k, h in self.histograms.items()})
+
     def expose(self, prefix: str = "paddle_serving",
-               gauges: Optional[Dict[str, float]] = None) -> str:
+               gauges: Optional[Dict[str, float]] = None,
+               labels: Optional[Dict[str, str]] = None) -> str:
         """Dependency-free Prometheus text exposition (format 0.0.4).
 
         Flat counters become ``<prefix>_<name>_total``; labeled
@@ -187,35 +203,103 @@ class ServingMetrics:
         the per-tick ``page_utilization`` histogram) is emitted as
         ``<prefix>_<name>_now``: one metric family must not carry two
         TYPEs, or the whole scrape is rejected.
+
+        ``labels`` (optional {name: value}) are stamped onto EVERY
+        sample — the fleet aggregator passes ``{"replica": ...}``.
+        Values are passed RAW and escaped exactly once at render time,
+        so re-exporting through the fleet can never double-escape.
         """
-        with self._lock:
-            counters = dict(self.counters)
-            labeled = {n: dict(s) for n, s in self.labeled.items()}
-            hists = {k: (h.summary(), h.lifetime_sum)
-                     for k, h in self.histograms.items()}
-        lines = []
-        for name, v in sorted(counters.items()):
-            metric = f"{prefix}_{name}_total"
-            lines.append(f"# TYPE {metric} counter")
-            lines.append(f"{metric} {v}")
-        for name, series in sorted(labeled.items()):
-            metric = f"{prefix}_{name}_breakdown_total"
-            lines.append(f"# TYPE {metric} counter")
-            for key, lv in sorted(series.items()):
-                lbl = ",".join(
-                    f'{k}="{_prom_escape(val)}"' for k, val in key)
-                lines.append(f"{metric}{{{lbl}}} {lv}")
-        for name, (s, life_sum) in sorted(hists.items()):
-            metric = f"{prefix}_{name}"
-            lines.append(f"# TYPE {metric} summary")
-            lines.append(f'{metric}{{quantile="0.5"}} {s["p50"]:.9g}')
-            lines.append(f'{metric}{{quantile="0.99"}} {s["p99"]:.9g}')
-            lines.append(f"{metric}_sum {life_sum:.9g}")
-            lines.append(f"{metric}_count {s['count']}")
-        for name, v in sorted((gauges or {}).items()):
-            if name in hists:
-                name = f"{name}_now"    # family collision (docstring)
-            metric = f"{prefix}_{name}"
-            lines.append(f"# TYPE {metric} gauge")
-            lines.append(f"{metric} {float(v):.9g}")
-        return "\n".join(lines) + "\n"
+        return merge_exposition([(labels or {}, self, gauges)],
+                                prefix=prefix)
+
+
+def _render_labels(labels: Dict[str, str]) -> str:
+    """``k1="v1",k2="v2"`` with values escaped HERE and nowhere else
+    (the escape-once contract: callers always hand raw values)."""
+    return ",".join(f'{k}="{_prom_escape(v)}"'
+                    for k, v in sorted(labels.items()))
+
+
+def _sample(metric: str, labels: Dict[str, str], value: str) -> str:
+    lbl = _render_labels(labels)
+    return f"{metric}{{{lbl}}} {value}" if lbl else f"{metric} {value}"
+
+
+def merge_exposition(entries, prefix: str = "paddle_serving") -> str:
+    """Render MANY metrics sources as ONE Prometheus scrape.
+
+    ``entries`` is ``[(labels, metrics, gauges)]``: per entry, a raw
+    (unescaped) label dict stamped on every sample (the fleet passes
+    ``{"replica": "r0"}``), a :class:`ServingMetrics` or ``None``, and
+    an optional ``{name: value}`` gauge dict. The single-engine
+    :meth:`ServingMetrics.expose` is exactly this with one entry.
+
+    Aggregation rules (the reasons this is structured merging, not
+    text concatenation):
+
+    * one ``# TYPE`` line per family, however many entries sample it —
+      repeated TYPE lines for one family make a scrape invalid;
+    * label values are escaped exactly ONCE, here: entries hand raw
+      values, so a fleet re-exporting per-replica metrics can never
+      double-escape what an engine already escaped;
+    * deterministic ordering — families sorted by kind (counters,
+      labeled breakdowns, histogram summaries, gauges) then name,
+      samples within a family sorted by rendered label string — so two
+      renders of the same state are byte-identical (diffable scrapes);
+    * an entry's labels override same-named labels from a labeled
+      counter's own key (the aggregator owns the ``replica`` axis);
+    * gauge names colliding with a histogram family anywhere in the
+      merge are renamed ``<name>_now`` (one family, one TYPE).
+    """
+    fam_counter: Dict[str, list] = {}
+    fam_break: Dict[str, list] = {}
+    fam_hist: Dict[str, list] = {}
+    fam_gauge: Dict[str, list] = {}
+    for labels, metrics, gauges in entries:
+        base = {str(k): str(v) for k, v in (labels or {}).items()}
+        if metrics is not None:
+            counters, labeled, hists = metrics._collect()
+            for name, v in counters.items():
+                fam_counter.setdefault(name, []).append((base, v))
+            for name, series in labeled.items():
+                for key, lv in series.items():
+                    merged = dict(key)
+                    merged.update(base)
+                    fam_break.setdefault(name, []).append((merged, lv))
+            for name, (s, life_sum) in hists.items():
+                fam_hist.setdefault(name, []).append((base, s, life_sum))
+        for name, v in (gauges or {}).items():
+            fam_gauge.setdefault(name, []).append((base, float(v)))
+    lines = []
+    for name in sorted(fam_counter):
+        metric = f"{prefix}_{name}_total"
+        lines.append(f"# TYPE {metric} counter")
+        for base, v in sorted(fam_counter[name],
+                              key=lambda e: _render_labels(e[0])):
+            lines.append(_sample(metric, base, str(v)))
+    for name in sorted(fam_break):
+        metric = f"{prefix}_{name}_breakdown_total"
+        lines.append(f"# TYPE {metric} counter")
+        for lbls, v in sorted(fam_break[name],
+                              key=lambda e: _render_labels(e[0])):
+            lines.append(_sample(metric, lbls, str(v)))
+    for name in sorted(fam_hist):
+        metric = f"{prefix}_{name}"
+        lines.append(f"# TYPE {metric} summary")
+        for base, s, life_sum in sorted(
+                fam_hist[name], key=lambda e: _render_labels(e[0])):
+            for q, val in (("0.5", s["p50"]), ("0.99", s["p99"])):
+                lines.append(_sample(metric, dict(base, quantile=q),
+                                     f"{val:.9g}"))
+            lines.append(_sample(f"{metric}_sum", base,
+                                 f"{life_sum:.9g}"))
+            lines.append(_sample(f"{metric}_count", base,
+                                 str(s["count"])))
+    for name in sorted(fam_gauge):
+        out_name = f"{name}_now" if name in fam_hist else name
+        metric = f"{prefix}_{out_name}"
+        lines.append(f"# TYPE {metric} gauge")
+        for base, v in sorted(fam_gauge[name],
+                              key=lambda e: _render_labels(e[0])):
+            lines.append(_sample(metric, base, f"{v:.9g}"))
+    return "\n".join(lines) + "\n"

@@ -47,12 +47,49 @@ import heapq
 import itertools
 from typing import Dict, List, Tuple
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "prefix_fingerprints"]
+
+# Rolling-hash base/mask for the fleet affinity signal: a chain's
+# fingerprint is a polynomial hash over its concatenated page token
+# tuples, extended one page at a time (the same rolling keying the trie
+# itself uses, collapsed to one int). Fingerprints only ROUTE requests
+# (serving/fleet/router.py) — a collision can at worst send a request
+# to a colder replica, never alias KV: attachment still goes through
+# the trie's exact tuple comparison.
+_FP_MUL = 1000003
+_FP_MASK = (1 << 64) - 1
+
+
+def _fp_extend(fp: int, toks) -> int:
+    for t in toks:
+        fp = (fp * _FP_MUL + int(t) + 1) & _FP_MASK
+    return fp
+
+
+def prefix_fingerprints(prompt, page_size: int, max_depth: int = 2):
+    """Rolling-hash fingerprints of ``prompt``'s leading full pages:
+    ``[fp(page0), fp(page0+page1), ...]`` up to ``max_depth`` entries,
+    capped at the pages a ``PrefixCache`` could ever attach for this
+    prompt (``(n-1)//page_size`` — at least one suffix token always
+    prefills). The fleet router hashes an incoming prompt with THIS
+    function and matches against each replica's
+    :meth:`PrefixCache.affinity_summary` — same hash, same page
+    framing, so a match means the replica's trie holds that exact
+    chain (modulo 64-bit collisions, which only cost routing warmth,
+    never correctness)."""
+    ps = int(page_size)
+    n = len(prompt)
+    pages = min(max(0, (int(n) - 1) // ps), int(max_depth))
+    out, fp = [], 0
+    for i in range(pages):
+        fp = _fp_extend(fp, prompt[i * ps:(i + 1) * ps])
+        out.append(fp)
+    return out
 
 
 class _Node:
     __slots__ = ("toks", "parent", "children", "page", "refs",
-                 "last_used")
+                 "last_used", "hits")
 
     def __init__(self, toks, parent, page: int, tick: int):
         self.toks = toks                    # this page's token tuple
@@ -61,6 +98,7 @@ class _Node:
         self.page = int(page)
         self.refs = 0
         self.last_used = tick
+        self.hits = 0                       # acquire() attachments
 
     def __repr__(self):  # debugging aid only
         return (f"_Node(page={self.page}, refs={self.refs}, "
@@ -142,6 +180,7 @@ class PrefixCache:
         for nd in nodes:
             nd.refs += 1
             nd.last_used = t
+            nd.hits += 1
         return nodes
 
     def release(self, nodes: List[_Node]) -> None:
@@ -234,6 +273,36 @@ class PrefixCache:
             return
         for nd in self._nodes:
             nd.page = plan.get(nd.page, nd.page)
+
+    # ---------------------------------------------------------- affinity ----
+    def affinity_summary(self, max_depth: int = 2) -> Dict[int, Dict]:
+        """The fleet router's warmth signal: ``{fingerprint: {"depth",
+        "hits", "refs", "last_used"}}`` for every cached chain up to
+        ``max_depth`` pages deep, where ``fingerprint`` is the rolling
+        hash :func:`prefix_fingerprints` computes for the same token
+        chain. Computed LIVE from the trie on every call — an evicted
+        chain vanishes from the summary the moment ``evict`` frees it
+        (the affinity signal can never point at evicted KV), and a
+        defrag ``remap`` changes only page ids, which the fingerprint
+        never sees. ``hits`` counts ``acquire()`` attachments (real
+        admissions — ``match_pages`` peeks don't inflate it); ``refs``
+        and ``last_used`` let the router prefer chains that are hot
+        RIGHT NOW. Depth is bounded (system prompts share their first
+        pages), so the walk touches the top of the trie, not every
+        cached page."""
+        out: Dict[int, Dict] = {}
+        frontier = [(self._root, 0, 0)]         # (node, fp, depth)
+        while frontier:
+            node, fp, d = frontier.pop()
+            if d >= max_depth:
+                continue
+            for toks, child in node.children.items():
+                cfp = _fp_extend(fp, toks)
+                out[cfp] = {"depth": d + 1, "hits": child.hits,
+                            "refs": child.refs,
+                            "last_used": child.last_used}
+                frontier.append((child, cfp, d + 1))
+        return out
 
     def stats(self) -> Dict[str, int]:
         return {"cached_pages": self.cached_pages,

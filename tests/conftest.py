@@ -63,7 +63,7 @@ _SMOKE_MODULES = {
     "test_pallas_kernels", "test_distributed", "test_prefix_cache",
     "test_analysis", "test_rewrite", "test_ragged_attention",
     "test_observability", "test_pipeline_async", "test_speculative",
-    "test_fused_sampling", "test_auto_parallel_planner",
+    "test_fused_sampling", "test_auto_parallel_planner", "test_fleet",
 }
 
 

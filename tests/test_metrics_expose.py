@@ -1,0 +1,157 @@
+"""Prometheus text exposition hardened for fleet aggregation (ISSUE
+r18 satellite): escape-once label handling, stable ordering, and a
+parser round-trip pinning the text format — the properties the fleet's
+``merge_exposition`` re-export depends on.
+"""
+import re
+
+import pytest
+
+from paddle_tpu.serving import ServingMetrics, merge_exposition
+
+# ---------------------------------------------------------------------------
+# a minimal Prometheus text-format 0.0.4 parser (test-side reference):
+# TYPE lines + samples, label values UNescaped back to raw strings
+# ---------------------------------------------------------------------------
+
+_SAMPLE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})? ([-+0-9.eEinfa]+)$")
+_LABEL = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape(v: str) -> str:
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt,
+                                                             "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_exposition(text):
+    """-> (types {family: kind}, samples [(name, {label: raw}, value)])."""
+    types, samples = {}, []
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, family, kind = ln.split(" ")
+            assert family not in types, f"duplicate TYPE for {family}"
+            types[family] = kind
+            continue
+        assert not ln.startswith("#"), ln
+        m = _SAMPLE.match(ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        name, lbl, val = m.groups()
+        labels = {}
+        if lbl:
+            consumed = _LABEL.sub("", lbl).replace(",", "")
+            assert consumed == "", f"unparseable labels: {lbl!r}"
+            labels = {k: _unescape(v) for k, v in _LABEL.findall(lbl)}
+        samples.append((name, labels, float(val)))
+    return types, samples
+
+
+NASTY = 'tick "w=16"\\path\nnewline'      # quotes + backslash + newline
+
+
+def _metrics():
+    m = ServingMetrics()
+    m.inc("submitted", 3)
+    m.inc("completed", 2)
+    m.inc_labeled("recompiles", during=NASTY)
+    for v in (0.1, 0.2, 0.3):
+        m.observe("ttft_s", v)
+    return m
+
+
+def test_round_trip_escapes_exactly_once():
+    """A label value with quotes, backslashes and a newline survives
+    render -> parse EXACTLY — single-engine and fleet-labeled alike
+    (re-export through merge_exposition must not double-escape)."""
+    m = _metrics()
+    for text in (m.expose(),
+                 m.expose(labels={"replica": "r0"}),
+                 merge_exposition([({"replica": "r0"}, m, None),
+                                   ({"replica": NASTY}, _metrics(),
+                                    None)])):
+        types, samples = parse_exposition(text)
+        breakdown = [(lbls, v) for name, lbls, v in samples
+                     if name == "paddle_serving_recompiles_breakdown_total"]
+        assert breakdown, text
+        for lbls, v in breakdown:
+            assert lbls["during"] == NASTY      # raw value round-trips
+            assert v == 1.0
+        # every physical line is newline-free (the escape did its job)
+        assert all("\n" not in ln or ln == ""
+                   for ln in text.split("\n"))
+
+
+def test_merged_scrape_one_type_line_per_family():
+    """Two replicas sampling every family must still yield ONE TYPE
+    line per family (duplicate TYPE lines invalidate a scrape), with
+    the replica label distinguishing the samples."""
+    a, b = _metrics(), _metrics()
+    b.inc("submitted", 7)                   # 3 + 7 -> distinguishable
+    text = merge_exposition([({"replica": "r0"}, a, {"free_pages": 5}),
+                             ({"replica": "r1"}, b, {"free_pages": 9})])
+    types, samples = parse_exposition(text)
+    sub = {lbls["replica"]: v for name, lbls, v in samples
+           if name == "paddle_serving_submitted_total"}
+    assert sub == {"r0": 3.0, "r1": 10.0}
+    # summary families carry replica + quantile labels together
+    q = [(lbls["replica"], lbls["quantile"]) for name, lbls, _ in samples
+         if name == "paddle_serving_ttft_s"]
+    assert set(q) == {("r0", "0.5"), ("r0", "0.99"),
+                      ("r1", "0.5"), ("r1", "0.99")}
+    gauges = {lbls["replica"]: v for name, lbls, v in samples
+              if name == "paddle_serving_free_pages"}
+    assert gauges == {"r0": 5.0, "r1": 9.0}
+
+
+def test_ordering_is_deterministic_and_sorted():
+    """Two renders of the same state are byte-identical, and families
+    appear in sorted order within each kind block — diffable scrapes."""
+    entries = [({"replica": "r1"}, _metrics(), {"g": 1}),
+               ({"replica": "r0"}, _metrics(), {"g": 2})]
+    t1 = merge_exposition(entries)
+    t2 = merge_exposition(entries)
+    assert t1 == t2
+    # within a family, samples sort by rendered labels (r0 before r1)
+    lines = t1.splitlines()
+    subs = [ln for ln in lines
+            if ln.startswith("paddle_serving_submitted_total{")]
+    assert subs == sorted(subs)
+    # counter families come sorted among themselves
+    counter_fams = [ln.split()[2] for ln in lines
+                    if ln.startswith("# TYPE") and ln.endswith("counter")
+                    and "breakdown" not in ln]
+    assert counter_fams == sorted(counter_fams)
+
+
+def test_gauge_histogram_collision_renamed():
+    m = ServingMetrics()
+    m.observe("page_utilization", 0.5)
+    text = m.expose(gauges={"page_utilization": 0.25, "queued": 3})
+    types, samples = parse_exposition(text)
+    assert types["paddle_serving_page_utilization"] == "summary"
+    assert types["paddle_serving_page_utilization_now"] == "gauge"
+    vals = [v for name, _, v in samples
+            if name == "paddle_serving_page_utilization_now"]
+    assert vals == [0.25]
+
+
+def test_single_engine_format_unchanged():
+    """The single-engine exposition (no labels) keeps the exact pre-r18
+    shape: bare sample names, no empty ``{}`` label blocks."""
+    text = _metrics().expose(gauges={"free_pages": 31})
+    lines = text.splitlines()
+    assert "paddle_serving_submitted_total 3" in lines
+    assert "paddle_serving_free_pages 31" in lines
+    assert not any("{}" in ln for ln in lines)

@@ -348,6 +348,84 @@ def test_prefix_cache_remap_follows_defrag_plan():
 
 
 # ---------------------------------------------------------------------------
+# hot-chain affinity summary (ISSUE r18 satellite): the fleet router's
+# warmth signal must track the trie exactly — hit accounting correct
+# across LRU eviction, and invariant under defrag remap
+# ---------------------------------------------------------------------------
+
+def test_affinity_summary_matches_prompt_fingerprints():
+    from paddle_tpu.serving import prefix_fingerprints
+    pool = PagePool(total_pages=16, page_size=2)
+    pc = PrefixCache(pool)
+    prompt = _toks(1, 2, 3, 4, 5)
+    nodes = pc.insert(prompt, [], pool.alloc(2))[0]
+    summ = pc.affinity_summary(max_depth=2)
+    fps = prefix_fingerprints(prompt, page_size=2, max_depth=2)
+    # the summary speaks the same hash: every prompt fingerprint
+    # resolves, at the right depth
+    assert len(fps) == 2 and set(fps) <= set(summ)
+    assert summ[fps[0]]["depth"] == 1 and summ[fps[1]]["depth"] == 2
+    # insert-time ownership is not a "hit"; acquire() is
+    assert summ[fps[0]]["hits"] == 0
+    got = pc.acquire(prompt)
+    summ = pc.affinity_summary(max_depth=2)
+    assert summ[fps[0]]["hits"] == 1 and summ[fps[1]]["hits"] == 1
+    assert summ[fps[0]]["refs"] == 2            # insert ref + acquire
+    # a non-pinning peek must NOT inflate the hotness signal
+    pc.match_pages(prompt)
+    assert pc.affinity_summary(2)[fps[0]]["hits"] == 1
+    pc.release(got)
+    pc.release(nodes)
+    # depth cap bounds the walk: depth-1 summary has one entry
+    assert len(pc.affinity_summary(max_depth=1)) == 1
+
+
+def test_affinity_summary_drops_evicted_chains():
+    """The affinity signal must never point at evicted KV: after LRU
+    eviction the evicted chain's fingerprints vanish while the
+    survivor's stats (hits included) are unchanged."""
+    from paddle_tpu.serving import prefix_fingerprints
+    pool = PagePool(total_pages=16, page_size=2)
+    pc = PrefixCache(pool)
+    p_a = _toks(1, 2, 3, 4, 9)
+    p_b = _toks(7, 8, 9)
+    a = pc.insert(p_a, [], pool.alloc(2))[0]
+    b = pc.insert(p_b, [], pool.alloc(1))[0]
+    pc.release(a)
+    pc.release(b)
+    got = pc.acquire(p_b)                   # B is hotter AND newer
+    pc.release(got)
+    fa = prefix_fingerprints(p_a, 2, 2)
+    fb = prefix_fingerprints(p_b, 2, 2)
+    summ = pc.affinity_summary(2)
+    assert set(fa) <= set(summ) and set(fb) <= set(summ)
+    assert pc.evict(2) == 2                 # chain A (LRU) fully gone
+    summ = pc.affinity_summary(2)
+    assert not (set(fa) & set(summ)), "evicted chain still advertised"
+    assert summ[fb[0]]["hits"] == 1         # survivor stats intact
+
+
+def test_affinity_summary_invariant_under_defrag_remap():
+    """Fingerprints hash TOKENS, not page ids: a defrag remap moves
+    every page and must not change the summary at all."""
+    pool = PagePool(total_pages=16, page_size=2)
+    pc = PrefixCache(pool)
+    prompt = _toks(1, 2, 3, 4, 5)
+    nodes = pc.insert(prompt, [], [9, 12])[0]
+    got = pc.acquire(prompt)
+    before = pc.affinity_summary(2)
+    pc.remap({9: 1, 12: 2})
+    after = pc.affinity_summary(2)
+    assert before == after
+    # and the remapped chain still resolves for new acquirers
+    got2 = pc.acquire(prompt)
+    assert [nd.page for nd in got2] == [1, 2]
+    pc.release(got2)
+    pc.release(got)
+    pc.release(nodes)
+
+
+# ---------------------------------------------------------------------------
 # PagePool.free() guards (satellite): corruption is loud, not silent
 # ---------------------------------------------------------------------------
 

@@ -16,8 +16,8 @@ import jax.numpy as jnp
 
 from paddle_tpu.inference.paged_kv import PagePool, apply_defrag
 from paddle_tpu.models import llama as L
-from paddle_tpu.serving import (CANCELLED, COMPLETED, Request, Scheduler,
-                                ServingEngine, TIMED_OUT)
+from paddle_tpu.serving import (CANCELLED, COMPLETED, QUEUED, Request,
+                                Scheduler, ServingEngine, TIMED_OUT)
 
 CFG = L.LlamaConfig.tiny(dtype=jnp.float32, use_flash_attention=False,
                          remat=False)
@@ -175,6 +175,52 @@ def test_close_drains_all_pending(params):
         np.testing.assert_array_equal(h.result(), _ref(params, p, m))
     with pytest.raises(RuntimeError, match="closed"):
         eng.submit(specs[0][0], 2)
+
+
+def test_close_hand_back_returns_queued_requests(params):
+    """The fleet drain protocol (ISSUE r18 satellite): a hand-back
+    drain finishes IN-FLIGHT requests but returns queued-but-unadmitted
+    ones STILL QUEUED — never finalized as failed — so a router can
+    re-dispatch them (regression: a request queued mid-drain must
+    survive the drain un-finalized and complete elsewhere)."""
+    rng = np.random.RandomState(7)
+    p_run = rng.randint(0, CFG.vocab_size, (4,)).astype(np.int32)
+    p_q = [rng.randint(0, CFG.vocab_size, (5,)).astype(np.int32)
+           for _ in range(2)]
+    eng = _engine(params, max_batch=1)
+    h_run = eng.submit(p_run, 12)
+    it = iter(h_run)
+    next(it)                        # h_run is admitted and decoding
+    h_queued = [eng.submit(p, 8) for p in p_q]
+    handed = eng.close(drain=True, hand_back=True)
+    # in-flight finished on the draining engine, bitwise
+    assert h_run.status == COMPLETED
+    np.testing.assert_array_equal(h_run.result(), _ref(params, p_run, 12))
+    # queued ones came back un-finalized, in order
+    assert [r.id for r in handed] == [h.id for h in h_queued]
+    for r, h in zip(handed, h_queued):
+        assert r.state == QUEUED and not r.done.is_set()
+        assert h.tokens_so_far == []
+    # metrics recorded the hand-back; nothing was cancelled
+    c = eng.stats()["counters"]
+    assert c["handed_back"] == 2 and c["cancelled"] == 0
+    # re-dispatch: the SAME Request objects into a fresh engine — the
+    # ORIGINAL handles resolve there, bitwise
+    eng2 = _engine(params)
+    try:
+        for r in handed:
+            assert eng2.inject(r)
+        for p, h in zip(p_q, h_queued):
+            np.testing.assert_array_equal(h.result(timeout=300),
+                                          _ref(params, p, 8))
+            assert h.status == COMPLETED
+    finally:
+        assert eng2.close() == []   # plain drain hands nothing back
+    # a hand-back without drain is contradictory
+    eng3 = _engine(params)
+    with pytest.raises(ValueError, match="hand_back"):
+        eng3.close(drain=False, hand_back=True)
+    eng3.close()
 
 
 def test_close_without_drain_cancels(params):

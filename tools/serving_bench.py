@@ -36,9 +36,20 @@ emitted token, speculative vs plain greedy, on a repetitive
 single-stream workload — with outputs asserted bitwise-equal across
 the arms.
 
+``--replicas N`` (the ``fleet`` mode) drives the serving FLEET
+(paddle_tpu/serving/fleet/): N engine replicas behind the
+prefix-affinity router, a multi-turn multi-session shared-prefix
+workload A/B'd against forced round-robin (the hit-rate claim), a
+flood 1-vs-N scaling arm, and a kill-one-replica scenario
+(drain-on-failure: queued hand-back + re-dispatch, zero drops, clean
+survivor sentinels). ``--arrival seed:K`` pins a replayable arrival
+schedule (inter-arrival + length draws) independent of content.
+
     JAX_PLATFORMS=cpu python tools/serving_bench.py --requests 32
     JAX_PLATFORMS=cpu python tools/serving_bench.py \
         --shared-prefix 24 --modes engine prefix_ab
+    JAX_PLATFORMS=cpu python tools/serving_bench.py \
+        --replicas 4 --arrival seed:1
 """
 import argparse
 import json
@@ -53,24 +64,73 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def build_trace(n, rate, max_prompt, mnt_choices, seed, shared_prefix=0):
+def parse_arrival(spec):
+    """``--arrival`` spec -> schedule-RNG seed or None (legacy: the
+    schedule rides the content seed). The only form today is
+    ``seed:K`` — a dedicated, replayable arrival schedule (ROADMAP
+    item 5's first slice): the SAME ``seed:K`` reproduces identical
+    inter-arrival gaps, prompt lengths and mnt draws whatever
+    ``--seed`` says, so fleet A/Bs and the kill-replica scenario
+    replay bit-identical schedules while varying content."""
+    if spec is None:
+        return None
+    if isinstance(spec, str) and spec.startswith("seed:"):
+        return int(spec.split(":", 1)[1])
+    raise ValueError(f"--arrival must be 'seed:K', got {spec!r}")
+
+
+def build_trace(n, rate, max_prompt, mnt_choices, seed, shared_prefix=0,
+                arrival=None):
     """[(arrival_s, prompt int32[?], max_new_tokens)] sorted by arrival.
     mnt_choices is a SMALL set so every mode compiles a bounded number
     of programs. shared_prefix > 0 prepends one fixed token header to
     EVERY prompt (the common-system-prompt serving shape the prefix
-    cache exists for)."""
+    cache exists for). ``arrival`` (see :func:`parse_arrival`) splits
+    the SCHEDULE draws (inter-arrival gaps, prompt lengths, mnt
+    choices) onto their own seeded RNG, leaving ``seed`` to govern
+    content only."""
     rng = np.random.RandomState(seed)
-    arrivals = np.cumsum(rng.exponential(1.0 / rate, n))
+    sched = rng if arrival is None else np.random.RandomState(arrival)
+    arrivals = np.cumsum(sched.exponential(1.0 / rate, n))
     header = (rng.randint(0, 256, (shared_prefix,)).astype(np.int32)
               if shared_prefix else None)
     lo = min(shared_prefix + 2, max_prompt)
     trace = []
     for t in arrivals:
-        plen = int(rng.randint(max(lo, 2), max_prompt + 1))
+        plen = int(sched.randint(max(lo, 2), max_prompt + 1))
         prompt = rng.randint(0, 256, (plen,)).astype(np.int32)
         if header is not None:
             prompt[:shared_prefix] = header
-        trace.append((float(t), prompt, int(rng.choice(mnt_choices))))
+        trace.append((float(t), prompt, int(sched.choice(mnt_choices))))
+    return trace
+
+
+def build_session_trace(groups, group_size, rate, header_tokens,
+                        tail_lo, tail_hi, mnt_choices, seed,
+                        arrival=None):
+    """Multi-session shared-prefix workload for the FLEET modes: ``groups``
+    sessions, each with its own fixed ``header_tokens``-token header
+    (system prompt), ``group_size`` requests per session with random
+    tails, arrival order interleaved across sessions by the schedule
+    RNG. Returns ``[(arrival_s, group_id, prompt, mnt)]``. This is the
+    workload where routing decides the hit rate: affinity keeps each
+    session's header on ONE replica (~1 cold prefill per session);
+    round-robin scatters it over N cold tries."""
+    rng = np.random.RandomState(seed)
+    sched = rng if arrival is None else np.random.RandomState(arrival)
+    headers = [rng.randint(0, 256, (header_tokens,)).astype(np.int32)
+               for _ in range(groups)]
+    order = np.repeat(np.arange(groups), group_size)
+    sched.shuffle(order)
+    arrivals = np.cumsum(sched.exponential(1.0 / rate, order.size))
+    trace = []
+    for t, g in zip(arrivals, order):
+        tail = rng.randint(0, 256,
+                           (int(sched.randint(tail_lo, tail_hi + 1)),)
+                           ).astype(np.int32)
+        prompt = np.concatenate([headers[int(g)], tail])
+        trace.append((float(t), int(g), prompt,
+                      int(sched.choice(mnt_choices))))
     return trace
 
 
@@ -804,6 +864,217 @@ class Bench:
                               and exact),
         }
 
+    # ------------------------------------------------------- fleet mode ----
+    def _session_trace(self):
+        a = self.args
+        header = a.fleet_header or max(2 * a.page_size, 16)
+        header = min(header, a.max_prompt - 6)
+        tail_lo, tail_hi = 4, max(5, a.max_prompt - header)
+        mnts = [m for m in a.mnt_choices if m <= 16] or \
+            [min(a.mnt_choices)]
+        return build_session_trace(
+            a.fleet_groups, a.fleet_group_size, a.rate, header,
+            tail_lo, tail_hi, mnts, a.seed,
+            arrival=parse_arrival(a.arrival)), header
+
+    def _fleet_run(self, n, policy, strace, *, paced=True,
+                   sequential=True, kill_at=None):
+        """One fleet arm over ``[(arrival, group, prompt, mnt)]``.
+
+        ``sequential=True`` replays each group as a MULTI-TURN session
+        (one thread per session; turn k+1 submits only after turn k's
+        reply completed — the traffic shape whose prefix re-hits the
+        router must keep warm). ``sequential=False, paced=False`` is
+        the flood: every request submitted up front, wall = pure
+        service time (the tok/s scaling arm). ``kill_at=i`` runs the
+        kill-one-replica scenario: after the i-th accepted submission
+        the first serving replica is killed (drain-on-failure:
+        admission stops, in-flight finish, queued hand back +
+        re-dispatch) while submission continues — the zero-drop claim
+        is checked on EVERY handle, the killed replica's accepted
+        requests included."""
+        from collections import defaultdict
+
+        from paddle_tpu.serving.fleet import SERVING, ServingFleet
+        fleet = ServingFleet(lambda: self._mk_engine(), replicas=n,
+                             policy=policy)
+        fleet.arm_sentinels()
+        nreq = len(strace)
+        handles = [None] * nreq
+        state = {"submitted": 0, "kill_started": False, "kill": None}
+        klock = threading.Lock()
+        t0 = time.perf_counter()
+
+        def _maybe_kill():
+            with klock:
+                if (kill_at is None or state["kill_started"]
+                        or state["submitted"] < kill_at):
+                    return
+                state["kill_started"] = True
+            victim = min(fleet.replicas(SERVING), key=lambda r: r.name)
+            handed = fleet.kill(victim.name)
+            with klock:
+                state["kill"] = {"killed": victim.name,
+                                 "at_request": int(kill_at),
+                                 "handed_back": len(handed)}
+
+        def _one(idx, arrival, prompt, mnt, wait_done):
+            if paced:
+                now = time.perf_counter() - t0
+                if now < arrival:
+                    time.sleep(arrival - now)
+            try:
+                handles[idx] = fleet.submit(prompt, mnt)
+            except BaseException:
+                return                  # counted as a drop below
+            with klock:
+                state["submitted"] += 1
+            _maybe_kill()
+            if wait_done:
+                try:
+                    handles[idx].result(timeout=600)
+                except BaseException:
+                    pass                # judged in the collect pass
+
+        if sequential:
+            sessions = defaultdict(list)
+            for idx, (arr, g, prompt, mnt) in enumerate(strace):
+                sessions[g].append((idx, arr, prompt, mnt))
+
+            def _run_session(items):
+                for idx, arr, prompt, mnt in items:
+                    _one(idx, arr, prompt, mnt, wait_done=True)
+
+            threads = [threading.Thread(target=_run_session,
+                                        args=(items,), daemon=True)
+                       for items in sessions.values()]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join()
+        else:
+            for idx, (arr, g, prompt, mnt) in enumerate(strace):
+                _one(idx, arr, prompt, mnt, wait_done=False)
+        drops, useful, ttfts = 0, 0, []
+        for h in handles:
+            if h is None:
+                drops += 1
+                continue
+            try:
+                out = h.result(timeout=600)
+            except BaseException:
+                drops += 1
+                continue
+            if h.status != "completed":
+                drops += 1
+                continue
+            useful += len(out)
+            if h.ttft_s is not None:
+                ttfts.append(h.ttft_s)
+        wall = time.perf_counter() - t0
+        kill_info = state["kill"]
+        snap = fleet.snapshot()
+        sentinels = {rep.name: rep.sentinel_report()
+                     for rep in fleet.replicas()}
+        fleet.close()
+        agg = {k: 0 for k in ("completed", "tokens_out", "prefix_hits",
+                              "prefix_misses", "handed_back")}
+        per_replica = {}
+        for name, rh in snap["replicas"].items():
+            c = rh.get("counters")
+            if not c:
+                continue
+            for k in agg:
+                agg[k] += c.get(k, 0)
+            denom = max(c["prefix_hits"] + c["prefix_misses"], 1)
+            per_replica[name] = {
+                "state": rh["state"], "role": rh["role"],
+                "completed": int(c["completed"]),
+                "tokens_out": int(c["tokens_out"]),
+                "prefix_hit_rate": round(c["prefix_hits"] / denom, 3)}
+        denom = max(agg["prefix_hits"] + agg["prefix_misses"], 1)
+        row = _report(f"fleet[{policy}]x{n}", wall, useful, ttfts)
+        row.update(
+            replicas=n, policy=policy,
+            prefix_hit_rate=round(agg["prefix_hits"] / denom, 3),
+            drops=int(drops), completed=int(agg["completed"]),
+            per_replica=per_replica,
+            router=dict(snap["router"]), generation=snap["generation"])
+        if kill_info is not None:
+            survivors_clean = all(
+                s is None or s["clean"] for name, s in sentinels.items()
+                if name != kill_info["killed"])
+            kill_info.update(
+                redispatched=snap["router"]["redispatched"],
+                redispatch_failed=snap["router"]["redispatch_failed"],
+                drops=int(drops),
+                zero_drops=bool(drops == 0),
+                sentinel_clean_survivors=bool(survivors_clean))
+            row["kill"] = kill_info
+        return row
+
+    def run_fleet(self, trace):
+        """ISSUE r18 acceptance mode (``--replicas N``). Arms, one
+        JSON row:
+
+        * **sessions** — the multi-session shared-prefix workload
+          (multi-turn: turn k+1 follows turn k's reply) under
+          prefix-affinity routing vs forced round-robin, plus a
+          single-replica baseline. This is where the hit rate lives:
+          affinity keeps each session's header chain on one replica
+          (~1 cold prefill per session); round-robin scatters it cold.
+        * **flood** — the plain mixed trace, all requests submitted up
+          front, 1 vs N replicas: aggregate tok/s scaling
+          (``speedup_vs_single``). On the shared-CPU mesh this
+          measures in-process contention more than fleet capacity
+          (docs/SERVING.md "Fleet" discusses the measured ceiling);
+          the N-process multi-host number is the real target.
+        * **kill** (unless ``--no-kill``) — kill-one-replica during
+          the flood: drain-on-failure, queued hand-back +
+          re-dispatch, submission continuing throughout; reports
+          zero-drop status and survivor sentinel cleanliness.
+        """
+        a = self.args
+        n = max(a.replicas, 2)
+        strace, header = self._session_trace()
+        single_s = self._fleet_run(1, "affinity", strace)
+        aff = self._fleet_run(n, "affinity", strace)
+        rr = self._fleet_run(n, "round_robin", strace)
+        ftrace = [(arr, 0, p, mnt) for arr, p, mnt in trace]
+        flood_1 = self._fleet_run(1, "affinity", ftrace, paced=False,
+                                  sequential=False)
+        flood_n = self._fleet_run(n, "affinity", ftrace, paced=False,
+                                  sequential=False)
+        out = {
+            "mode": "fleet", "replicas": n,
+            "workload": {
+                "groups": a.fleet_groups,
+                "group_size": a.fleet_group_size,
+                "header_tokens": int(header),
+                "session_requests": len(strace),
+                "flood_requests": len(ftrace),
+                "arrival": a.arrival or f"seed:{a.seed} (legacy)"},
+            "sessions": {"single": single_s, "affinity": aff,
+                         "round_robin": rr},
+            "flood": {"single": flood_1, "fleet": flood_n},
+            "speedup_vs_single": round(
+                flood_n["tok_s"] / max(flood_1["tok_s"], 1e-9), 2),
+            "hit_rate_affinity": aff["prefix_hit_rate"],
+            "hit_rate_round_robin": rr["prefix_hit_rate"],
+            "affinity_beats_round_robin": bool(
+                aff["prefix_hit_rate"] > rr["prefix_hit_rate"]),
+            "hit_rate_target_met": bool(
+                aff["prefix_hit_rate"] >= 0.90),
+        }
+        if not a.no_kill:
+            kill_at = max(1, int(0.4 * len(ftrace)))
+            kill_row = self._fleet_run(n, "affinity", ftrace,
+                                       paced=False, sequential=False,
+                                       kill_at=kill_at)
+            out["kill"] = kill_row["kill"]
+            out["kill"]["completed"] = kill_row["completed"]
+        return out
+
     def _tick_chain(self, kind, ctx=24, iters=12, reps=3):
         """Controlled pure-decode tick latency on matched state: all
         slots live at cache length ``ctx``, ``iters`` chained fused
@@ -926,6 +1197,27 @@ def main(argv=None):
                     help="spec_ab mode: tokens generated per request "
                          "(long enough that the repetitive attractor "
                          "dominates)")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="serving-fleet size for the fleet mode; "
+                         "passing N>1 selects the fleet mode when "
+                         "--modes was not given")
+    ap.add_argument("--arrival", default=None,
+                    help="seeded replayable arrival schedule, "
+                         "'seed:K': inter-arrival gaps + prompt-length "
+                         "+ mnt draws come from RandomState(K), "
+                         "independent of --seed (content) — the same "
+                         "spec replays the identical schedule")
+    ap.add_argument("--fleet-groups", type=int, default=8,
+                    help="fleet mode: distinct shared-prefix sessions "
+                         "(each gets its own system-prompt header)")
+    ap.add_argument("--fleet-group-size", type=int, default=12,
+                    help="fleet mode: requests per session")
+    ap.add_argument("--fleet-header", type=int, default=0,
+                    help="fleet mode: session header tokens "
+                         "(0 = max(2 pages, 16))")
+    ap.add_argument("--no-kill", action="store_true",
+                    help="fleet mode: skip the kill-one-replica "
+                         "scenario")
     ap.add_argument("--check-invariants", action="store_true",
                     help="run the paged-KV invariant checker "
                          "(analysis/kv_invariants.py) after every "
@@ -936,11 +1228,15 @@ def main(argv=None):
                     help="export the engine run's span timeline as "
                          "Perfetto-loadable Chrome-trace JSON (one "
                          "track per engine phase + per slot)")
-    ap.add_argument("--modes", nargs="+",
-                    default=["sequential", "batcher", "engine"],
+    ap.add_argument("--modes", nargs="+", default=None,
                     help="any of: sequential batcher engine prefix_ab "
-                         "ragged_ab trace_overhead spec_ab")
+                         "ragged_ab trace_overhead spec_ab fleet "
+                         "(default: sequential batcher engine, or "
+                         "fleet when --replicas > 1)")
     args = ap.parse_args(argv)
+    if args.modes is None:
+        args.modes = (["fleet"] if args.replicas > 1
+                      else ["sequential", "batcher", "engine"])
     if (args.shared_prefix and args.shared_prefix >= args.max_prompt
             and any(m != "prefix_ab" for m in args.modes)):
         # trace prompts are capped at --max-prompt; prefix_ab picks its
@@ -955,9 +1251,11 @@ def main(argv=None):
     bench = Bench(args)
     trace = build_trace(args.requests, args.rate, args.max_prompt,
                         args.mnt_choices, args.seed,
-                        shared_prefix=args.shared_prefix)
+                        shared_prefix=args.shared_prefix,
+                        arrival=parse_arrival(args.arrival))
     bench.warmup([m for m in args.modes
-                  if m not in ("prefix_ab", "ragged_ab", "spec_ab")])
+                  if m not in ("prefix_ab", "ragged_ab", "spec_ab",
+                               "fleet")])
     results = {}
     for mode in args.modes:
         results[mode] = getattr(bench, f"run_{mode}")(list(trace))
