@@ -11,8 +11,10 @@ model already exists as a static pass. This module closes ROADMAP item
    schedule x zero stage x dtype, pruned by the SAME legality the
    executors enforce — divisibility (layers per stage chunk, heads per
    tp shard, batch per microbatch per dp shard), the schedule table
-   (``parallel.pipeline_async.schedule_legality``: the dp=tp=1
-   restriction on ``1f1b_async``/``zb``, ZB's V=1, interleaved M % S),
+   (``parallel.pipeline_async.schedule_legality``: ZB's V=1,
+   interleaved M % S — the old dp=tp=1 restriction on
+   ``1f1b_async``/``zb`` fell in r19 when the executors composed
+   dp/tp into the shard_map, which widened this search automatically),
    and zero-stage applicability (needs dp > 1). Every pruned search
    branch is counted by reason — the search space is auditable, not
    implicit.
@@ -31,8 +33,8 @@ model already exists as a static pass. This module closes ROADMAP item
      compiled single-device reference step per dtype
      (``hbm.xla_cost_analysis``; closed-form fallback when the backend
      omits the counters), scaled by the point's shard denominators,
-     multiplied by the schedule's work factor (zb's W recompute is
-     5/4 — ``SCHEDULE_INFO``), and divided by
+     multiplied by the schedule's work factor (zb's residual-ring W
+     is 4.5/4 since r19 — ``SCHEDULE_INFO``), and divided by
      ``schedule_efficiency(pp, M, V)``.
    * *comms* — explicit collectives priced from the trace
      (``collectives.collective_cost_bytes``: the async schedules'
@@ -401,9 +403,16 @@ def price_plan_point(point: PlanPoint, base_cfg, *, batch_size: int,
         p2 = estimate_hbm_peak(t2).peak_bytes
         slope = max(0, p2 - p1) / (b2 - b1)
         peak = int(p1 + slope * (B - b1))
-        # explicit collective payloads are microbatch activations —
-        # they scale with batch rows
-        coll_b = int(collective_cost_bytes(t1.jaxpr) * (B / b1))
+        # explicit collective payloads split into batch-scaling
+        # microbatch activations (the ppermute pairs, in-body tp
+        # all-reduces) and batch-INDEPENDENT terms (the composed
+        # schedules' folded dp gradient psum is param-shaped) — the
+        # same two-proxy-point affine extrapolation as the HBM peak
+        # separates slope from intercept instead of scaling both
+        c1 = collective_cost_bytes(t1.jaxpr)
+        c2 = collective_cost_bytes(t2.jaxpr)
+        c_slope = max(0, c2 - c1) / (b2 - b1)
+        coll_b = int(c1 + c_slope * (B - b1))
     fits = (hbm_budget_bytes is None
             or peak <= int(hbm_budget_bytes))
 
@@ -432,14 +441,22 @@ def price_plan_point(point: PlanPoint, base_cfg, *, batch_size: int,
     # param bytes depend only on dtype — reference_step_costs already
     # computed them once per dtype
     pbytes_dev = ref["param_bytes"] / (point.tp * point.pp)
-    if point.dp > 1:
+    # composed async points (r19) carry their dp gradient psum and tp
+    # activation all-reduces EXPLICITLY in the traced program (the
+    # shard_map body's manual collectives, already in coll_b above) —
+    # the analytic terms below model only what GSPMD still inserts at
+    # compile time, so adding them for those points would double-count
+    async_exec = (point.pp > 1
+                  and SCHEDULE_INFO[point.schedule].executor is not None)
+    if point.dp > 1 and not async_exec:
         # gradient all-reduce (ZeRO>=1: reduce-scatter + gather moves
         # the same total wire bytes)
         comms_bytes += 2.0 * (point.dp - 1) / point.dp * pbytes_dev
-        if point.zero_stage >= 3:
-            # parameter regather at use (fwd) + re-scatter of updates
-            comms_bytes += 2.0 * (point.dp - 1) / point.dp * pbytes_dev
-    if point.tp > 1:
+    if point.dp > 1 and point.zero_stage >= 3:
+        # parameter regather at use (fwd) + re-scatter of updates
+        # (outside the shard_map even for composed async points)
+        comms_bytes += 2.0 * (point.dp - 1) / point.dp * pbytes_dev
+    if point.tp > 1 and not async_exec:
         import jax.numpy as jnp
         act = (B / point.dp) * seq_len * base_cfg.hidden_size \
             * jnp.dtype(point.dtype).itemsize

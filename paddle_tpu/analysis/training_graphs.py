@@ -29,9 +29,14 @@ __all__ = ["TRAIN_GEOMETRIES", "training_targets", "train_step_target",
 
 #: name -> mesh degrees + schedule knobs. The acceptance geometries:
 #: plain dp, dp x mp(tp), pp (lockstep 1F1B + interleaved VPP),
-#: dp-zero-sharded optimizer state, and the rank-asymmetric schedules
+#: dp-zero-sharded optimizer state, the rank-asymmetric schedules
 #: (pipeline_async: classic per-rank 1F1B at pp=4, ZB-H1 W-deferral at
-#: pp=2 with M NOT divisible by pp — the ragged-microbatch case).
+#: pp=2 with M NOT divisible by pp — the ragged-microbatch case), and
+#: the COMPOSED async geometries (r19: dp and tp inside the shard_map
+#: stage body — manual in-body collectives, dp grad psum in the f32
+#: carry) so sharding-lint / donation-audit / hbm-peak /
+#: collective-consistency all walk the composed programs under
+#: ``graph_lint --ci``.
 TRAIN_GEOMETRIES: Dict[str, Dict] = {
     "dp":      dict(dp=2, tp=1, pp=1, vpp=1, microbatches=1,
                     zero_stage=0),
@@ -43,6 +48,10 @@ TRAIN_GEOMETRIES: Dict[str, Dict] = {
                     zero_stage=0, schedule="zb"),
     "pp4_async": dict(dp=1, tp=1, pp=4, vpp=1, microbatches=8,
                       zero_stage=0, schedule="1f1b_async"),
+    "pp2_dp2_zb": dict(dp=2, tp=1, pp=2, vpp=1, microbatches=4,
+                       zero_stage=0, schedule="zb"),
+    "pp2_tp2_async": dict(dp=1, tp=2, pp=2, vpp=1, microbatches=4,
+                          zero_stage=0, schedule="1f1b_async"),
     "zero1":   dict(dp=4, tp=2, pp=1, vpp=1, microbatches=1,
                     zero_stage=1),
 }
@@ -149,15 +158,18 @@ def build_train_target(g: Dict, geometry: str, *,
     state_specs = L.train_state_specs(cfg, mesh, optimizer,
                                       g["zero_stage"])
     if batch_size is None:
-        # default: the smallest batch >= 4 that splits into the
-        # geometry's M microbatches (pp2_zb runs M=5 — the
-        # M-not-divisible-by-pp case — so a fixed 4 wouldn't divide)
-        M = g["microbatches"]
-        batch_size = M * max(1, -(-4 // M))
-    elif batch_size % g["microbatches"]:
+        # default: the smallest batch >= 4 whose per-microbatch rows
+        # split evenly over dp (the composed async shard_map REQUIRES
+        # even row splits; pp2_zb runs M=5 — the M-not-divisible-by-pp
+        # case — so a fixed 4 wouldn't divide either)
+        M, dp = g["microbatches"], g["dp"]
+        batch_size = M * dp * max(1, -(-4 // (M * dp)))
+    elif batch_size % (g["microbatches"] * g["dp"]):
         raise ValueError(
             f"batch_size={batch_size} does not split into geometry "
-            f"{geometry!r}'s {g['microbatches']} microbatches")
+            f"{geometry!r}'s {g['microbatches']} microbatches of "
+            f"dp={g['dp']}-divisible rows (the composed async "
+            f"shard_map requires even row splits)")
     sds = jax.ShapeDtypeStruct
     batch = {"tokens": sds((batch_size, seq_len), jnp.int32),
              "labels": sds((batch_size, seq_len), jnp.int32)}
@@ -331,6 +343,7 @@ def schedule_inventory(geometries=None) -> Dict:
             sched = build_schedule(S, M, V, model)
             entry["phases"] = sched.op_counts()
             entry["saved_ring_depth"] = {"acts": sched.depth_x,
-                                         "cotangents": sched.depth_c}
+                                         "cotangents": sched.depth_c,
+                                         "residuals": sched.depth_r}
         out["geometries"][name] = entry
     return out

@@ -56,9 +56,13 @@ class LlamaConfig:
     # every tick runs every slot (fill/drain = masked work).
     # "1f1b_async": rank-asymmetric 1F1B (pipeline_async) — shard_map
     # body branching on stage index, reference per-rank bubble
-    # 1-(S-1)/(VM+S-1); requires dp=tp=1.
+    # 1-(S-1)/(VM+S-1); composes dp (row-sharded microbatches, grad
+    # psum folded into the f32 accumulation carry) and tp (manual
+    # megatron f/g collectives in the stage body, vocab-parallel CE
+    # in the head) since r19.
     # "zb": ZB-H1-style W-deferral on top of 1f1b_async
-    # (pipeline_zero_bubble.py counterpart); V=1, dp=tp=1.
+    # (pipeline_zero_bubble.py counterpart); V=1, W consumes
+    # ring-saved residuals (~4.5 work units vs the fused 4).
     pp_schedule: str = "gpipe"
     # interleaved VPP: chunks per device under the 1f1b schedule
     # (pipeline_parallel.py:1372 round-robin model partition)
@@ -550,6 +554,126 @@ ASYNC_PP_SCHEDULES = {k: var for k, (_, var) in PP_SCHEDULES.items()
                       if var is not None}
 
 
+def _tp_local_block(lp, h, positions, cfg: LlamaConfig, attn_fn):
+    """One transformer block on tp-LOCAL weight shards inside a
+    ``shard_map`` body — the manual-collective mirror of ``_block``
+    for the rank-asymmetric pipeline schedules, where GSPMD cannot
+    insert the tp collectives (and a raw in-body ``lax.psum`` would
+    transpose wrong under ``jax.vjp`` — parallel/mp_ops.py).
+
+    Megatron placement: the "f" op (identity fwd, psum bwd) sits on
+    each norm's OUTPUT, between the replicated math and the
+    column-parallel weights — downstream of every replicated weight,
+    so the backward psum completes the cotangent BEFORE it reaches the
+    norm and its gradient arrives COMPLETE on each tp rank; the "g" op
+    (psum fwd, identity bwd) completes the row-parallel outputs (wo,
+    w_down) — two activation all-reduces per block forward and two
+    backward, exactly the pattern the planner's analytic tp term
+    priced. Local head/ffn widths are derived from the SHARD shapes
+    (``wq.shape[-1] // head_dim``), so the same code runs at tp=1
+    unsharded."""
+    from ..parallel.mp_ops import (identity_fwd_psum_bwd,
+                                   psum_fwd_identity_bwd)
+    B, T, D = h.shape
+    Dh = cfg.head_dim
+    Hl = lp["wq"].shape[-1] // Dh
+    Hkvl = lp["wk"].shape[-1] // Dh
+    x = identity_fwd_psum_bwd(
+        rms_norm(h, lp["attn_norm"], cfg.rms_norm_eps), "tp")
+    q = (x @ lp["wq"]).reshape(B, T, Hl, Dh)
+    k = (x @ lp["wk"]).reshape(B, T, Hkvl, Dh)
+    v = (x @ lp["wv"]).reshape(B, T, Hkvl, Dh)
+    q, k = rope(q, k, positions, cfg.rope_theta, Dh)
+    o = attn_fn(q, k, v)
+    h = h + psum_fwd_identity_bwd(
+        o.reshape(B, T, Hl * Dh) @ lp["wo"], "tp")
+    x = identity_fwd_psum_bwd(
+        rms_norm(h, lp["mlp_norm"], cfg.rms_norm_eps), "tp")
+    h = h + psum_fwd_identity_bwd(
+        (jax.nn.silu(x @ lp["w_gate"]) * (x @ lp["w_up"]))
+        @ lp["w_down"], "tp")
+    return h
+
+
+def _async_stage_head_fns(cfg: LlamaConfig, mesh: Mesh):
+    """(stage_fn, head_fn) for ``pipeline_train_async``'s shard_map
+    body. tp=1 keeps the exact pre-r19 callables (GSPMD-free local
+    math, fused dense CE) so those traced programs are unchanged;
+    tp>1 switches to the manual-collective forms: ``_tp_local_block``
+    per layer and a vocab-parallel head (``final_norm`` replicated,
+    ``lm_head`` vocab-sharded, CE via the explicit-psum
+    ``vocab_parallel_cross_entropy``)."""
+    from ..ops.fused import (fused_softmax_cross_entropy,
+                             vocab_parallel_cross_entropy)
+    tp = mesh.shape.get("tp", 1) if mesh is not None else 1
+    if tp <= 1:
+        def stage_fn(chunk_params, xm):
+            return _scan_layers(chunk_params, xm, cfg, None,
+                                remat=cfg.remat)
+
+        def head_fn(hp, y, y_labels):
+            h = rms_norm(y, hp["final_norm"], cfg.rms_norm_eps)
+            return fused_softmax_cross_entropy(
+                h @ hp["lm_head"], y_labels).mean()
+        return stage_fn, head_fn
+
+    H, Hkv = cfg.num_attention_heads, cfg.num_key_value_heads
+    F, V = cfg.intermediate_size, cfg.vocab_size
+    bad = {k: n for k, n in
+           dict(heads=H, kv_heads=Hkv, ffn=F, vocab=V).items()
+           if n % tp}
+    if bad:
+        raise ValueError(
+            f"tp={tp} does not divide {bad} — the async schedules "
+            f"shard heads/ffn/vocab over tp inside the stage body")
+    from ..ops.pallas.flash_attention import flash_attention as _fa
+    fa = cfg.use_flash_attention
+    impl = fa if isinstance(fa, str) else ("auto" if fa else "dense")
+    attn_fn = lambda q, k, v: _fa(q, k, v, causal=True, impl=impl)
+    from ..parallel.mp_ops import identity_fwd_psum_bwd
+
+    def stage_fn(chunk_params, xm):
+        B, T, _ = xm.shape
+        positions = jnp.broadcast_to(jnp.arange(T), (B, T))
+        fn = lambda lp, hh: _tp_local_block(lp, hh, positions, cfg,
+                                            attn_fn)
+        if cfg.remat:
+            fn = jax.checkpoint(fn)
+
+        def body(carry, lp):
+            return fn(lp, carry), None
+
+        h, _ = lax.scan(body, xm, chunk_params)
+        return h
+
+    def head_fn(hp, y, y_labels):
+        h = identity_fwd_psum_bwd(
+            rms_norm(y, hp["final_norm"], cfg.rms_norm_eps), "tp")
+        return vocab_parallel_cross_entropy(
+            h @ hp["lm_head"], y_labels, "tp").mean()
+    return stage_fn, head_fn
+
+
+def _async_shard_specs(cfg: LlamaConfig, mesh: Mesh):
+    """(stage_specs, head_specs, x_spec, aux_specs) for the composed
+    async executor: per-leaf chunk-dim specs derived from the ONE
+    declared layout (``param_specs``), rows sharded over dp. The tail
+    of each layer spec (everything after the stacked-layer axis) IS
+    the chunk tail — the executor prepends its (V, pp) axes."""
+    dp_on = mesh.shape.get("dp", 1) > 1
+    tp_on = mesh.shape.get("tp", 1) > 1
+    pspecs = param_specs(cfg)
+    stage_specs = jax.tree_util.tree_map(
+        lambda s: P(None, *(tuple(s)[1:] if tp_on else ())),
+        pspecs["layers"], is_leaf=lambda v: isinstance(v, P))
+    head_specs = {"final_norm": P(),
+                  "lm_head": P(None, "tp") if tp_on else P()}
+    dp_ax = "dp" if dp_on else None
+    x_spec = P(None, dp_ax, None, None)
+    aux_specs = P(None, dp_ax, None)
+    return stage_specs, head_specs, x_spec, aux_specs
+
+
 def grads_1f1b(params, batch, cfg: LlamaConfig, mesh: Mesh):
     """(loss, grads) via an explicit fused fwd+bwd pipeline schedule:
     the lockstep 1F1B / interleaved-VPP scan (parallel/pipeline_1f1b.py,
@@ -588,10 +712,17 @@ def grads_1f1b(params, batch, cfg: LlamaConfig, mesh: Mesh):
     head_params = {"final_norm": params["final_norm"],
                    "lm_head": params["lm_head"]}
     if cfg.pp_schedule in ASYNC_PP_SCHEDULES:
+        a_stage, a_head = _async_stage_head_fns(cfg, mesh)
+        spec_kw = {}
+        if (mesh.shape.get("dp", 1) > 1 or mesh.shape.get("tp", 1) > 1):
+            sspecs, hspecs, xspec, aspecs = _async_shard_specs(cfg, mesh)
+            spec_kw = dict(stage_specs=sspecs, head_specs=hspecs,
+                           x_spec=xspec, aux_specs=aspecs)
         loss, gchunks, ghead, dx = pipeline_train_async(
-            stage_fn, head_fn, chunks, head_params, x_mb, labels_mb,
+            a_stage, a_head, chunks, head_params, x_mb, labels_mb,
             num_stages=S, virtual_chunks=V,
-            variant=ASYNC_PP_SCHEDULES[cfg.pp_schedule], mesh=mesh)
+            variant=ASYNC_PP_SCHEDULES[cfg.pp_schedule], mesh=mesh,
+            **spec_kw)
     else:
         loss, gchunks, ghead, dx = pipeline_train_1f1b(
             stage_fn, head_fn, chunks, head_params, x_mb, labels_mb,

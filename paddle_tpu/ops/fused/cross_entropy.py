@@ -86,17 +86,48 @@ def vocab_parallel_cross_entropy(logits, labels, axis_name: str,
     ``axis_name`` — the literal TPU translation of the reference kernel
     (mp_ops.py:414), three ``[B, T]`` collectives and no logits gather.
 
+    Differentiable INSIDE the shard_map body via a custom VJP: backward
+    is the closed-form ``softmax_local - onehot_local`` — purely local
+    math off the saved (globally reduced) lse, so an in-body
+    ``jax.vjp`` (the async pipeline head, parallel/pipeline_async.py)
+    never transposes a raw ``psum`` (which jax would turn into another
+    psum, over-counting by the axis size — see parallel/mp_ops.py).
+
     ``vocab_start`` defaults to ``axis_index * local_V``.
     """
     local_v = logits.shape[-1]
     if vocab_start is None:
         vocab_start = jax.lax.axis_index(axis_name) * local_v
+    return _vp_ce(logits, labels,
+                  jnp.asarray(vocab_start, jnp.int32), axis_name)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _vp_ce(logits, labels, vocab_start, axis_name: str):
+    return _vp_ce_fwd(logits, labels, vocab_start, axis_name)[0]
+
+
+def _vp_ce_fwd(logits, labels, vocab_start, axis_name):
     lf = logits.astype(jnp.float32)
     m = jax.lax.pmax(jnp.max(lf, axis=-1), axis_name)
-    s = jax.lax.psum(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1), axis_name)
+    s = jax.lax.psum(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1),
+                     axis_name)
     lse = jnp.log(s) + m
     local_ids = labels[..., None] - vocab_start
-    onehot = (jnp.arange(local_v, dtype=jnp.int32) == local_ids)
+    onehot = (jnp.arange(logits.shape[-1], dtype=jnp.int32) == local_ids)
     label_logit = jax.lax.psum(
         jnp.sum(jnp.where(onehot, lf, 0.0), axis=-1), axis_name)
-    return lse - label_logit
+    return lse - label_logit, (logits, labels, vocab_start, lse)
+
+
+def _vp_ce_bwd(axis_name, res, g):
+    logits, labels, vocab_start, lse = res
+    p = jnp.exp(logits.astype(jnp.float32) - lse[..., None])
+    local_ids = labels[..., None] - vocab_start
+    onehot = (jnp.arange(logits.shape[-1], dtype=jnp.int32) == local_ids)
+    grad = (p - jnp.where(onehot, 1.0, 0.0)) * g[..., None].astype(
+        jnp.float32)
+    return grad.astype(logits.dtype), None, None
+
+
+_vp_ce.defvjp(_vp_ce_fwd, _vp_ce_bwd)

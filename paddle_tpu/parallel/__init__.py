@@ -25,6 +25,10 @@ from .pipeline_async import (
     build_schedule,
     pipeline_train_async,
 )
+from .mp_ops import (
+    identity_fwd_psum_bwd,
+    psum_fwd_identity_bwd,
+)
 from .context_parallel import (
     ring_attention,
     ulysses_attention,

@@ -1,0 +1,78 @@
+"""Manual tensor-parallel collective ops for shard_map stage bodies.
+
+TPU-native counterpart of the reference's mp_ops.py identity/all-reduce
+pair (``_mp_allreduce`` / ``c_identity``, the megatron "f"/"g"
+operators): under GSPMD the compiler inserts these from sharding
+annotations, but inside a ``shard_map`` body — where the async pipeline
+schedules run their per-rank op tables — collectives are MANUAL, and
+``jax.vjp`` *inside* the body transposes a raw ``lax.psum`` to another
+``psum`` (measured on jax 0.4.37: an in-body pullback through a bare
+psum over-counts by the axis size; differentiating *through* the
+shard_map boundary is rewritten correctly, but the pipeline executors
+call ``jax.vjp`` per tick inside the body). These two ops pin the
+correct pair with ``custom_vjp``:
+
+  * :func:`psum_fwd_identity_bwd` — megatron "g": all-reduce in
+    forward (the row-parallel matmul's partial sums), identity in
+    backward (each rank's partial contributed linearly with
+    coefficient 1, so the cotangent passes through once).
+  * :func:`identity_fwd_psum_bwd` — megatron "f": identity in forward
+    (the replicated stream enters column-parallel weights), all-reduce
+    in backward (each rank's column shard contributes a partial input
+    cotangent; the sum re-completes it — and every replicated weight
+    consumed *upstream* of this op therefore receives a COMPLETE
+    gradient, which is why the executor never tp-psums grad
+    accumulators).
+
+Both are identity at axis size 1 (the psum is a no-op), so callers can
+apply them unconditionally on any mesh that names the axis.
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_fwd_identity_bwd(x, axis_name: str):
+    """All-reduce ``x`` over ``axis_name``; backward is identity.
+
+    Use after a ROW-parallel matmul (megatron "g"): the forward value
+    is a partial sum per rank, the completed activation's cotangent
+    flows back to each rank's partial exactly once."""
+    return jax.lax.psum(x, axis_name)
+
+
+def _g_fwd(x, axis_name):
+    return jax.lax.psum(x, axis_name), None
+
+
+def _g_bwd(axis_name, _res, ct):
+    return (ct,)
+
+
+psum_fwd_identity_bwd.defvjp(_g_fwd, _g_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def identity_fwd_psum_bwd(x, axis_name: str):
+    """Identity forward; all-reduce the cotangent over ``axis_name``.
+
+    Use where a tp-REPLICATED stream feeds column-parallel weights
+    (megatron "f"): each rank back-propagates a partial input
+    cotangent through its own column shard; the backward psum
+    re-completes it before it reaches the residual stream (and any
+    replicated weights upstream)."""
+    return x
+
+
+def _f_fwd(x, axis_name):
+    return x, None
+
+
+def _f_bwd(axis_name, _res, ct):
+    return (jax.lax.psum(ct, axis_name),)
+
+
+identity_fwd_psum_bwd.defvjp(_f_fwd, _f_bwd)

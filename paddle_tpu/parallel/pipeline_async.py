@@ -40,12 +40,15 @@ Variants (``schedule_ticks`` / ``schedule_efficiency`` model both):
     backward splits into B (input grads, critical path) and W (weight
     grads, deferred into bubble slots; backlog bounded by S so the
     saved-tensor ring stays O(S)). Span = 3VM + fill/drain remainder —
-    strictly above the 1F1B bound at every geometry. Honest cost: B
-    and W each re-run the stage forward inside their ``jax.vjp``
-    (a pullback cannot cross scan ticks), one extra stage forward per
-    microbatch-stage vs the fused backward — 5 work units per
-    microbatch-stage vs 4. docs/PERF.md quantifies when the bubble
-    buys it back.
+    strictly above the 1F1B bound at every geometry. Honest cost: B's
+    ``jax.vjp`` re-runs the stage forward (a pullback cannot cross
+    scan ticks), but its RESIDUALS — the pullback's own pytree leaves
+    — are ring-saved (interval-colored like the sx/sc rings, depths
+    still exactly M-independent), so W restores the saved pullback and
+    computes weight grads with NO second forward replay: ~4.5 work
+    units per microbatch-stage vs the fused backward's 4 (the dW pass
+    still re-walks the cotangent chain — docs/PERF.md r19 quantifies
+    the cut from the r14 5/4).
 
 Numerics are IDENTICAL to the lockstep schedule by construction: the
 same per-microbatch stage/head functions, f32 grad accumulation in the
@@ -54,10 +57,17 @@ exactness test doubles as a correctness pin for this module
 (tests/test_pipeline_async.py asserts loss+grads match lockstep and
 plain single-stage autodiff).
 
-Restrictions: requires a mesh with a ``pp`` axis of size
-``num_stages`` and no other partitioned axis (dp=tp=cp=1) — inside
-``shard_map`` the stage body is a single-device program; composing
-tp-sharding into the branches is future work (ROADMAP item 4).
+Mesh composition (r19, ROADMAP item 4's roll-forward): the shard_map
+now spans the FULL ``(dp, tp, pp)`` mesh. The op-table scan and the
+up/down ppermute pair run along ``pp`` exactly as before; ``dp``
+shards the microbatch rows (the caller's ``x_spec``), with the dp
+gradient psum folded into the f32 accumulation carry AFTER the scan —
+one psum per accumulator leaf, not per microbatch — and loss/ghead
+psum'd over dp×pp; ``tp`` shards the stage weights per the caller's
+``stage_specs``, with the stage/head bodies doing their own in-body
+collectives (models/llama.py `_tp_local_block`: megatron f/g custom
+ops from parallel/mp_ops.py + vocab-parallel CE). Axes other than
+dp/tp/pp (cp, ep) must still be size 1.
 """
 from __future__ import annotations
 
@@ -97,20 +107,24 @@ class ScheduleInfo:
     miss the other.
 
     ``work_units_per_mb_stage``: relative compute units one microbatch
-    costs one stage (F=1, fused backward=3). The zb variant's B/W split
-    re-runs the stage forward inside each ``jax.vjp`` — 5 units vs 4
-    (docs/PERF.md r14) — which the planner prices as a flop multiplier.
+    costs one stage (F=1, fused backward=3). The zb variant's B
+    re-runs the stage forward inside its ``jax.vjp`` and W re-walks
+    the cotangent chain from the ring-saved residuals (no second
+    forward replay — r19's residual-ring cut from the r14 5/4) —
+    ~4.5 units vs 4 (docs/PERF.md r19) — which the planner prices as
+    a flop multiplier.
     ``lockstep_masked_work``: the schedule executes every slot every
     tick, so (1 - efficiency) is REAL extra compute, not idle time.
     """
     name: str                   # LlamaConfig.pp_schedule value
     model: str                  # schedule_ticks/schedule_efficiency name
     executor: Optional[str]     # pipeline_async variant; None = lockstep
-    requires_dp1_tp1: bool      # shard_map stage body is single-device
+    requires_dp1_tp1: bool      # True only for a schedule whose stage
+    #                             body cannot compose dp/tp (none today)
     supports_vpp: bool          # virtual_chunks > 1 allowed
     vpp_needs_divisible_M: bool  # V>1 requires M % S == 0
     min_stages: int
-    work_units_per_mb_stage: int
+    work_units_per_mb_stage: float
     lockstep_masked_work: bool
 
     def to_dict(self) -> Dict[str, Any]:
@@ -127,14 +141,14 @@ SCHEDULE_INFO: Dict[str, ScheduleInfo] = {
         work_units_per_mb_stage=4, lockstep_masked_work=True),
     "1f1b_async": ScheduleInfo(
         name="1f1b_async", model="1f1b", executor="1f1b",
-        requires_dp1_tp1=True, supports_vpp=True,
+        requires_dp1_tp1=False, supports_vpp=True,
         vpp_needs_divisible_M=True, min_stages=2,
         work_units_per_mb_stage=4, lockstep_masked_work=False),
     "zb": ScheduleInfo(
         name="zb", model="zb", executor="zb",
-        requires_dp1_tp1=True, supports_vpp=False,
+        requires_dp1_tp1=False, supports_vpp=False,
         vpp_needs_divisible_M=True, min_stages=2,
-        work_units_per_mb_stage=5, lockstep_masked_work=False),
+        work_units_per_mb_stage=4.5, lockstep_masked_work=False),
 }
 assert set(SCHEDULE_INFO) == set(PP_SCHEDULES) and all(
     (i.model, i.executor) == PP_SCHEDULES[n]
@@ -154,6 +168,13 @@ def schedule_legality(name: str, *, num_stages: int,
     rejection tests), ``pipeline_train_async`` enforces the mesh-axis
     restriction at run time, and the planner prunes its search space
     with the same answers, so legality cannot drift between the three.
+
+    ``dp``/``tp`` are accepted for any schedule since r19 (the
+    executor composes both into the shard_map — model-level
+    divisibility like heads-per-tp-shard is the planner's/caller's
+    mesh-level check, not a schedule property); the parameters remain
+    so a future schedule that genuinely cannot compose can gate on
+    them via ``requires_dp1_tp1``.
     """
     info = SCHEDULE_INFO.get(name)
     if info is None:
@@ -194,10 +215,13 @@ class Schedule:
     (which (virtual chunk, microbatch) the op touches), ``slot_x`` /
     ``slot_c`` (saved-activation / saved-cotangent ring slots the op
     reads — for F with ``inject`` set, the slot it WRITES the injected
-    input to), ``inject`` (F consumes ``x[mb]`` instead of an arrival),
-    ``emit`` (B's dx is the stage-0 embedding cotangent), ``store_up``
-    / ``store_dn`` (ring slot where this rank stores the value arriving
-    on the up/down ppermute at the END of the tick; -1 = none/discard).
+    input to), ``slot_r`` (zb only: the residual-ring slot B WRITES its
+    pullback's residual leaves to and W READS them from — what lets W
+    skip the stage-forward replay), ``inject`` (F consumes ``x[mb]``
+    instead of an arrival), ``emit`` (B's dx is the stage-0 embedding
+    cotangent), ``store_up`` / ``store_dn`` (ring slot where this rank
+    stores the value arriving on the up/down ppermute at the END of
+    the tick; -1 = none/discard).
     """
     num_stages: int
     num_microbatches: int
@@ -206,11 +230,13 @@ class Schedule:
     ticks: int
     depth_x: int          # saved-activation ring depth (max over ranks)
     depth_c: int          # saved-cotangent ring depth
+    depth_r: int          # saved-residual ring depth (zb; 0 otherwise)
     kind: np.ndarray
     chunk: np.ndarray
     mb: np.ndarray
     slot_x: np.ndarray
     slot_c: np.ndarray
+    slot_r: np.ndarray
     inject: np.ndarray
     emit: np.ndarray
     store_up: np.ndarray
@@ -549,14 +575,20 @@ def build_schedule(num_stages: int, num_microbatches: int,
     # -- saved-value intervals per rank ------------------------------
     # ACT(v,s,m): stage input. Stored at arrival (end of the sender's F
     # tick) or, for stage-0 chunk-0 injects, during its own F tick;
-    # read by F (non-inject), B, and (zb) W's recompute.
+    # read by F (non-inject) and B (W consumes the residual ring, not
+    # the input — it never replays the stage forward).
     # CT(v,s,m): incoming cotangent. Stored at arrival / the FH tick;
     # read by B and (zb) W.
+    # RES(v,s,m) (zb): B's pullback residual leaves. Stored during the
+    # B tick, read once by W — the interval that prices the W-replay
+    # cut's memory.
     x_assign: Dict[int, Dict[Tuple[int, int], int]] = {}
     c_assign: Dict[int, Dict[Tuple[int, int], int]] = {}
+    r_assign: Dict[int, Dict[Tuple[int, int], int]] = {}
     depth_x = depth_c = 1
+    depth_r = 0
     for s in range(S):
-        xiv, civ = [], []
+        xiv, civ, riv = [], [], []
         for v in range(V):
             for m in range(M):
                 f_t = ftick[(v, s, m)]
@@ -568,7 +600,7 @@ def build_schedule(num_stages: int, num_microbatches: int,
                         store = ftick[(v - 1, S - 1, m)]
                     else:
                         store = ftick[(v, s - 1, m)]
-                xiv.append((store, last, (v, m)))
+                xiv.append((store, btick[(v, s, m)], (v, m)))
                 if v == V - 1 and s == S - 1:
                     c_store = f_t  # head ct, written during FH
                 else:
@@ -577,10 +609,17 @@ def build_schedule(num_stages: int, num_microbatches: int,
                     else:
                         c_store = btick[(v, s + 1, m)]
                 civ.append((c_store, last, (v, m)))
+                if zb:
+                    riv.append((btick[(v, s, m)], wtick[(v, s, m)],
+                                (v, m)))
         xa, dx = _alloc_slots(xiv)
         ca, dc = _alloc_slots(civ)
         x_assign[s], c_assign[s] = xa, ca
         depth_x, depth_c = max(depth_x, dx), max(depth_c, dc)
+        if zb:
+            ra, dr = _alloc_slots(riv)
+            r_assign[s] = ra
+            depth_r = max(depth_r, dr)
 
     # -- tables ------------------------------------------------------
     kind = np.zeros((T, S), np.int32)
@@ -588,6 +627,7 @@ def build_schedule(num_stages: int, num_microbatches: int,
     mb = np.zeros((T, S), np.int32)
     slot_x = np.zeros((T, S), np.int32)
     slot_c = np.zeros((T, S), np.int32)
+    slot_r = np.zeros((T, S), np.int32)
     inject = np.zeros((T, S), np.int32)
     emit = np.zeros((T, S), np.int32)
     store_up = np.full((T, S), -1, np.int32)
@@ -600,6 +640,8 @@ def build_schedule(num_stages: int, num_microbatches: int,
             slot_x[t, s] = x_assign[s][(v, m)]
             if k in (OP_B, OP_W) or (k == OP_FH):
                 slot_c[t, s] = c_assign[s][(v, m)]
+            if zb and k in (OP_B, OP_W):
+                slot_r[t, s] = r_assign[s][(v, m)]
             if k in (OP_F, OP_FH) and v == 0 and s == 0:
                 inject[t, s] = 1
             if k == OP_B and v == 0 and s == 0:
@@ -622,13 +664,26 @@ def build_schedule(num_stages: int, num_microbatches: int,
     return Schedule(
         num_stages=S, num_microbatches=M, virtual_chunks=V,
         variant=variant, ticks=T, depth_x=depth_x, depth_c=depth_c,
-        kind=kind, chunk=chunk, mb=mb, slot_x=slot_x, slot_c=slot_c,
-        inject=inject, emit=emit, store_up=store_up, store_dn=store_dn)
+        depth_r=depth_r, kind=kind, chunk=chunk, mb=mb, slot_x=slot_x,
+        slot_c=slot_c, slot_r=slot_r, inject=inject, emit=emit,
+        store_up=store_up, store_dn=store_dn)
 
 
 # ---------------------------------------------------------------------------
 # traced executor
 # ---------------------------------------------------------------------------
+
+def _spec_names(spec) -> set:
+    """Flat set of mesh-axis names a PartitionSpec mentions."""
+    out = set()
+    for entry in tuple(spec) if spec is not None else ():
+        if entry is None:
+            continue
+        for ax in (entry if isinstance(entry, (tuple, list))
+                   else (entry,)):
+            out.add(ax)
+    return out
+
 
 def pipeline_train_async(
     stage_fn: Callable[[Any, Any], Any],
@@ -642,7 +697,12 @@ def pipeline_train_async(
     virtual_chunks: int = 1,
     variant: str = "1f1b",
     mesh: Any,
+    stage_specs: Any = None,
+    head_specs: Any = None,
+    x_spec: Any = None,
+    aux_specs: Any = None,
     _schedule: Optional[Schedule] = None,
+    _drop_dp_grad_psum: bool = False,
 ):
     """One fused forward+backward pass under a rank-asymmetric schedule.
 
@@ -660,9 +720,35 @@ def pipeline_train_async(
     as the lockstep schedule, so loss and grads match it (pinned by
     tests/test_pipeline_async.py).
 
-    ``_schedule`` overrides the built schedule (tests use it to prove
-    a mutated schedule trips the analysis passes); everyone else lets
-    ``build_schedule`` construct and validate it.
+    Mesh composition (r19): the shard_map spans the FULL mesh, not a
+    pp-only one. ``dp`` shards the microbatch rows — ``x_spec`` /
+    ``aux_specs`` must name it when dp > 1 (each dp rank then runs the
+    schedule on its row shard; the gradient psum over dp is folded
+    into the f32 accumulation carry ONCE per accumulator leaf after
+    the scan, and loss/ghead are psum'd over dp×pp). ``tp`` shards the
+    stage weights per ``stage_specs`` (per-leaf PartitionSpecs over
+    the dims AFTER the leading ``V*S`` chunk axis) and the head per
+    ``head_specs`` — the stage/head callables are then responsible for
+    their own in-body tp collectives (``parallel.mp_ops`` f/g custom
+    ops; see models/llama.py ``_tp_local_block``) and must return
+    tp-COMPLETE cotangents and gradients (replicated leaves complete
+    on every tp rank, sharded leaves shard-local), which the megatron
+    f-op placement guarantees. All spec arguments default to the
+    pp-only behavior (everything else replicated).
+
+    zb's W ticks consume RING-SAVED residuals: B runs the one
+    forward+input-grad backward of its ``jax.vjp`` and stores the
+    pullback's own leaves into the residual ring (``slot_r``,
+    interval-colored, M-independent depth); W restores the pullback
+    and computes weight grads with no second forward replay (~4.5
+    work units per microbatch-stage vs the r14 replay's 5 — the
+    unused co-outputs of each pullback call are dead code XLA
+    eliminates per switch branch).
+
+    ``_schedule`` overrides the built schedule and
+    ``_drop_dp_grad_psum`` drops the folded dp gradient psum (tests
+    use both to prove mutations trip the analysis passes); everyone
+    else leaves them alone.
     """
     import jax
     import jax.numpy as jnp
@@ -679,15 +765,27 @@ def pipeline_train_async(
     if mesh.shape["pp"] != S:
         raise ValueError(f"mesh pp axis is {mesh.shape['pp']} but "
                          f"num_stages={S}")
+    dp_deg = int(mesh.shape.get("dp", 1))
     busy = {k: int(n) for k, n in mesh.shape.items()
-            if k != "pp" and int(n) > 1}
+            if k not in ("dp", "tp", "pp") and int(n) > 1}
     if busy:
         raise NotImplementedError(
-            f"rank-asymmetric schedules currently require every "
-            f"non-pp mesh axis to be size 1 (the shard_map stage body "
-            f"is a single-device program); got {busy}. Compose tp/dp "
-            f"into the stage body or use pp_schedule='1f1b' "
-            f"(lockstep) for pp x tp/dp meshes.")
+            f"rank-asymmetric schedules compose dp/tp/pp only; mesh "
+            f"axes {busy} must be size 1 (cp/ep inside the per-rank "
+            f"op-table scan is future work)")
+    if dp_deg > 1:
+        aux_leaves = jax.tree_util.tree_leaves(
+            aux_specs, is_leaf=lambda v: isinstance(v, P))
+        if ("dp" not in _spec_names(x_spec)
+                or not aux_leaves
+                or not all("dp" in _spec_names(sp)
+                           for sp in aux_leaves)):
+            raise ValueError(
+                "dp > 1 needs x_spec AND aux_specs sharding the "
+                "microbatch rows over 'dp' — with replicated inputs "
+                "the folded dp gradient psum would over-count by the "
+                "dp degree (and global-shaped labels would silently "
+                "broadcast against local rows in the head)")
     sched = _schedule if _schedule is not None else build_schedule(
         S, M, V, variant)
     zb = sched.variant == "zb"
@@ -696,9 +794,21 @@ def pipeline_train_async(
         lambda p: p.reshape((V, S) + p.shape[1:]), stage_params)
     rows_np = dict(
         kind=sched.kind, chunk=sched.chunk, mb=sched.mb,
-        slot_x=sched.slot_x, slot_c=sched.slot_c,
+        slot_x=sched.slot_x, slot_c=sched.slot_c, slot_r=sched.slot_r,
         inject=sched.inject, emit=sched.emit,
         store_up=sched.store_up, store_dn=sched.store_dn)
+
+    is_p = lambda v: isinstance(v, P)
+    if stage_specs is None:
+        chunk_in_specs: Any = P(None, "pp")
+    else:
+        chunk_in_specs = jax.tree_util.tree_map(
+            lambda sp: P(None, "pp", *tuple(sp)), stage_specs,
+            is_leaf=is_p)
+    head_in_specs = P() if head_specs is None else head_specs
+    x_in_spec = P() if x_spec is None else x_spec
+    aux_in_specs = P() if aux_specs is None else aux_specs
+    dx_out_spec = P("pp", *tuple(x_in_spec))
 
     def body(chunks, x_all, aux_all, hp):
         r = lax.axis_index("pp")
@@ -708,6 +818,25 @@ def pipeline_train_async(
         dt = x_all.dtype
         zero_mb = jnp.zeros(mb_shape, dt)
         rows_all = {k: jnp.asarray(v) for k, v in rows_np.items()}
+
+        # zb residual rings: the pullback of ONE stage vjp is a pytree
+        # whose leaves are exactly the residuals W needs — get their
+        # avals + treedef abstractly (zero equations traced) so the
+        # rings can live in the scan carry and W can rebuild the
+        # pullback from a ring slot instead of replaying the forward
+        if zb:
+            p_abs = jax.tree_util.tree_map(
+                lambda c: jax.ShapeDtypeStruct(c.shape[1:], c.dtype),
+                chunks_loc)
+            pull_abs = jax.eval_shape(
+                lambda pp_, xx: jax.vjp(stage_fn, pp_, xx)[1],
+                p_abs, jax.ShapeDtypeStruct(mb_shape, dt))
+            res_abs, res_tree = jax.tree_util.tree_flatten(pull_abs)
+            depth_r = max(int(sched.depth_r), 1)
+            sr0 = [jnp.zeros((depth_r,) + l.shape, l.dtype)
+                   for l in res_abs]
+        else:
+            res_tree, sr0 = None, []
 
         def pick(tree, v):
             return jax.tree_util.tree_map(
@@ -721,12 +850,13 @@ def pipeline_train_async(
                 buf, jnp.where(slot >= 0, val, cur), idx, 0)
 
         def tick(carry, row):
-            sx, sc, gacc, ghead, loss, dxbuf = carry
+            sx, sc, sr, gacc, ghead, loss, dxbuf = carry
             kind = row["kind"][r]
             v = row["chunk"][r]
             m = jnp.clip(row["mb"][r], 0, M - 1)
             sl_x = row["slot_x"][r]
             sl_c = row["slot_c"][r]
+            sl_r = row["slot_r"][r]
             inject = row["inject"][r]
             emit = row["emit"][r]
             p_v = pick(chunks_loc, v)
@@ -740,22 +870,33 @@ def pipeline_train_async(
             x_in = jnp.where(inject == 1, x_m, x_sl)
 
             def _idle():
-                return (sx, sc, zero_mb, zero_mb, gacc, ghead, loss,
-                        dxbuf)
+                return (sx, sc, sr, zero_mb, zero_mb, gacc, ghead,
+                        loss, dxbuf)
 
             def _f():
                 sx2 = lax.dynamic_update_index_in_dim(sx, x_in, sl_x, 0)
                 y = stage_fn(p_v, x_in).astype(dt)
-                return sx2, sc, y, zero_mb, gacc, ghead, loss, dxbuf
+                return (sx2, sc, sr, y, zero_mb, gacc, ghead, loss,
+                        dxbuf)
 
             def _b():
+                # ONE forward inside the vjp either way; zb ring-saves
+                # the pullback's residual leaves so W never replays it
+                # (the dp co-output is dead here and DCE'd by XLA)
+                _, pull = jax.vjp(stage_fn, p_v, x_in)
+                dp, dx = pull(ct_sl)
                 if zb:
-                    _, pull = jax.vjp(lambda xx: stage_fn(p_v, xx), x_in)
-                    (dx,) = pull(ct_sl)
+                    leaves = jax.tree_util.tree_leaves(pull)
+                    assert len(leaves) == len(sr), (
+                        f"pullback residual structure changed between "
+                        f"eval_shape ({len(sr)} leaves) and the B "
+                        f"trace ({len(leaves)})")
+                    sr2 = [lax.dynamic_update_index_in_dim(rb, l,
+                                                           sl_r, 0)
+                           for rb, l in zip(sr, leaves)]
                     gacc2 = gacc
                 else:
-                    _, pull = jax.vjp(stage_fn, p_v, x_in)
-                    dp, dx = pull(ct_sl)
+                    sr2 = sr
                     gacc2 = jax.tree_util.tree_map(
                         lambda g, d: g.at[v].add(d.astype(jnp.float32)),
                         gacc, dp)
@@ -764,7 +905,8 @@ def pipeline_train_async(
                                                keepdims=False)
                 dxbuf2 = lax.dynamic_update_index_in_dim(
                     dxbuf, jnp.where(emit == 1, dx, old), m, 0)
-                return sx, sc, zero_mb, dx, gacc2, ghead, loss, dxbuf2
+                return (sx, sc, sr2, zero_mb, dx, gacc2, ghead, loss,
+                        dxbuf2)
 
             def _fh():
                 sx2 = lax.dynamic_update_index_in_dim(sx, x_in, sl_x, 0)
@@ -776,19 +918,26 @@ def pipeline_train_async(
                     sc, dout.astype(dt), sl_c, 0)
                 ghead2 = jax.tree_util.tree_map(
                     lambda g, d: g + d.astype(jnp.float32), ghead, dhead)
-                return (sx2, sc2, zero_mb, zero_mb, gacc, ghead2,
+                return (sx2, sc2, sr, zero_mb, zero_mb, gacc, ghead2,
                         loss + loss_m.astype(jnp.float32), dxbuf)
 
             def _w():
-                _, pull = jax.vjp(lambda pp_: stage_fn(pp_, x_in), p_v)
-                (dp,) = pull(ct_sl)
+                # restore B's pullback from the residual ring: weight
+                # grads with NO stage-forward replay (the dx co-output
+                # is dead here and DCE'd by XLA)
+                leaves = [lax.dynamic_index_in_dim(rb, sl_r, 0,
+                                                   keepdims=False)
+                          for rb in sr]
+                pull = jax.tree_util.tree_unflatten(res_tree, leaves)
+                dp, _dx = pull(ct_sl)
                 gacc2 = jax.tree_util.tree_map(
                     lambda g, d: g.at[v].add(d.astype(jnp.float32)),
                     gacc, dp)
-                return sx, sc, zero_mb, zero_mb, gacc2, ghead, loss, dxbuf
+                return (sx, sc, sr, zero_mb, zero_mb, gacc2, ghead,
+                        loss, dxbuf)
 
             branches = [_idle, _f, _b, _fh] + ([_w] if zb else [])
-            (sx, sc, up, dn, gacc, ghead, loss, dxbuf) = lax.switch(
+            (sx, sc, sr, up, dn, gacc, ghead, loss, dxbuf) = lax.switch(
                 kind, branches)
 
             # unconditional neighbour exchange: identical collective
@@ -799,11 +948,12 @@ def pipeline_train_async(
                 dn, "pp", [(i, (i - 1) % S) for i in range(S)])
             sx = store_if(sx, up_in, row["store_up"][r])
             sc = store_if(sc, dn_in, row["store_dn"][r])
-            return (sx, sc, gacc, ghead, loss, dxbuf), None
+            return (sx, sc, sr, gacc, ghead, loss, dxbuf), None
 
         carry0 = (
             jnp.zeros((sched.depth_x,) + mb_shape, dt),
             jnp.zeros((sched.depth_c,) + mb_shape, dt),
+            sr0,
             jax.tree_util.tree_map(
                 lambda c: jnp.zeros(c.shape, jnp.float32), chunks_loc),
             jax.tree_util.tree_map(
@@ -811,22 +961,39 @@ def pipeline_train_async(
             jnp.zeros((), jnp.float32),
             jnp.zeros((M,) + mb_shape, dt),
         )
-        (sx, sc, gacc, ghead, loss, dxbuf), _ = lax.scan(
+        (sx, sc, sr, gacc, ghead, loss, dxbuf), _ = lax.scan(
             tick, carry0, rows_all)
-        loss = lax.psum(loss, "pp")          # only the last rank's is
-        ghead = jax.tree_util.tree_map(       # nonzero (head ops)
-            lambda g: lax.psum(g, "pp"), ghead)
+        # dp composition: each dp rank accumulated grads for ITS row
+        # shard of every microbatch — fold the dp reduction into the
+        # f32 accumulators, ONE psum per accumulator leaf (not per
+        # microbatch); loss/ghead reduce over dp x pp (pp because only
+        # the last rank's head ops are nonzero, as before)
+        red_axes = ("pp", "dp") if dp_deg > 1 else ("pp",)
+        if dp_deg > 1 and not _drop_dp_grad_psum:
+            gacc = jax.tree_util.tree_map(
+                lambda g: lax.psum(g, "dp"), gacc)
+        loss = lax.psum(loss, red_axes)
+        ghead = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, red_axes), ghead)
         gacc_out = jax.tree_util.tree_map(
             lambda g: g.reshape((V, 1) + g.shape[1:]), gacc)
         return loss, gacc_out, ghead, dxbuf[None]
 
+    if stage_specs is None:
+        gacc_out_specs: Any = P(None, "pp")
+    else:
+        gacc_out_specs = chunk_in_specs
     fn = shard_map(
         body, mesh=mesh,
-        in_specs=(P(None, "pp"), P(), P(), P()),
-        out_specs=(P(), P(None, "pp"), P(), P("pp")),
+        in_specs=(chunk_in_specs, x_in_spec, aux_in_specs,
+                  head_in_specs),
+        out_specs=(P(), gacc_out_specs, head_in_specs, dx_out_spec),
         check_vma=False)
     loss, gchunks, ghead, dxs = fn(chunks_vs, x, aux, head_params)
-    inv_m = 1.0 / M
+    # mean over the M microbatches AND the dp row shards: each dp rank
+    # computed per-microbatch means over its rows/dp rows, so the
+    # dp-psum'd sums divide by M*dp
+    inv_m = 1.0 / (M * dp_deg)
     gchunks = jax.tree_util.tree_map(
         lambda g, p: (g.reshape((V * S,) + g.shape[2:]) * inv_m
                       ).astype(p.dtype),

@@ -809,7 +809,9 @@ def test_1f1b_schedule_trip_count_checked_and_mutation_caught(train_targets):
 
 
 @pytest.mark.parametrize("geom,model", [("pp2_zb", "zb"),
-                                        ("pp4_async", "1f1b")])
+                                        ("pp4_async", "1f1b"),
+                                        ("pp2_dp2_zb", "zb"),
+                                        ("pp2_tp2_async", "1f1b")])
 def test_async_schedule_trip_count_checked_and_mutation_caught(
         train_targets, geom, model):
     """The rank-asymmetric schedules are traced targets too: the

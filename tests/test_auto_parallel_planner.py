@@ -60,16 +60,19 @@ def smoke_plan():
 def test_smoke_ranks_nonempty_plan(smoke_plan):
     out = smoke_plan
     assert out["schema"] == "paddle_tpu.auto_parallel_plan/1"
-    assert out["legal"] >= 20, "flagship smoke space collapsed"
-    assert out["priced"] >= 20
+    # r19 lifted the dp=tp=1 restriction on the async schedules, so
+    # the same smoke space grew from 32 legal points to >= 50 (58 at
+    # the r19 flagship run) — and NO pruned reason may mention the
+    # old mesh-axis restriction anymore
+    assert out["legal"] >= 50, "composed smoke space collapsed"
+    assert out["priced"] >= 50
     assert out["plans"], "ranked plan is empty"
     # ranking is by the step-time proxy among fitting plans
     times = [p["cost"]["step_time_proxy_s"] for p in out["plans"]]
     assert times == sorted(times)
     assert all(p["cost"]["fits"] for p in out["plans"])
-    # the pruned space is auditable: the current dp=tp=1 restriction
-    # on the async schedules must show up as a counted reason
-    assert any("1f1b_async" in r for r in out["pruned"]), out["pruned"]
+    assert not any("non-pp mesh axis" in r or "dp=" in r
+                   for r in out["pruned"]), out["pruned"]
 
 
 def test_smoke_winner_trace_verifies(smoke_plan):
@@ -138,13 +141,63 @@ def test_schedule_legality_matches_builder():
                         f"legality says {reason!r}")
 
 
-def test_schedule_legality_dp_tp_restriction():
-    assert schedule_legality("1f1b_async", num_stages=2,
-                             num_microbatches=4, dp=2) is not None
-    assert schedule_legality("zb", num_stages=2,
-                             num_microbatches=4, tp=2) is not None
-    assert schedule_legality("1f1b", num_stages=2,
-                             num_microbatches=4, dp=2, tp=2) is None
+def test_schedule_legality_composed_dp_tp_legal():
+    """r19: the async schedules compose dp/tp — the legality table
+    must accept every (dp, tp) for every schedule (the executors run
+    them; model-level divisibility is the mesh-level prune)."""
+    for name in SCHEDULE_INFO:
+        if SCHEDULE_INFO[name].min_stages > 1:
+            assert schedule_legality(name, num_stages=2,
+                                     num_microbatches=4, dp=2,
+                                     tp=2) is None, name
+        assert not SCHEDULE_INFO[name].requires_dp1_tp1, name
+
+
+def test_enumeration_composes_async_points_at_devices_8():
+    """The acceptance pin: the widened search space contains composed
+    (dp·tp > 1) async-schedule points at the flagship devices=8 run —
+    the 4D north star can now ride the best schedules."""
+    points, pruned = enumerate_plan_points(8, CFG, batch_size=64)
+    composed = [p for p in points
+                if p.dp * p.tp > 1 and p.pp > 1
+                and SCHEDULE_INFO[p.schedule].executor is not None]
+    assert composed, "no composed async points enumerated"
+    # both axes individually and the full 3D mesh appear
+    assert any(p.dp > 1 and p.schedule == "zb" for p in composed)
+    assert any(p.tp > 1 and p.schedule == "1f1b_async"
+               for p in composed)
+    assert any(p.dp > 1 and p.tp > 1 for p in composed)
+    # the zb work factor the planner prices reflects the residual-ring
+    # recompute cut (r14's 5/4 -> r19's 4.5/4)
+    assert SCHEDULE_INFO["zb"].work_units_per_mb_stage == 4.5
+
+
+def test_composed_async_point_prices_and_verifies():
+    """A composed (dp>1) zb point prices with its in-body collectives
+    TRACED (collective_bytes > 0 — the folded dp grad psum and the
+    ppermute pairs; the analytic dp term is skipped for async points
+    so nothing double-counts), carries the 4.5/4 residual-ring work
+    factor, and trace-VERIFIES through the full registered pass stack
+    under the planner contract — the r19 acceptance loop in one
+    point."""
+    from paddle_tpu.analysis.planner import price_plan_point
+    pt = PlanPoint(dp=2, tp=1, pp=2, vpp=1, microbatches=4,
+                   schedule="zb", zero_stage=0, dtype="bfloat16")
+    ref = {"bfloat16": reference_step_costs(CFG, "bfloat16",
+                                            seq_len=8)}
+    cache = {}
+    cost = price_plan_point(pt, CFG, batch_size=8, seq_len=8,
+                            hbm_budget_bytes=None, ref_costs=ref,
+                            trace_cache=cache)
+    assert cost.collective_bytes > 0
+    assert cost.work_multiplier == pytest.approx(4.5 / 4)
+    ver = verify_plan(pt, CFG, batch_size=8, seq_len=8,
+                      hbm_budget_bytes=None,
+                      prediction=dict(cost.to_dict(),
+                                      point=pt.to_dict()),
+                      trace_cache=cache)
+    assert ver["ok"], ver["report"]
+    assert abs(ver["deltas"]["hbm_rel_delta"]) <= ver["tolerance"]
 
 
 # ---------------------------------------------------------------------------

@@ -65,6 +65,32 @@ def test_ignore_index_zero_loss_and_grad():
     np.testing.assert_array_equal(masked, np.zeros_like(masked))
 
 
+def test_vocab_parallel_in_body_grad_matches_dense():
+    """The r19 property the custom VJP exists for: ``jax.vjp`` taken
+    INSIDE the shard_map body (the async pipeline head does exactly
+    this per FH tick) returns the dense gradient — a raw in-body psum
+    would transpose to another psum and over-count by tp."""
+    from jax.experimental.shard_map import shard_map
+    devs = np.array(jax.devices()[:4]).reshape(4)
+    mesh = Mesh(devs, ("tp",))
+    V = 32
+    logits = jax.random.normal(jax.random.PRNGKey(7), (2, 6, V),
+                               jnp.float32)
+    labels = jax.random.randint(jax.random.PRNGKey(8), (2, 6), 0, V)
+
+    def in_body_grad(l, y):
+        _, pull = jax.vjp(
+            lambda ll: vocab_parallel_cross_entropy(ll, y,
+                                                    "tp").mean(), l)
+        return pull(jnp.ones(()))[0]
+
+    g = shard_map(in_body_grad, mesh=mesh,
+                  in_specs=(P(None, None, "tp"), P(None, None)),
+                  out_specs=P(None, None, "tp"))(logits, labels)
+    want = jax.grad(lambda l: naive_nll(l, labels).mean())(logits)
+    np.testing.assert_allclose(g, want, rtol=1e-5, atol=1e-6)
+
+
 def test_vocab_parallel_shard_map_matches_dense():
     from jax.experimental.shard_map import shard_map
     devs = np.array(jax.devices()[:8]).reshape(8)

@@ -104,7 +104,9 @@ def test_builder_saved_rings_are_o_sv_and_m_independent():
     allocator: saved-activation/cotangent ring depths are O(S·V) and
     DO NOT grow with M (GPipe's O(M) is exactly what this schedule
     exists to avoid; zb's W backlog is capped at S so deferral does
-    not reintroduce it)."""
+    not reintroduce it). The r19 RESIDUAL ring — what lets W skip the
+    stage-forward replay — is re-pinned to the same discipline: depth
+    exactly M-independent and bounded by the W backlog O(S)."""
     for S in (2, 4, 8):
         for V, var in ((1, "1f1b"), (1, "zb"), (2, "1f1b")):
             a = build_schedule(S, 2 * S, V, var)
@@ -113,6 +115,11 @@ def test_builder_saved_rings_are_o_sv_and_m_independent():
                 (S, V, var)
             assert b.depth_x <= 3 * S * V
             assert b.depth_c <= 2 * S * V
+            assert a.depth_r == b.depth_r, (S, V, var)
+            if var == "zb":
+                assert 1 <= b.depth_r <= S + 1, (S, b.depth_r)
+            else:
+                assert b.depth_r == 0
 
 
 def test_builder_rejections():
@@ -214,15 +221,89 @@ def test_async_train_step_losses_equal_lockstep_steps():
     assert losses["zb"][-1] < losses["zb"][0]
 
 
-def test_async_requires_pp_only_mesh():
-    hm = init_hybrid_mesh(dp=1, pp=2, tp=2, set_global=False)
-    cfg = _cfg(2, "1f1b_async", 1, 4)
+# ---------------------------------------------------------------------------
+# composed dp/tp numerics (r19): the 4D north star rides the best
+# schedules — every composed geometry must match the GSPMD lockstep
+# schedule (and, transitively, plain autodiff) at the same tolerances
+# as the dp=tp=1 grid above
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("dp,tp,pp,sched,M,B,vpp", [
+    (2, 1, 2, "zb", 4, 8, 1),           # dp composed into zb
+    (2, 1, 2, "1f1b_async", 4, 8, 1),   # dp composed into 1f1b
+    (1, 2, 2, "zb", 4, 4, 1),           # tp composed (manual colls)
+    (1, 2, 2, "1f1b_async", 4, 4, 1),
+    (2, 2, 2, "zb", 4, 8, 1),           # full 3D mesh
+    (2, 1, 2, "zb", 5, 10, 1),          # dp with M not divisible by pp
+    (1, 2, 2, "1f1b_async", 4, 4, 2),   # interleaved VPP under tp
+])
+def test_composed_matches_lockstep(dp, tp, pp, sched, M, B, vpp):
+    """dp/tp composed into the async shard_map: loss and every grad
+    match the lockstep (GSPMD) schedule at the dp=tp=1 grid's
+    tolerances — the r19 acceptance pin."""
+    hm = init_hybrid_mesh(dp=dp, pp=pp, tp=tp, set_global=False)
+    cfg_a = _cfg(pp, sched, vpp, M)
+    cfg_l = _cfg(pp, "1f1b", vpp, M)
+    params = L.init_params(cfg_a, jax.random.PRNGKey(0))
+    with hm.mesh:
+        batch = L.make_batch(cfg_a, batch_size=B, seq_len=16,
+                             mesh=hm.mesh)
+        loss_a, grads_a = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg_a, hm.mesh))(params,
+                                                             batch)
+        loss_l, grads_l = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg_l, hm.mesh))(params,
+                                                             batch)
+    np.testing.assert_allclose(loss_a, loss_l, rtol=1e-6, atol=1e-7)
+    _tree_close(grads_a, grads_l, rtol=2e-5, atol=1e-6)
+
+
+def test_composed_3d_matches_single_stage_autodiff():
+    """Absolute correctness of the full 3D composition: dp2 x tp2 x
+    pp2 zb against plain pp=1 value_and_grad on a 1-device mesh
+    (embedding + vocab-parallel head bracket included)."""
+    dp, tp, pp, M = 2, 2, 2, 4
+    hm = init_hybrid_mesh(dp=dp, pp=pp, tp=tp, set_global=False)
+    cfg = _cfg(pp, "zb", 1, M)
+    ref_cfg = _cfg(1, "gpipe", 1, 1)
     params = L.init_params(cfg, jax.random.PRNGKey(0))
     with hm.mesh:
-        batch = L.make_batch(cfg, batch_size=4, seq_len=16,
+        batch = L.make_batch(cfg, batch_size=2 * M, seq_len=32,
                              mesh=hm.mesh)
-        with pytest.raises(NotImplementedError, match="non-pp"):
-            L.grads_1f1b(params, batch, cfg, hm.mesh)
+        loss_p, grads_p = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg, hm.mesh))(params,
+                                                           batch)
+    hm1 = init_hybrid_mesh(dp=1, pp=1, tp=1, set_global=False)
+    with hm1.mesh:
+        loss_r, grads_r = jax.jit(
+            lambda p, b: jax.value_and_grad(L.loss_fn)(
+                p, b, ref_cfg, hm1.mesh))(params, batch)
+    np.testing.assert_allclose(loss_p, loss_r, rtol=1e-5, atol=1e-6)
+    _tree_close(grads_p, grads_r, rtol=2e-4, atol=1e-5)
+
+
+def test_async_rejects_cp_mesh_and_unsharded_dp_inputs():
+    """The composition covers dp/tp/pp only — a live cp axis still
+    rejects loudly; and an executor call with dp > 1 but replicated
+    inputs (no x_spec) must refuse rather than over-count grads by
+    the dp degree."""
+    from paddle_tpu.parallel.pipeline_async import pipeline_train_async
+    hm = init_hybrid_mesh(dp=1, pp=2, tp=1, cp=2, set_global=False)
+    stage = lambda p, x: x @ p["w"]
+    head = lambda hp, y, lbl: jnp.mean((y @ hp["wo"] - lbl) ** 2)
+    d = 4
+    sp = {"w": jnp.zeros((2, d, d))}
+    hp = {"wo": jnp.zeros((d, d))}
+    x = jnp.zeros((2, 2, d))
+    with hm.mesh:
+        with pytest.raises(NotImplementedError, match="cp"):
+            pipeline_train_async(stage, head, sp, hp, x, x,
+                                 num_stages=2, mesh=hm.mesh)
+    hm2 = init_hybrid_mesh(dp=2, pp=2, tp=1, set_global=False)
+    with hm2.mesh:
+        with pytest.raises(ValueError, match="x_spec"):
+            pipeline_train_async(stage, head, sp, hp, x, x,
+                                 num_stages=2, mesh=hm2.mesh)
 
 
 def test_bad_async_schedule_name_rejected():
@@ -269,6 +350,58 @@ def test_fp32_grad_accum_pinned_under_bf16():
                   if v.aval.dtype == jnp.bfloat16 and v.aval.ndim >= 3]
     assert len(f32_acc) >= 5, [v.aval for v in carry]   # gacc + ghead
     assert bf16_rings, [v.aval for v in carry]          # sx/sc rings
+    for leaf, ref in zip(jax.tree_util.tree_leaves(grads),
+                         jax.tree_util.tree_leaves(params)):
+        assert leaf.dtype == ref.dtype
+
+
+def test_fp32_grad_accum_pin_survives_dp_psum_in_carry():
+    """The composed-dp program keeps the same structural discipline:
+    f32 grad accumulators ride the schedule-scan carry, the dp
+    reduction is ONE psum per accumulator leaf on the f32 values
+    AFTER the scan (not per microbatch, not on the cast-back grads),
+    and returned grads land back in the param dtype."""
+    from paddle_tpu.core.graph_trace import iter_jaxpr_eqns
+    pp, M, dp = 2, 4, 2
+    cfg = _cfg(pp, "zb", 1, M, dtype=jnp.bfloat16)
+    hm = init_hybrid_mesh(dp=dp, pp=pp, tp=1, set_global=False)
+    params = L.init_params(cfg, jax.random.PRNGKey(0))
+    with hm.mesh:
+        batch = L.make_batch(cfg, batch_size=M * dp, seq_len=16,
+                             mesh=hm.mesh)
+        jaxpr = jax.make_jaxpr(
+            lambda p, b: L.grads_1f1b(p, b, cfg, hm.mesh))(params,
+                                                           batch)
+        grads = jax.jit(
+            lambda p, b: L.grads_1f1b(p, b, cfg, hm.mesh))(params,
+                                                           batch)[1]
+    T = schedule_ticks(pp, M, 1, schedule="zb")
+    sched_scans = [
+        eqn for _path, eqn in iter_jaxpr_eqns(jaxpr)
+        if eqn.primitive.name == "scan" and eqn.params["length"] == T]
+    assert sched_scans, "schedule scan not found in the traced program"
+    eqn = sched_scans[0]
+    carry = eqn.invars[eqn.params["num_consts"]:
+                       eqn.params["num_consts"] + eqn.params["num_carry"]]
+    f32_acc = [v for v in carry
+               if v.aval.dtype == jnp.float32 and v.aval.ndim >= 2]
+    assert len(f32_acc) >= 5, [v.aval for v in carry]
+    # the folded dp psum: f32 multi-dim psums OUTSIDE the scan, one
+    # per stage accumulator leaf (7 layer-param leaves) — none inside
+    n_dp_psums = 0
+    for path, e in iter_jaxpr_eqns(jaxpr):
+        if e.primitive.name != "psum":
+            continue
+        axes = e.params.get("axes", ())
+        in_scan = any(p[0] == "scan" for p in path)
+        if "dp" in axes and not in_scan:
+            assert all(o.aval.dtype == jnp.float32
+                       for o in e.outvars), e
+            n_dp_psums += sum(1 for o in e.outvars
+                              if o.aval.ndim >= 2)
+        assert not (("dp" in axes) and in_scan), \
+            "dp grad psum leaked inside the schedule scan"
+    assert n_dp_psums >= 7, n_dp_psums
     for leaf, ref in zip(jax.tree_util.tree_leaves(grads),
                          jax.tree_util.tree_leaves(params)):
         assert leaf.dtype == ref.dtype
@@ -344,7 +477,8 @@ def test_dropped_w_deferral_trips_consistency_and_corrupts_grads():
         sched, ticks=cut,
         **{f: getattr(sched, f)[:cut]
            for f in ("kind", "chunk", "mb", "slot_x", "slot_c",
-                     "inject", "emit", "store_up", "store_dn")})
+                     "slot_r", "inject", "emit", "store_up",
+                     "store_dn")})
 
     def stage_fn(p, x):
         return jnp.tanh(x @ p["w"])
@@ -377,5 +511,91 @@ def test_dropped_w_deferral_trips_consistency_and_corrupts_grads():
     good = jax.jit(lambda: run(sched))()
     bad = jax.jit(lambda: run(mutated))()
     np.testing.assert_allclose(good[0], bad[0], rtol=1e-6)  # loss ok
+    assert not np.allclose(np.asarray(good[1]["w"]),
+                           np.asarray(bad[1]["w"]), rtol=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# composed collectives are PRICED from the trace, and a dropped dp
+# psum is statically caught + concretely wrong (r19 satellite)
+# ---------------------------------------------------------------------------
+
+def test_composed_collectives_priced_from_trace():
+    """collective_cost_bytes must see the composed program's manual
+    in-body collectives — the folded dp grad psum and the per-block tp
+    all-reduces — not just the ppermute pairs: the composed traces
+    carry strictly more explicit wire bytes than the dp=tp=1 trace of
+    the same schedule, which is what lets the planner drop its
+    analytic dp/tp terms for async points."""
+    from paddle_tpu.analysis.collectives import collective_cost_bytes
+
+    def traced(dp, tp, B):
+        cfg = _cfg(2, "zb", 1, 4)
+        hm = init_hybrid_mesh(dp=dp, pp=2, tp=tp, set_global=False)
+        params = L.init_params(cfg, jax.random.PRNGKey(0))
+        with hm.mesh:
+            batch = L.make_batch(cfg, batch_size=B, seq_len=16,
+                                 mesh=hm.mesh)
+            return jax.make_jaxpr(
+                lambda p, b: L.grads_1f1b(p, b, cfg, hm.mesh))(params,
+                                                               batch)
+
+    base = collective_cost_bytes(traced(1, 1, 4))
+    with_dp = collective_cost_bytes(traced(2, 1, 8))
+    with_tp = collective_cost_bytes(traced(1, 2, 4))
+    assert base > 0                       # the ppermute pairs
+    assert with_dp > base                 # + folded dp grad psum
+    assert with_tp > base                 # + in-body tp all-reduces
+
+
+def test_dropped_dp_psum_trips_consistency_and_corrupts_grads():
+    """Seeded mutation: build the SAME composed-dp program with the
+    folded dp gradient psum dropped — the collective signature
+    diverges (collective-consistency stage-group compare fires, the
+    designated safety net) and the stage grads are concretely wrong
+    (each dp rank's partial accumulator escapes unreduced)."""
+    from paddle_tpu.analysis import (CollectiveConsistencyPass,
+                                     GraphTarget, Severity)
+    from paddle_tpu.parallel.pipeline_async import pipeline_train_async
+    S, M, dp = 2, 3, 2
+    d = 8
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    def head_fn(hp, y, lbl):
+        return jnp.mean((y @ hp["wo"] - lbl) ** 2)
+
+    sp = {"w": jax.random.normal(jax.random.PRNGKey(0), (S, d, d)) * .3}
+    hp = {"wo": jax.random.normal(jax.random.PRNGKey(1), (d, d)) * .3}
+    x = jax.random.normal(jax.random.PRNGKey(2), (M, 2 * dp, d))
+    lbl = jax.random.normal(jax.random.PRNGKey(3), (M, 2 * dp, d))
+    hm = init_hybrid_mesh(dp=dp, pp=S, tp=1, set_global=False)
+
+    def run(drop):
+        with hm.mesh:
+            return pipeline_train_async(
+                stage_fn, head_fn, sp, hp, x, lbl, num_stages=S,
+                variant="zb", mesh=hm.mesh,
+                x_spec=jax.sharding.PartitionSpec(None, "dp", None),
+                aux_specs=jax.sharding.PartitionSpec(None, "dp", None),
+                _drop_dp_grad_psum=drop)
+
+    with hm.mesh:
+        targets = [
+            GraphTarget(
+                name=f"toy.zb_dp[{'dropped' if drop else 'ok'}]",
+                jaxpr=jax.make_jaxpr(lambda drop=drop: run(drop))(),
+                meta={"stage_group": "toy.zb_dp_psum",
+                      "stage_count": 2,
+                      "signature_include_loops": True})
+            for drop in (False, True)]
+    cc = CollectiveConsistencyPass()
+    errs = [f for t in targets for f in cc.run(t)
+            if f.severity == Severity.ERROR]
+    assert errs and "collective" in errs[0].message
+    # and the grads really are wrong without the fold-in psum
+    good = jax.jit(lambda: run(False))()
+    bad = jax.jit(lambda: run(True))()
     assert not np.allclose(np.asarray(good[1]["w"]),
                            np.asarray(bad[1]["w"]), rtol=1e-3)

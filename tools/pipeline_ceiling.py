@@ -21,9 +21,17 @@ efficiency those ticks imply:
 Reference comparison columns: the interleaved-1F1B analytic bubble
 (pipeline_parallel.py forward_backward_pipeline, VPP chunks V).
 
+Composed geometries (r19): ``--dp``/``--tp`` run the SAME tick-count
+A/B with data/tensor parallelism composed into the async schedules'
+shard_map (the op-table scan is along pp only, so tick counts — and
+therefore the efficiency columns — must be IDENTICAL to the dp=tp=1
+run at every geometry; the measured table in docs/PERF.md r19 pins
+that parity). dp·tp·pp must fit the 8 virtual host devices.
+
 Run:  python tools/pipeline_ceiling.py
       python tools/pipeline_ceiling.py --schedule lockstep 1f1b zb \
           --json out.json
+      python tools/pipeline_ceiling.py --schedule zb --pp 2 --dp 2
 """
 import argparse
 import json
@@ -51,7 +59,7 @@ SCHEDULES = {
 }
 
 
-def measure(S, M, schedule):
+def measure(S, M, schedule, dp=1, tp=1):
     """Trace the real train step, return (ticks, efficiency)."""
     from paddle_tpu.analysis.collectives import scan_trip_counts
     from paddle_tpu.models import llama as L
@@ -67,11 +75,11 @@ def measure(S, M, schedule):
         num_key_value_heads=2, max_position_embeddings=128,
         dtype=jnp.float32, use_flash_attention=False, remat=False,
         pp_stages=S, pp_schedule=pp_schedule, num_microbatches=M)
-    hm = init_hybrid_mesh(dp=1, pp=S, tp=1, set_global=False)
+    hm = init_hybrid_mesh(dp=dp, pp=S, tp=tp, set_global=False)
     with hm.mesh:
         step, init = L.make_train_step(cfg, hm.mesh)
         state = init(jax.random.PRNGKey(0))
-        batch = L.make_batch(cfg, batch_size=M * 2, seq_len=16,
+        batch = L.make_batch(cfg, batch_size=M * 2 * dp, seq_len=16,
                              mesh=hm.mesh)
         jaxpr = jax.make_jaxpr(step.__wrapped__)(state, batch)
     # exclude the per-stage layer scans (trip count <= layers) so an
@@ -103,25 +111,35 @@ def main(argv=None):
                                                         "1f1b", "zb"])
     ap.add_argument("--pp", nargs="+", type=int, default=[2, 4, 8])
     ap.add_argument("--mb", nargs="+", type=int, default=[8, 16, 32])
+    ap.add_argument("--dp", type=int, default=1,
+                    help="data-parallel degree composed into the "
+                         "schedules (r19); batch rows shard over it")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree composed into the "
+                         "stage bodies (r19, manual collectives)")
     ap.add_argument("--json", metavar="PATH",
                     help="write the efficiency table as JSON")
     args = ap.parse_args(argv)
 
     rows = []
     cols = " | ".join(f"{s} eff" for s in args.schedule)
+    geo = (f" (dp={args.dp} tp={args.tp})"
+           if args.dp > 1 or args.tp > 1 else "")
     print(f"| pp | M | {cols} | ref 1F1B eff (V=1) | "
-          "ref interleaved eff (V=2) |")
+          f"ref interleaved eff (V=2) |{geo}")
     print("|---|---|" + "---|" * (len(args.schedule) + 2))
     for S in args.pp:
         for M in args.mb:
             effs = {}
             for sched in args.schedule:
-                ticks, eff = measure(S, M, sched)
+                ticks, eff = measure(S, M, sched, dp=args.dp,
+                                     tp=args.tp)
                 effs[sched] = {"ticks": ticks, "efficiency": round(eff,
                                                                    4)}
             ref1 = 1 - (S - 1) / (M + S - 1)
             refv = 1 - (S - 1) / (2 * M + S - 1)
             rows.append({"pp": S, "microbatches": M,
+                         "dp": args.dp, "tp": args.tp,
                          "schedules": effs,
                          "ref_1f1b_eff": round(ref1, 4),
                          "ref_interleaved_v2_eff": round(refv, 4)})
