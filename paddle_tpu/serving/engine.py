@@ -700,6 +700,84 @@ class ServingEngine:
         with self._tick_lock:
             return self.prefix_cache.affinity_summary(max_depth)
 
+    # ------------------------------------------------- KV-page migration ----
+    def export_chain(self, fp: int,
+                     max_depth: int = 64) -> Optional[dict]:
+        """Export a cached prefix chain's tokens + KV pages, keyed by
+        the affinity FINGERPRINT the fleet router matches on
+        (``prefix_cache.prefix_fingerprints`` / ``affinity_summary``).
+        Returns a plain-data blob —
+        ``{fp, page_size, tokens: [page token tuples], k, v}`` with
+        ``k``/``v`` numpy arrays of shape ``[L, Hkv, n_pages,
+        page_size, Dh]`` gathered from the live pools — or ``None``
+        when no cached chain hashes to ``fp``. The blob is what
+        crosses the process boundary in disaggregated serving
+        (fleet/proc/): a prefill worker exports, a decode worker
+        :meth:`adopt_chain`\\ s. Runs under the tick lock, so the
+        gather can never race a tick's pool donation or a defrag's
+        page moves — and post-defrag ``node.page`` ids are already
+        the live ids (``PrefixCache.remap``), so a scattered-then-
+        compacted source exports correctly by construction."""
+        if self.prefix_cache is None:
+            return None
+        jnp = self._jnp
+        with self._tick_lock:
+            nodes = self.prefix_cache.chain_by_fingerprint(fp, max_depth)
+            if not nodes:
+                return None
+            pages = [nd.page for nd in nodes]
+            tokens = [tuple(int(t) for t in nd.toks) for nd in nodes]
+            idx = jnp.asarray(pages, jnp.int32)
+            # gather along the page axis (pools are [L, Hkv, P, ps, Dh]);
+            # the pull to host is the POINT: the blob must be plain
+            # numpy to pickle across the fleet/proc worker boundary
+            k = np.asarray(jnp.take(self._kp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
+            v = np.asarray(jnp.take(self._vp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
+        return {"fp": int(fp), "page_size": int(self.pool.page_size),
+                "tokens": tokens, "k": k, "v": v}
+
+    def adopt_chain(self, blob: dict) -> dict:
+        """Adopt an exported chain (:meth:`export_chain` blob) into
+        THIS engine's pool + trie: allocate pages for the un-cached
+        suffix of the chain (evicting cold refcount-0 pages under
+        pressure, same policy as admission), scatter the exported KV
+        into the live pools, and graft the trie nodes at refs=0 —
+        after which a submit sharing that prefix attaches it through
+        the normal exact-token-tuple path and decodes BITWISE equal
+        to a single-engine ``generate()`` (the KV bytes are the
+        source's; attachment never trusts the fingerprint). Returns
+        ``{"matched_pages", "adopted_pages"}``; raises ValueError on
+        a page-size mismatch and RuntimeError when the pool cannot
+        hold the suffix even after eviction."""
+        if self.prefix_cache is None:
+            raise RuntimeError("adopt_chain needs prefix_cache=True")
+        if int(blob["page_size"]) != int(self.pool.page_size):
+            raise ValueError(
+                f"page-size mismatch: exported {blob['page_size']}, "
+                f"this engine serves {self.pool.page_size}")
+        tokens = [tuple(int(t) for t in tt) for tt in blob["tokens"]]
+        jnp = self._jnp
+        with self._tick_lock:
+            pc = self.prefix_cache
+            have = pc.match_chain(tokens)
+            need = len(tokens) - have
+            if need == 0:
+                return {"matched_pages": have, "adopted_pages": 0}
+            if not self.pool.can_alloc(need):
+                pc.evict(need - self.pool.free_pages)
+            if not self.pool.can_alloc(need):
+                raise RuntimeError(
+                    f"cannot adopt chain: {need} pages needed, "
+                    f"{self.pool.free_pages} free after eviction")
+            pages = self.pool.alloc(need)
+            idx = jnp.asarray(pages, jnp.int32)
+            self._kp = self._kp.at[:, :, idx].set(
+                jnp.asarray(blob["k"][:, :, have:]))
+            self._vp = self._vp.at[:, :, idx].set(
+                jnp.asarray(blob["v"][:, :, have:]))
+            pc.adopt_chain(tokens, pages, start=have)
+        return {"matched_pages": have, "adopted_pages": need}
+
     def export_trace(self, path: str) -> str:
         """Write the span tracer's ring as Perfetto-loadable
         Chrome-trace JSON (one track per engine phase + per slot);

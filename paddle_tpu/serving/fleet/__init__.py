@@ -12,6 +12,14 @@ prefill/decode disaggregation and drain-on-failure:
                    → GONE lifecycle, health view, drain protocol
                    (replica.py)
 
+The multi-process twin lives in ``fleet.proc`` (same router, same
+lifecycle, worker PROCESSES instead of threads — plus KV-page
+migration between workers); it is imported lazily, not here, because
+it pulls in multiprocessing machinery most fleet users never touch:
+
+    from paddle_tpu.serving.fleet.proc import (ProcServingFleet,
+                                               WorkerSpec)
+
 The affinity signal is ``PrefixCache.affinity_summary`` (rolling-hash
 fingerprints of each replica's hot trie chains) matched against
 ``prefix_cache.prefix_fingerprints(prompt, ...)``. The drain contract

@@ -1,0 +1,315 @@
+"""ProcServingFleet: launcher + supervisor for process replicas.
+
+The multi-process twin of :class:`~paddle_tpu.serving.fleet.fleet
+.ServingFleet`: same FleetRouter (prefix-affinity routing needs no
+changes — ProcReplica serves the identical surface), same
+generation-bumped join/drain/kill lifecycle, same exactly-once
+re-dispatch — but each replica is a spawned worker process owning its
+own JAX runtime, so aggregate throughput scales with processes
+instead of time-slicing one GIL.
+
+Supervision adds the path the in-process fleet could not have: a HARD
+crash (worker SIGKILLed, OOMed, or dead of any cause) is detected by
+the transport pump, converted into drain-on-failure — membership
+pruned, generation bumped, every unfinished request the dead worker
+held handed back and re-dispatched to survivors exactly once — and
+the caller's handles simply keep streaming from the new worker
+(emission dedup in ProcReplica pins exactly-once delivery).
+
+KV-page migration (the disaggregation step): :meth:`migrate_chain`
+pulls a completed chain's pages out of a prefill worker by trie
+fingerprint (engine.export_chain) and pushes them into a decode
+worker's pool/trie (engine.adopt_chain) over the transport — after
+which requests sharing that prefix decode on the target with a warm
+cache, bitwise-identical to having prefilled there.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ...metrics import merge_exposition
+from ...scheduler import RequestHandle
+from ..replica import DRAINING, GONE, JOINING, ROLE_GENERAL, SERVING
+from ..router import FleetRouter
+from .replica import ProcReplica
+
+__all__ = ["ProcServingFleet"]
+
+
+class ProcServingFleet:
+    """N worker processes + router + elastic membership.
+
+    spec: the :class:`WorkerSpec` every worker is spawned from (same
+    weights seed fleet-wide — re-dispatch depends on replicas being
+    bitwise-identical decoders).
+    """
+
+    def __init__(self, spec, *, replicas: int = 2,
+                 roles: Optional[List[str]] = None,
+                 policy: str = "affinity", summary_depth: int = 2,
+                 prefill_len_ratio: float = 1.0,
+                 name_prefix: str = "w",
+                 start_timeout: float = 180.0,
+                 rpc_timeout: float = 30.0,
+                 drain_timeout: float = 120.0):
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        self.spec = spec
+        self._prefix = str(name_prefix)
+        self._timeouts = (start_timeout, rpc_timeout, drain_timeout)
+        self._lock = threading.Lock()
+        self._n = 0
+        self.generation = 0
+        self._replicas: Dict[str, ProcReplica] = {}
+        self._leaving: set = set()
+        self.router = FleetRouter(policy=policy,
+                                  summary_depth=summary_depth,
+                                  prefill_len_ratio=prefill_len_ratio)
+        self.counters = {"joins": 0, "drains": 0, "kills": 0,
+                         "crashes": 0, "handed_back": 0, "closed": 0}
+        # bring the initial fleet up CONCURRENTLY: spawn + engine
+        # build + warm overlap across workers (they are separate
+        # processes — this is the first place that buys real time)
+        reps = []
+        for i in range(replicas):
+            role = roles[i % len(roles)] if roles else ROLE_GENERAL
+            reps.append(self._make(role))
+        errs: list = []
+
+        def _start(rep):
+            try:
+                rep.start()
+            except BaseException as e:     # noqa: BLE001
+                errs.append((rep.name, e))
+        ths = [threading.Thread(target=_start, args=(r,), daemon=True)
+               for r in reps]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        if errs:
+            for rep in reps:
+                try:
+                    rep.close(drain=False)
+                except Exception:
+                    pass
+            name, e = errs[0]
+            raise RuntimeError(
+                f"fleet bring-up failed at {name}: {e}") from e
+        for rep in reps:        # join order = name order
+            self.router.add(rep)
+            self._inc("joins")
+
+    # -------------------------------------------------------- membership ----
+    def _inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+
+    def _make(self, role: str) -> ProcReplica:
+        st, rt, dt = self._timeouts
+        with self._lock:
+            name = f"{self._prefix}{self._n}"
+            self._n += 1
+            self.generation += 1
+            gen = self.generation
+        rep = ProcReplica(name, self.spec, role=role, generation=gen,
+                          on_death=self._on_crash, start_timeout=st,
+                          rpc_timeout=rt, drain_timeout=dt)
+        with self._lock:
+            self._replicas[name] = rep
+        return rep
+
+    def replica(self, name: str) -> ProcReplica:
+        with self._lock:
+            return self._replicas[name]
+
+    def replicas(self, state: Optional[str] = None
+                 ) -> List[ProcReplica]:
+        with self._lock:
+            reps = list(self._replicas.values())
+        if state is not None:
+            reps = [r for r in reps if r.state == state]
+        return reps
+
+    def join(self, role: str = ROLE_GENERAL) -> ProcReplica:
+        """Elastic join: spawn + build + open to the router."""
+        rep = self._make(role)
+        rep.start()
+        self.router.add(rep)
+        self._inc("joins")
+        return rep
+
+    def _leave(self, name: str, counter: str) -> List:
+        rep = self.replica(name)
+        with self._lock:
+            if name in self._leaving or rep.state in (DRAINING, GONE):
+                return []
+            self._leaving.add(name)
+        try:
+            handed = rep.drain()
+            self.router.remove(name)
+            with self._lock:
+                self.generation += 1
+                self.counters[counter] += 1
+            if handed:
+                self._inc("handed_back", len(handed))
+                self.router.redispatch(handed, exclude=(name,))
+            return handed
+        finally:
+            with self._lock:
+                self._leaving.discard(name)
+
+    def drain(self, name: str) -> List:
+        """Graceful leave (drain protocol + re-dispatch)."""
+        return self._leave(name, "drains")
+
+    def kill(self, name: str) -> List:
+        """Drain-on-failure, accounted as a kill (the bench's
+        kill-one-replica scenario)."""
+        return self._leave(name, "kills")
+
+    def kill_hard(self, name: str, timeout: float = 30.0) -> None:
+        """SIGKILL the worker process and WAIT until the crash path
+        (detect -> hand back -> re-dispatch) has completed — the
+        failure-injection entry the kill-mid-stream tests drive."""
+        rep = self.replica(name)
+        rep.kill_process()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if rep.state == GONE and \
+                    all(r.name != name
+                        for r in self.router.replicas()):
+                return
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"crash handling for {name} incomplete after {timeout}s")
+
+    def _on_crash(self, rep: ProcReplica, handed: List) -> None:
+        """Transport death callback: exactly-once crash accounting +
+        hand-back re-dispatch (the supervisor's whole job)."""
+        with self._lock:
+            if rep.name in self._leaving:
+                return
+            self._leaving.add(rep.name)
+        try:
+            self.router.remove(rep.name)
+            with self._lock:
+                self.generation += 1
+                self.counters["kills"] += 1
+                self.counters["crashes"] += 1
+            if handed:
+                self._inc("handed_back", len(handed))
+                self.router.redispatch(handed, exclude=(rep.name,))
+        finally:
+            with self._lock:
+                self._leaving.discard(rep.name)
+
+    # --------------------------------------------------------- admission ----
+    def submit(self, prompt, max_new_tokens: int,
+               **kw) -> RequestHandle:
+        return self.router.submit(prompt, max_new_tokens, **kw)
+
+    def generate(self, prompt, max_new_tokens: int, **kw):
+        return self.submit(prompt, max_new_tokens, **kw).result()
+
+    # --------------------------------------------------------- migration ---
+    def migrate_chain(self, fp: int, src: str, dst: str,
+                      max_depth: int = 64) -> Optional[dict]:
+        """Move a completed chain's KV pages ``src`` -> ``dst`` by
+        trie fingerprint. Returns the adopt stats
+        (``{"matched_pages", "adopted_pages"}``) or None when ``src``
+        does not hold the chain. The source KEEPS its copy (migration
+        is replication — the trie refcounts make eviction safe on
+        both sides independently)."""
+        blob = self.replica(src).export_chain(fp, max_depth)
+        if blob is None:
+            return None
+        return self.replica(dst).adopt_chain(blob)
+
+    # ----------------------------------------------------- observability ----
+    def arm_sentinels(self) -> None:
+        for rep in self.replicas(SERVING):
+            rep.arm_sentinel()
+
+    def snapshot(self) -> dict:
+        """Same shape as ServingFleet.snapshot — the bench's fleet
+        mode consumes either interchangeably."""
+        reps = {}
+        for rep in self.replicas():
+            h = rep.health()
+            src = rep.snapshot_dict()
+            if src is not None:
+                c = src.get("counters", {})
+                h["counters"] = {k: c.get(k, 0) for k in
+                                 ("submitted", "admitted", "completed",
+                                  "handed_back", "tokens_out",
+                                  "prefix_hits", "prefix_misses")}
+            reps[rep.name] = h
+        with self._lock:
+            counters = dict(self.counters)
+            gen = self.generation
+        return {"generation": gen, "policy": self.router.policy,
+                "replicas": reps, "router": dict(self.router.counters),
+                "fleet": counters}
+
+    def expose(self) -> str:
+        """ONE Prometheus scrape for the whole fleet, assembled from
+        per-worker scrape TEXT: each live worker renders its own
+        exposition in-process, the parent parse-merges them
+        (metrics.merge_exposition) under ``{replica, role}`` labels
+        stamped HERE — same one-TYPE-line-per-family and escape-once
+        guarantees as the in-process fleet, now across a process
+        boundary."""
+        entries = []
+        reps = self.replicas()
+        for rep in reps:
+            if rep.state == GONE:
+                continue
+            text = rep.expose_text()
+            if text is not None:
+                entries.append(({"replica": rep.name,
+                                 "role": rep.role}, text, None))
+        with self._lock:
+            gen = self.generation
+            fleet_g = {f"fleet_{k}": v
+                       for k, v in self.counters.items()}
+        fleet_g["fleet_generation"] = gen
+        for state in (JOINING, SERVING, DRAINING, GONE):
+            fleet_g[f"fleet_replicas_{state}"] = sum(
+                1 for r in reps if r.state == state)
+        for k, v in self.router.counters.items():
+            fleet_g[f"router_{k}"] = v
+        entries.append(({}, None, fleet_g))
+        return merge_exposition(entries)
+
+    # ---------------------------------------------------------- shutdown ----
+    def close(self, drain: bool = True) -> None:
+        """Full fleet shutdown: every replica's queued + running
+        requests are served (no survivors to hand back to), workers
+        exit, processes are joined. Concurrent across workers."""
+        reps = [r for r in self.replicas()
+                if r.state not in (DRAINING, GONE)]
+
+        def _close(rep):
+            try:
+                rep.close(drain=drain, hand_back=False)
+            except Exception:
+                pass
+        ths = [threading.Thread(target=_close, args=(r,), daemon=True)
+               for r in reps]
+        for th in ths:
+            th.start()
+        for th in ths:
+            th.join()
+        for rep in reps:
+            self.router.remove(rep.name)
+        self._inc("closed")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

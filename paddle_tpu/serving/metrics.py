@@ -213,6 +213,140 @@ class ServingMetrics:
                                 prefix=prefix)
 
 
+def _prom_unescape(v: str) -> str:
+    """Exact inverse of :func:`_prom_escape` (label values parsed back
+    to RAW strings, so a re-render escapes exactly once again)."""
+    out, i = [], 0
+    while i < len(v):
+        c = v[i]
+        if c == "\\" and i + 1 < len(v):
+            nxt = v[i + 1]
+            out.append({"n": "\n", "\\": "\\", '"': '"'}.get(
+                nxt, "\\" + nxt))
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+_SAMPLE_RE = None     # compiled lazily (module import stays regex-free
+#                       for the serving hot path; parsing is scrape-time)
+
+
+def _parse_exposition(text: str, prefix: str) -> dict:
+    """Parse Prometheus text exposition (the format ``expose()`` /
+    :func:`merge_exposition` render) back into the merge's internal
+    families — the REMOTE-worker half of fleet aggregation
+    (fleet/proc/): a worker process ships its scrape as text, and the
+    parent merges it with local entries under the same
+    one-TYPE-line-per-family and escape-once guarantees.
+
+    Returns ``{"counters"|"breakdowns"|"summaries"|"gauges":
+    {name: samples}}`` with family names STRIPPED of ``prefix`` and
+    kind suffixes, label values unescaped to raw, and summary samples
+    regrouped into ``(labels, {"p50","p99","count"}, lifetime_sum)``
+    triples. A gauge the worker renamed ``<name>_now`` (histogram
+    collision) is un-renamed when its base family is a summary in the
+    same text, so the merged render applies the collision rename
+    exactly once, globally."""
+    global _SAMPLE_RE
+    if _SAMPLE_RE is None:
+        import re
+        _SAMPLE_RE = (
+            re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+                       r"(?:\{(.*)\})? (\S+)$"),
+            re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"'))
+    sample_re, label_re = _SAMPLE_RE
+    kinds: Dict[str, str] = {}
+    raw = []                            # (metric, labels, value) in order
+    for ln in text.splitlines():
+        if not ln.strip():
+            continue
+        if ln.startswith("# TYPE "):
+            parts = ln.split(" ")
+            if len(parts) == 4:
+                kinds[parts[2]] = parts[3]
+            continue
+        if ln.startswith("#"):
+            continue                    # HELP/comment lines
+        m = sample_re.match(ln)
+        if not m:
+            raise ValueError(f"unparseable exposition sample: {ln!r}")
+        metric, lbl, val = m.groups()
+        labels = {k: _prom_unescape(v)
+                  for k, v in label_re.findall(lbl)} if lbl else {}
+        raw.append((metric, labels, float(val)))
+
+    def strip(metric: str, suffix: str = "") -> str:
+        name = metric[len(prefix) + 1:]
+        return name[:-len(suffix)] if suffix else name
+
+    def family_of(metric: str) -> str:
+        """Owning family: ``X_sum``/``X_count`` belong to summary
+        family ``X``."""
+        for suf in ("_sum", "_count"):
+            if metric.endswith(suf) and \
+                    kinds.get(metric[:-len(suf)]) == "summary":
+                return metric[:-len(suf)]
+        return metric
+
+    out = {"counters": {}, "breakdowns": {}, "summaries": {},
+           "gauges": {}}
+    # summaries need regrouping: (family, label-key minus quantile) ->
+    # accumulating {p50, p99, sum, count}
+    summ: Dict[tuple, dict] = {}
+    for metric, labels, val in raw:
+        fam = family_of(metric)
+        kind = kinds.get(fam)
+        if kind is None or not fam.startswith(prefix + "_"):
+            raise ValueError(
+                f"sample {metric!r} has no TYPE line (family {fam!r})")
+        if kind == "counter":
+            ival = int(val) if val == int(val) else val
+            if fam.endswith("_breakdown_total"):
+                out["breakdowns"].setdefault(
+                    strip(fam, "_breakdown_total"), []).append(
+                        (labels, ival))
+            else:
+                out["counters"].setdefault(
+                    strip(fam, "_total"), []).append((labels, ival))
+        elif kind == "summary":
+            base = dict(labels)
+            q = base.pop("quantile", None)
+            key = (strip(fam),
+                   tuple(sorted(base.items())))
+            acc = summ.setdefault(key, {"labels": base, "p50": 0.0,
+                                        "p99": 0.0, "sum": 0.0,
+                                        "count": 0})
+            if metric.endswith("_sum") and fam != metric:
+                acc["sum"] = val
+            elif metric.endswith("_count") and fam != metric:
+                acc["count"] = int(val)
+            elif q == "0.5":
+                acc["p50"] = val
+            elif q == "0.99":
+                acc["p99"] = val
+        elif kind == "gauge":
+            out["gauges"].setdefault(strip(fam), []).append(
+                (labels, val))
+        else:
+            raise ValueError(f"unsupported TYPE {kind!r} for {fam!r}")
+    for (name, _), acc in summ.items():
+        out["summaries"].setdefault(name, []).append(
+            (acc["labels"],
+             {"p50": acc["p50"], "p99": acc["p99"],
+              "count": acc["count"]},
+             acc["sum"]))
+    # un-rename collision gauges (see docstring): raw name goes back in
+    # so the merged render's collision check fires exactly once
+    for gname in list(out["gauges"]):
+        if gname.endswith("_now") and gname[:-4] in out["summaries"]:
+            out["gauges"].setdefault(gname[:-4], []).extend(
+                out["gauges"].pop(gname))
+    return out
+
+
 def _render_labels(labels: Dict[str, str]) -> str:
     """``k1="v1",k2="v2"`` with values escaped HERE and nowhere else
     (the escape-once contract: callers always hand raw values)."""
@@ -230,9 +364,13 @@ def merge_exposition(entries, prefix: str = "paddle_serving") -> str:
 
     ``entries`` is ``[(labels, metrics, gauges)]``: per entry, a raw
     (unescaped) label dict stamped on every sample (the fleet passes
-    ``{"replica": "r0"}``), a :class:`ServingMetrics` or ``None``, and
-    an optional ``{name: value}`` gauge dict. The single-engine
-    :meth:`ServingMetrics.expose` is exactly this with one entry.
+    ``{"replica": "r0"}``), a :class:`ServingMetrics`, a raw scrape
+    TEXT ``str`` (a remote worker's own ``expose()`` output, shipped
+    over the fleet/proc transport and parse-merged here), or ``None``,
+    and an optional ``{name: value}`` gauge dict. The single-engine
+    :meth:`ServingMetrics.expose` is exactly this with one entry, and
+    ``merge_exposition([({}, expose_text, None)])`` is byte-identical
+    to ``expose_text`` (parse/render round-trips).
 
     Aggregation rules (the reasons this is structured merging, not
     text concatenation):
@@ -257,7 +395,33 @@ def merge_exposition(entries, prefix: str = "paddle_serving") -> str:
     fam_gauge: Dict[str, list] = {}
     for labels, metrics, gauges in entries:
         base = {str(k): str(v) for k, v in (labels or {}).items()}
-        if metrics is not None:
+        if isinstance(metrics, str):
+            # raw scrape TEXT from a remote worker (fleet/proc/):
+            # parse back into families so the TYPE-line and escape
+            # guarantees hold across the process boundary too
+            parsed = _parse_exposition(metrics, prefix)
+            for name, samples in parsed["counters"].items():
+                for lbls, v in samples:
+                    merged = dict(lbls)
+                    merged.update(base)
+                    fam_counter.setdefault(name, []).append((merged, v))
+            for name, samples in parsed["breakdowns"].items():
+                for lbls, v in samples:
+                    merged = dict(lbls)
+                    merged.update(base)
+                    fam_break.setdefault(name, []).append((merged, v))
+            for name, triples in parsed["summaries"].items():
+                for lbls, s, life_sum in triples:
+                    merged = dict(lbls)
+                    merged.update(base)
+                    fam_hist.setdefault(name, []).append(
+                        (merged, s, life_sum))
+            for name, samples in parsed["gauges"].items():
+                for lbls, v in samples:
+                    merged = dict(lbls)
+                    merged.update(base)
+                    fam_gauge.setdefault(name, []).append((merged, v))
+        elif metrics is not None:
             counters, labeled, hists = metrics._collect()
             for name, v in counters.items():
                 fam_counter.setdefault(name, []).append((base, v))

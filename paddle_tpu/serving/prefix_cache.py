@@ -264,6 +264,74 @@ class PrefixCache:
                                (parent.last_used, id(parent), parent))
         return freed
 
+    # -------------------------------------------------------- migration ----
+    def chain_by_fingerprint(self, fp: int,
+                             max_depth: int = 64) -> List[_Node]:
+        """Resolve an affinity fingerprint back to its cached chain:
+        the node path (root-side first) whose rolling hash — the same
+        :func:`prefix_fingerprints` extension the router matched on —
+        equals ``fp``. Empty list when no cached chain hashes to it.
+        This is the KV-page migration lookup (fleet/proc/): the
+        router's warmth signal names chains by fingerprint, so the
+        migration request arrives as a fingerprint and the EXPORT side
+        re-derives the exact token tuples + current page ids from the
+        trie (post-defrag ``node.page`` ids are the live ids — remap
+        already rewrote them). A 64-bit collision can at worst export
+        a different chain than intended; the ADOPT side re-keys by the
+        exported token tuples, so collisions cost a wasted transfer,
+        never KV aliasing."""
+        target = int(fp) & _FP_MASK
+        stack = [(self._root, 0, 0, [])]
+        while stack:
+            node, cur, d, path = stack.pop()
+            if d >= int(max_depth):
+                continue
+            for toks, child in node.children.items():
+                cfp = _fp_extend(cur, toks)
+                cpath = path + [child]
+                if cfp == target:
+                    return cpath
+                stack.append((child, cfp, d + 1, cpath))
+        return []
+
+    def adopt_chain(self, tokens: List[tuple], pages: List[int],
+                    start: int = 0) -> List[_Node]:
+        """Graft an EXTERNALLY prefilled chain into the trie (KV-page
+        migration adoption): ``tokens`` is the full chain's page token
+        tuples, ``tokens[:start]`` must already be cached here (the
+        shared prefix the destination holds), and ``pages`` are this
+        pool's freshly allocated pages now holding the KV for
+        ``tokens[start:]`` (the caller scattered the exported arrays
+        in before calling). New nodes enter at ``refs=0`` — cached and
+        evictable, exactly the state a locally prefilled chain reaches
+        after its owning request retires — so the pool-ownership
+        invariants are indistinguishable from local prefill."""
+        node = self._root
+        for tt in tokens[:start]:
+            node = node.children[tuple(tt)]
+        t = next(self._tick)
+        out: List[_Node] = []
+        for tt, page in zip(tokens[start:], pages):
+            key = tuple(int(x) for x in tt)
+            child = _Node(key, node, int(page), t)
+            node.children[key] = child
+            self._nodes.add(child)
+            out.append(child)
+            node = child
+        return out
+
+    def match_chain(self, tokens: List[tuple]) -> int:
+        """How many leading page token tuples of ``tokens`` are already
+        cached (the adopt side's dedup walk: only the uncached suffix
+        needs pages + KV scattered)."""
+        node, n = self._root, 0
+        for tt in tokens:
+            nxt = node.children.get(tuple(int(x) for x in tt))
+            if nxt is None:
+                break
+            node, n = nxt, n + 1
+        return n
+
     # ------------------------------------------------------------ defrag ----
     def remap(self, plan: Dict[int, int]) -> None:
         """Apply a ``PagePool.defrag_plan()`` to every cached node's

@@ -348,6 +348,57 @@ def test_arrival_schedule_is_seeded_and_replayable():
         [(a, g, len(p), m) for a, g, p, m in s2]
 
 
+def test_arrival_heavy_tailed_laws():
+    """--arrival lognormal:K[:s] / pareto:K[:a] (ISSUE r16 satellite):
+    heavy-tailed gaps + lengths with the SAME replay contract as
+    seed:K — the spec string reproduces the schedule bitwise whatever
+    the content seed — and visibly heavier tails than the exponential
+    default at the same offered rate."""
+    import importlib
+    sb = importlib.import_module("tools.serving_bench")
+    spec = sb.parse_arrival("lognormal:7")
+    assert isinstance(spec, sb.ArrivalSpec)
+    assert (spec.kind, spec.seed, spec.param) == ("lognormal", 7, 1.5)
+    par = sb.parse_arrival("pareto:7:2.5")
+    assert (par.kind, par.seed, par.param) == ("pareto", 7, 2.5)
+    with pytest.raises(ValueError):         # Lomax needs a finite mean
+        sb.parse_arrival("pareto:7:0.9")
+    with pytest.raises(ValueError):
+        sb.parse_arrival("lognormal:7:0")
+    with pytest.raises(ValueError):
+        sb.parse_arrival("weibull:7")
+    # replay contract: same spec string -> same schedule, any --seed
+    for s in ("lognormal:7", "pareto:7:2.5"):
+        t1 = sb.build_trace(24, 100.0, 24, [4, 8],
+                            seed=0, arrival=sb.parse_arrival(s))
+        t2 = sb.build_trace(24, 100.0, 24, [4, 8],
+                            seed=1, arrival=sb.parse_arrival(s))
+        assert [(a, len(p), m) for a, p, m in t1] == \
+            [(a, len(p), m) for a, p, m in t2]
+        assert any(not np.array_equal(p1, p2)
+                   for (_, p1, _), (_, p2, _) in zip(t1, t2))
+        # lengths stay inside the geometry the engine is built for
+        assert all(2 <= len(p) <= 24 and m in (4, 8)
+                   for _, p, m in t1)
+    # the tails are actually heavier: max/median inter-arrival gap far
+    # above the exponential baseline at the same mean rate
+    def max_over_median_gap(arrival):
+        t = sb.build_trace(400, 100.0, 24, [4], seed=0,
+                           arrival=arrival)
+        gaps = np.diff([a for a, _, _ in t])
+        return float(gaps.max() / np.median(gaps))
+    base = max_over_median_gap(17)          # seed:17 -> exponential
+    heavy = max_over_median_gap(sb.parse_arrival("lognormal:17"))
+    assert heavy > 2.0 * base
+    # session traces accept the spec too (fleet modes)
+    s1 = sb.build_session_trace(3, 4, 100.0, 8, 2, 6, [4], seed=0,
+                                arrival=sb.parse_arrival("pareto:5"))
+    s2 = sb.build_session_trace(3, 4, 100.0, 8, 2, 6, [4], seed=9,
+                                arrival=sb.parse_arrival("pareto:5"))
+    assert [(a, g, len(p), m) for a, g, p, m in s1] == \
+        [(a, g, len(p), m) for a, g, p, m in s2]
+
+
 @pytest.mark.slow
 def test_serving_bench_fleet_kill_replica():
     """End-to-end through tools/serving_bench.py --replicas 2: the

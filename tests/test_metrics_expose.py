@@ -155,3 +155,90 @@ def test_single_engine_format_unchanged():
     assert "paddle_serving_submitted_total 3" in lines
     assert "paddle_serving_free_pages 31" in lines
     assert not any("{}" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# parse-and-merge (ISSUE r16 satellite): merge_exposition accepts raw
+# scrape TEXT — the fleet/proc path where a worker process ships its
+# own exposition and the parent assembles ONE scrape
+# ---------------------------------------------------------------------------
+
+def test_text_entry_round_trips_byte_identical():
+    """merge_exposition([({}, text, None)]) == text — parse/render is
+    a fixed point, so relaying a worker's scrape changes nothing."""
+    text = _metrics().expose(gauges={"free_pages": 31, "queued": 0})
+    assert merge_exposition([({}, text, None)]) == text
+    # and with nasty labels already escaped in the text: still a fixed
+    # point (parse unescapes to raw, render escapes exactly once)
+    labeled = _metrics().expose(labels={"replica": NASTY})
+    assert merge_exposition([({}, labeled, None)]) == labeled
+
+
+def test_text_and_live_entries_merge_as_one_scrape():
+    """A live ServingMetrics and a remote worker's TEXT merge into one
+    valid scrape: one TYPE line per family, per-entry labels stamped,
+    values preserved (counters stay integers)."""
+    remote = _metrics().expose(gauges={"free_pages": 5})
+    live = _metrics()
+    live.inc("submitted", 7)
+    text = merge_exposition([({"replica": "w0"}, remote, None),
+                             ({"replica": "w1"}, live,
+                              {"free_pages": 9})])
+    types, samples = parse_exposition(text)
+    sub = {lbls["replica"]: v for name, lbls, v in samples
+           if name == "paddle_serving_submitted_total"}
+    assert sub == {"w0": 3.0, "w1": 10.0}
+    assert "paddle_serving_submitted_total{replica=\"w0\"} 3" in \
+        text.splitlines()                   # int, not 3.0
+    gauges = {lbls["replica"]: v for name, lbls, v in samples
+              if name == "paddle_serving_free_pages"}
+    assert gauges == {"w0": 5.0, "w1": 9.0}
+    # summary quantiles + lifetime _sum/_count survive the text hop
+    s = [(lbls["replica"], lbls["quantile"]) for name, lbls, _ in samples
+         if name == "paddle_serving_ttft_s"]
+    assert set(s) == {("w0", "0.5"), ("w0", "0.99"),
+                      ("w1", "0.5"), ("w1", "0.99")}
+    sums = {lbls["replica"]: v for name, lbls, v in samples
+            if name == "paddle_serving_ttft_s_sum"}
+    assert sums["w0"] == pytest.approx(0.6)
+    # escaped breakdown label round-trips through the text entry too
+    br = [lbls["during"] for name, lbls, _ in samples
+          if name == "paddle_serving_recompiles_breakdown_total"]
+    assert br == [NASTY, NASTY]
+
+
+def test_text_entry_base_labels_override():
+    """The aggregator owns the replica axis: a base label overrides a
+    same-named label already present in the worker's text."""
+    inner = _metrics().expose(labels={"replica": "inner"})
+    text = merge_exposition([({"replica": "outer"}, inner, None)])
+    _, samples = parse_exposition(text)
+    assert all(lbls.get("replica") == "outer"
+               for _, lbls, _ in samples if "replica" in lbls)
+
+
+def test_text_entry_collision_gauge_not_double_renamed():
+    """A worker that already renamed a colliding gauge ``<name>_now``
+    must NOT become ``<name>_now_now`` after the merge — the rename
+    applies exactly once, globally."""
+    m = ServingMetrics()
+    m.observe("page_utilization", 0.5)
+    worker = m.expose(gauges={"page_utilization": 0.25})
+    text = merge_exposition([({"replica": "w0"}, worker, None)])
+    types, samples = parse_exposition(text)
+    assert types["paddle_serving_page_utilization"] == "summary"
+    assert types["paddle_serving_page_utilization_now"] == "gauge"
+    assert "paddle_serving_page_utilization_now_now" not in types
+    vals = [v for name, _, v in samples
+            if name == "paddle_serving_page_utilization_now"]
+    assert vals == [0.25]
+
+
+def test_text_entry_rejects_garbage():
+    """Unparseable text or samples with no TYPE line raise instead of
+    silently producing a corrupt scrape."""
+    with pytest.raises(ValueError, match="unparseable"):
+        merge_exposition([({}, "this is not a scrape\n", None)])
+    with pytest.raises(ValueError, match="no TYPE"):
+        merge_exposition(
+            [({}, "paddle_serving_mystery_total 3\n", None)])

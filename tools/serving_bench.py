@@ -64,19 +64,95 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
+class ArrivalSpec:
+    """Replayable HEAVY-TAILED schedule (``--arrival lognormal:K[:s]``
+    / ``pareto:K[:a]``): inter-arrival gaps, prompt lengths and output
+    lengths all draw from the heavy-tailed law instead of the uniform/
+    exponential defaults — the production traffic shape (a few huge
+    prompts/outputs among many small ones) that convoy/admission
+    policies must be measured under. Same replay contract as
+    ``seed:K``: the spec string alone reproduces the schedule bitwise,
+    whatever ``--seed`` says about content.
+
+    Gaps keep MEAN ``1/rate`` so ``--rate`` means the same offered
+    load across laws: lognormal uses ``mu = ln(1/rate) - sigma^2/2``;
+    Pareto (Lomax) scales by ``(alpha-1)/rate`` and needs
+    ``alpha > 1`` for the mean to exist. Lengths map a mean-1 draw of
+    the same law onto ``[lo, hi]`` (mass near ``lo``, rare spikes
+    capped at ``hi``); output lengths pick from the sorted
+    ``--mnt-choices`` by the same draw (small outputs common, the big
+    choice rare)."""
+
+    def __init__(self, kind, seed, param=None):
+        if kind not in ("lognormal", "pareto"):
+            raise ValueError(f"unknown arrival law {kind!r}")
+        self.kind = kind
+        self.seed = int(seed)
+        self.param = 1.5 if param is None else float(param)
+        if kind == "pareto" and self.param <= 1.0:
+            raise ValueError("pareto alpha must be > 1 (finite mean), "
+                             f"got {self.param}")
+        if kind == "lognormal" and self.param <= 0.0:
+            raise ValueError("lognormal sigma must be > 0, "
+                             f"got {self.param}")
+
+    def __repr__(self):
+        return f"ArrivalSpec({self.kind}:{self.seed}:{self.param})"
+
+    def gaps(self, sched, rate, n):
+        """n inter-arrival gaps with mean 1/rate."""
+        if self.kind == "lognormal":
+            s = self.param
+            mu = np.log(1.0 / rate) - 0.5 * s * s
+            return sched.lognormal(mu, s, n)
+        a = self.param
+        return sched.pareto(a, n) * (a - 1.0) / rate
+
+    def _unit(self, sched):
+        """One mean-1 draw of the law (shared by lengths + mnt)."""
+        return float(self.gaps(sched, 1.0, 1)[0])
+
+    def length(self, sched, lo, hi):
+        """Heavy-tailed int length in [lo, hi]."""
+        lo, hi = int(lo), int(hi)
+        if hi <= lo:
+            return lo
+        # mean-1 draw scaled so the typical draw sits in the lower
+        # third of the span; the tail hits hi and is capped there
+        d = self._unit(sched) * (hi - lo) / 3.0
+        return lo + min(int(d), hi - lo)
+
+    def pick(self, sched, choices):
+        """Heavy-tailed pick over sorted choices (small ones common)."""
+        cs = sorted(int(c) for c in choices)
+        i = int(self._unit(sched) * len(cs) / 2.0)
+        return cs[min(i, len(cs) - 1)]
+
+
 def parse_arrival(spec):
-    """``--arrival`` spec -> schedule-RNG seed or None (legacy: the
-    schedule rides the content seed). The only form today is
-    ``seed:K`` — a dedicated, replayable arrival schedule (ROADMAP
-    item 5's first slice): the SAME ``seed:K`` reproduces identical
-    inter-arrival gaps, prompt lengths and mnt draws whatever
-    ``--seed`` says, so fleet A/Bs and the kill-replica scenario
-    replay bit-identical schedules while varying content."""
+    """``--arrival`` spec -> schedule-RNG seed, :class:`ArrivalSpec`,
+    or None (legacy: the schedule rides the content seed).
+
+    * ``seed:K`` — dedicated, replayable arrival schedule (ROADMAP
+      item 5's first slice): the SAME ``seed:K`` reproduces identical
+      inter-arrival gaps, prompt lengths and mnt draws whatever
+      ``--seed`` says, so fleet A/Bs and the kill-replica scenario
+      replay bit-identical schedules while varying content.
+    * ``lognormal:K[:sigma]`` / ``pareto:K[:alpha]`` — same replay
+      contract with HEAVY-TAILED gaps + lengths (:class:`ArrivalSpec`;
+      defaults sigma=1.5, alpha=1.5)."""
     if spec is None:
         return None
-    if isinstance(spec, str) and spec.startswith("seed:"):
-        return int(spec.split(":", 1)[1])
-    raise ValueError(f"--arrival must be 'seed:K', got {spec!r}")
+    if isinstance(spec, str):
+        if spec.startswith("seed:"):
+            return int(spec.split(":", 1)[1])
+        parts = spec.split(":")
+        if parts[0] in ("lognormal", "pareto") and len(parts) in (2, 3):
+            return ArrivalSpec(parts[0], int(parts[1]),
+                               float(parts[2]) if len(parts) == 3
+                               else None)
+    raise ValueError(f"--arrival must be 'seed:K', 'lognormal:K[:s]' "
+                     f"or 'pareto:K[:a]', got {spec!r}")
 
 
 def build_trace(n, rate, max_prompt, mnt_choices, seed, shared_prefix=0,
@@ -90,18 +166,24 @@ def build_trace(n, rate, max_prompt, mnt_choices, seed, shared_prefix=0,
     choices) onto their own seeded RNG, leaving ``seed`` to govern
     content only."""
     rng = np.random.RandomState(seed)
-    sched = rng if arrival is None else np.random.RandomState(arrival)
-    arrivals = np.cumsum(sched.exponential(1.0 / rate, n))
+    heavy = isinstance(arrival, ArrivalSpec)
+    sched = rng if arrival is None else np.random.RandomState(
+        arrival.seed if heavy else arrival)
+    arrivals = np.cumsum(arrival.gaps(sched, rate, n) if heavy
+                         else sched.exponential(1.0 / rate, n))
     header = (rng.randint(0, 256, (shared_prefix,)).astype(np.int32)
               if shared_prefix else None)
     lo = min(shared_prefix + 2, max_prompt)
     trace = []
     for t in arrivals:
-        plen = int(sched.randint(max(lo, 2), max_prompt + 1))
+        plen = (arrival.length(sched, max(lo, 2), max_prompt) if heavy
+                else int(sched.randint(max(lo, 2), max_prompt + 1)))
         prompt = rng.randint(0, 256, (plen,)).astype(np.int32)
         if header is not None:
             prompt[:shared_prefix] = header
-        trace.append((float(t), prompt, int(sched.choice(mnt_choices))))
+        mnt = (arrival.pick(sched, mnt_choices) if heavy
+               else int(sched.choice(mnt_choices)))
+        trace.append((float(t), prompt, mnt))
     return trace
 
 
@@ -117,20 +199,24 @@ def build_session_trace(groups, group_size, rate, header_tokens,
     session's header on ONE replica (~1 cold prefill per session);
     round-robin scatters it over N cold tries."""
     rng = np.random.RandomState(seed)
-    sched = rng if arrival is None else np.random.RandomState(arrival)
+    heavy = isinstance(arrival, ArrivalSpec)
+    sched = rng if arrival is None else np.random.RandomState(
+        arrival.seed if heavy else arrival)
     headers = [rng.randint(0, 256, (header_tokens,)).astype(np.int32)
                for _ in range(groups)]
     order = np.repeat(np.arange(groups), group_size)
     sched.shuffle(order)
-    arrivals = np.cumsum(sched.exponential(1.0 / rate, order.size))
+    arrivals = np.cumsum(arrival.gaps(sched, rate, order.size) if heavy
+                         else sched.exponential(1.0 / rate, order.size))
     trace = []
     for t, g in zip(arrivals, order):
-        tail = rng.randint(0, 256,
-                           (int(sched.randint(tail_lo, tail_hi + 1)),)
-                           ).astype(np.int32)
+        tlen = (arrival.length(sched, tail_lo, tail_hi) if heavy
+                else int(sched.randint(tail_lo, tail_hi + 1)))
+        tail = rng.randint(0, 256, (tlen,)).astype(np.int32)
         prompt = np.concatenate([headers[int(g)], tail])
-        trace.append((float(t), int(g), prompt,
-                      int(sched.choice(mnt_choices))))
+        mnt = (arrival.pick(sched, mnt_choices) if heavy
+               else int(sched.choice(mnt_choices)))
+        trace.append((float(t), int(g), prompt, mnt))
     return trace
 
 
@@ -877,8 +963,35 @@ class Bench:
             tail_lo, tail_hi, mnts, a.seed,
             arrival=parse_arrival(a.arrival)), header
 
+    def _proc_spec(self):
+        """WorkerSpec mirroring this bench's cfg + engine geometry —
+        every spawned worker re-derives the SAME weights
+        (params_seed=0 == the parent's PRNGKey(0)), so proc and
+        in-process arms decode identical streams and A/B cleanly."""
+        from paddle_tpu.serving.engine import _default_buckets
+        from paddle_tpu.serving.fleet.proc import WorkerSpec
+        a = self.args
+        cfg_kw = dict(
+            vocab_size=256, hidden_size=a.hidden,
+            intermediate_size=2 * a.hidden,
+            num_hidden_layers=a.layers,
+            num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=a.max_prompt + max(a.mnt_choices),
+            dtype="float32", use_flash_attention=False, remat=False)
+        engine_kw = dict(max_batch=a.max_batch, page_size=a.page_size,
+                         max_prompt_len=a.max_prompt,
+                         max_new_tokens_cap=self.mnt_cap,
+                         prompt_buckets=_default_buckets(a.max_prompt),
+                         decode_block_size=a.decode_block,
+                         prefix_cache=not a.no_prefix_cache,
+                         prefill_chunk=a.prefill_chunk or None,
+                         admission_window=a.admission_window,
+                         check_invariants=a.check_invariants or None)
+        return WorkerSpec(cfg_kw=cfg_kw, params_seed=0,
+                          engine_kw=engine_kw, warm=True)
+
     def _fleet_run(self, n, policy, strace, *, paced=True,
-                   sequential=True, kill_at=None):
+                   sequential=True, kill_at=None, proc=False):
         """One fleet arm over ``[(arrival, group, prompt, mnt)]``.
 
         ``sequential=True`` replays each group as a MULTI-TURN session
@@ -896,8 +1009,13 @@ class Bench:
         from collections import defaultdict
 
         from paddle_tpu.serving.fleet import SERVING, ServingFleet
-        fleet = ServingFleet(lambda: self._mk_engine(), replicas=n,
-                             policy=policy)
+        if proc:
+            from paddle_tpu.serving.fleet.proc import ProcServingFleet
+            fleet = ProcServingFleet(self._proc_spec(), replicas=n,
+                                     policy=policy)
+        else:
+            fleet = ServingFleet(lambda: self._mk_engine(), replicas=n,
+                                 policy=policy)
         fleet.arm_sentinels()
         nreq = len(strace)
         handles = [None] * nreq
@@ -1036,17 +1154,18 @@ class Bench:
         """
         a = self.args
         n = max(a.replicas, 2)
+        proc = bool(getattr(a, "proc", False))
         strace, header = self._session_trace()
-        single_s = self._fleet_run(1, "affinity", strace)
-        aff = self._fleet_run(n, "affinity", strace)
-        rr = self._fleet_run(n, "round_robin", strace)
+        single_s = self._fleet_run(1, "affinity", strace, proc=proc)
+        aff = self._fleet_run(n, "affinity", strace, proc=proc)
+        rr = self._fleet_run(n, "round_robin", strace, proc=proc)
         ftrace = [(arr, 0, p, mnt) for arr, p, mnt in trace]
         flood_1 = self._fleet_run(1, "affinity", ftrace, paced=False,
-                                  sequential=False)
+                                  sequential=False, proc=proc)
         flood_n = self._fleet_run(n, "affinity", ftrace, paced=False,
-                                  sequential=False)
+                                  sequential=False, proc=proc)
         out = {
-            "mode": "fleet", "replicas": n,
+            "mode": "fleet", "proc": proc, "replicas": n,
             "workload": {
                 "groups": a.fleet_groups,
                 "group_size": a.fleet_group_size,
@@ -1070,7 +1189,7 @@ class Bench:
             kill_at = max(1, int(0.4 * len(ftrace)))
             kill_row = self._fleet_run(n, "affinity", ftrace,
                                        paced=False, sequential=False,
-                                       kill_at=kill_at)
+                                       kill_at=kill_at, proc=proc)
             out["kill"] = kill_row["kill"]
             out["kill"]["completed"] = kill_row["completed"]
         return out
@@ -1202,11 +1321,19 @@ def main(argv=None):
                          "passing N>1 selects the fleet mode when "
                          "--modes was not given")
     ap.add_argument("--arrival", default=None,
-                    help="seeded replayable arrival schedule, "
-                         "'seed:K': inter-arrival gaps + prompt-length "
-                         "+ mnt draws come from RandomState(K), "
-                         "independent of --seed (content) — the same "
-                         "spec replays the identical schedule")
+                    help="seeded replayable arrival schedule. "
+                         "'seed:K': gaps/lengths/mnt from "
+                         "RandomState(K), independent of --seed "
+                         "(content) — the same spec replays the "
+                         "identical schedule. 'lognormal:K[:sigma]' / "
+                         "'pareto:K[:alpha]': same replay contract "
+                         "with HEAVY-TAILED gaps + prompt/output "
+                         "lengths (defaults sigma=1.5, alpha=1.5)")
+    ap.add_argument("--proc", action="store_true",
+                    help="fleet mode: run replicas as worker "
+                         "PROCESSES (serving.fleet.proc) instead of "
+                         "in-process engines — same JSON schema, so "
+                         "the two are directly A/B-able")
     ap.add_argument("--fleet-groups", type=int, default=8,
                     help="fleet mode: distinct shared-prefix sessions "
                          "(each gets its own system-prompt header)")
