@@ -101,12 +101,23 @@ def _chain_depth(nd) -> int:
 
 
 def audit_serving_state(pool, scheduler=None, prefix_cache=None,
-                        prefill_queue=None) -> List[Violation]:
+                        prefill_queue=None, extra_refs=None,
+                        extra_pages=None) -> List[Violation]:
     """Full audit of one serving stack's host-side state. Callers must
     hold whatever lock serializes mutation (the engine's tick lock);
     the checker only reads. ``prefill_queue=None`` means "unknown" —
-    the parked-but-not-queued liveness check is skipped."""
+    the parked-but-not-queued liveness check is skipped.
+
+    ``extra_refs`` (``{id(node): count}``) are trie refcounts held by
+    something OTHER than a live request's attached chain — the chunked
+    migration protocol pins exported chains and adopt graft points for
+    a transfer's lifetime; without declaring them the refcount-drift
+    check would fire on every in-flight transfer. ``extra_pages``
+    (``{page_id: label}``) are allocated pages owned by a pending
+    chunked adopt — scattered into but not yet grafted into the trie —
+    which the partition check must count as owned, not leaked."""
     v: List[Violation] = []
+    extra_refs = extra_refs or {}
     total = pool.total_pages
     trash = pool.TRASH
 
@@ -154,6 +165,10 @@ def audit_serving_state(pool, scheduler=None, prefix_cache=None,
         for slot, req in live_reqs:
             for p in req.pages:
                 own(p, "req-private", req.id)
+
+    if extra_pages:
+        for page, label in extra_pages.items():
+            own(int(page), "pending-adopt", label)
 
     for page, who in owners.items():
         if not 0 < page < total:
@@ -206,7 +221,8 @@ def audit_serving_state(pool, scheduler=None, prefix_cache=None,
                 expected[id(nd)] = expected.get(id(nd), 0) + 1
         by_id = {id(nd): nd for nd in cached_nodes}
         for nd in cached_nodes:
-            want = expected.get(id(nd), 0)
+            want = expected.get(id(nd), 0) + int(extra_refs.get(id(nd),
+                                                                0))
             if nd.refs != want:
                 v.append(Violation(
                     "refcount-drift",
@@ -391,6 +407,8 @@ def audit_engine(engine) -> List[Violation]:
     """Standalone audit of a live ``ServingEngine`` (grabs the tick
     lock so the state it reads is a consistent snapshot)."""
     with engine._tick_lock:
+        extra_refs, extra_pages = engine._audit_extras()
         return audit_serving_state(
             engine.pool, engine.scheduler, engine.prefix_cache,
-            prefill_queue=tuple(engine._prefill_q))
+            prefill_queue=tuple(engine._prefill_q),
+            extra_refs=extra_refs, extra_pages=extra_pages)

@@ -196,6 +196,15 @@ def save_checkpoint(state_dict: Dict[str, Any], root: str, step: int,
     """Save ``state_dict`` under ``root/step_<step>``; with ``keep``,
     prune all but the newest ``keep`` completed steps.
 
+    Storage requirement: provision each root for ``keep + 1`` full
+    checkpoints, not ``keep`` — the new step is written BEFORE older
+    steps are pruned (crash-safety: never delete the only good copy),
+    so disk peaks at ``keep`` retained + 1 in-flight. With per-host
+    private roots that budget applies to EVERY host's local disk; a
+    shared root pays it once. An async save widens the peak window
+    (pruning still runs at schedule time, but the new step's bytes
+    land when the commit completes).
+
     Pruning never touches steps >= the current one (an in-flight async
     commit must survive) and counts the just-scheduled step even when an
     async save has not committed it yet. WHO prunes depends on the

@@ -59,17 +59,19 @@ import os
 import threading
 import time
 from functools import partial
-from typing import Optional
+from typing import Dict, Optional
 
 import numpy as np
 
 from collections import deque
 
+import itertools
+
 from ..inference.paged_kv import PagePool, apply_defrag
 from ..observability import FlightRecorder, RecompileSentinel, SpanTracer
 from ..profiler import RecordEvent
 from .metrics import ServingMetrics
-from .prefix_cache import PrefixCache
+from .prefix_cache import ColdTier, PrefixCache, _fp_extend
 from .scheduler import (CANCELLED, COMPLETED, REJECTED, TIMED_OUT,
                         Request, RequestHandle, Scheduler)
 
@@ -274,6 +276,28 @@ class ServingEngine:
     ``enumerate_tick_programs``.
     spec_k: draft-length CAP (static — the one extra compile knob; a
     slot's actual per-tick draft count is device data).
+    cold_tier_bytes: 0 (default, off) or a host-RAM byte budget for
+    the COLD TIER (prefix_cache.ColdTier): refcount-0 chains evicted
+    under page pressure page out to host memory (keyed by the same
+    chain fingerprints migration and the fleet router use) instead of
+    being discarded, and a queued prompt whose warm trie match ends
+    where a spilled chain begins re-adopts the pages (alloc + scatter
+    + graft, one rewarm pass before each admission) instead of
+    recomputing prefill. Outputs stay bitwise-equal to a warm hit —
+    the stored bytes ARE the bytes the device computed — and a
+    fingerprint collision is detected by exact token-tuple comparison
+    before anything is adopted. Metrics: cold_hits / cold_hit_pages /
+    cold_spills counters, cold_adopt_s histogram, cold_tier_* gauges.
+    on_chain_complete: optional callback ``fn(req, info)`` fired (tick
+    lock held — keep it cheap/non-blocking, e.g. enqueue an event)
+    when a request's prefill completes having registered/extended a
+    prefix chain; ``info`` carries ``{"fp", "fps", "pages",
+    "prompt_tokens"}`` with ``fp`` the deepest chain fingerprint and
+    ``fps`` the cumulative per-page fingerprints. This is the
+    chain-completion EVENT the fleet's migration policy rides: a
+    prefill-pool worker surfaces it to the router, which picks a
+    decode-pool target and drives the chunked transfer with no caller
+    involvement (serving/fleet/proc/fleet.py).
     """
 
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
@@ -295,7 +319,9 @@ class ServingEngine:
                  flight_dir: Optional[str] = None,
                  recompile_sentinel: Optional[bool] = None,
                  speculative=None,
-                 spec_k: int = 3):
+                 spec_k: int = 3,
+                 cold_tier_bytes: int = 0,
+                 on_chain_complete=None):
         if max_batch < 1:
             raise ValueError("max_batch must be >= 1")
         if prefill_chunk is not None:
@@ -460,6 +486,28 @@ class ServingEngine:
         # device-side cache of the composition-dependent sampling
         # arrays (see _sampling_arrays); None = rebuild next tick
         self._samp_cache = None
+
+        # ------------------------------------- migration + cold tier ----
+        # chain-completion hook (fired by _finish_prefill, tick lock
+        # held) — the fleet wires this to surface events to the router
+        self.on_chain_complete = on_chain_complete
+        # in-flight chunked transfers, both directions. Exports pin
+        # their chain nodes (refs+1, released at export_chain_end);
+        # adopts own freshly-allocated pages that no scheduler row or
+        # trie node references yet, plus pins on the matched warm
+        # prefix. Both are declared to the KV auditor via
+        # _audit_extras() so CHECK_INVARIANTS stays clean mid-transfer.
+        self._exports: Dict[int, dict] = {}
+        self._adopts: Dict[int, dict] = {}
+        self._xfer_ids = itertools.count(1)
+        # host-RAM cold tier: refcount-0 chains evicted under pressure
+        # spill here (PrefixCache.spill hook) and rewarm on a prefix
+        # match instead of recomputing prefill — see class docstring
+        self._cold = (ColdTier(int(cold_tier_bytes))
+                      if int(cold_tier_bytes) > 0
+                      and self.prefix_cache is not None else None)
+        if self._cold is not None:
+            self.prefix_cache.spill = self._spill_node
 
         self._cond = threading.Condition()
         self._tick_lock = threading.Lock()
@@ -646,6 +694,8 @@ class ServingEngine:
         }
         if self.prefix_cache is not None:
             g["prefix_cache"] = self.prefix_cache.stats()
+        if self._cold is not None:
+            g["cold_tier"] = self._cold.stats()
         return g
 
     def snapshot(self) -> dict:
@@ -778,6 +828,266 @@ class ServingEngine:
             pc.adopt_chain(tokens, pages, start=have)
         return {"matched_pages": have, "adopted_pages": need}
 
+    # ------------------------------------- chunked (overlapped) transfer ----
+    # The whole-blob export/adopt above stalls BOTH tick loops for the
+    # full gather/scatter. The chunked protocol splits the transfer so
+    # neither worker's tick loop ever holds the tick lock longer than
+    # ONE bounded chunk: begin snapshots/pins under the lock, chunks
+    # stream between ticks, and the trie graft happens only at commit —
+    # exactly-once, with abort/end making any partial transfer
+    # invisible. The fleet drives this (fleet/proc/fleet.py
+    # ``migrate_chain``); in-flight state is declared to the KV auditor
+    # via ``_audit_extras`` so CHECK_INVARIANTS stays clean mid-flight.
+
+    def export_chain_begin(self, fp: int,
+                           max_depth: int = 64) -> Optional[dict]:
+        """Open a chunked export: resolve the chain for ``fp``, PIN its
+        nodes (refs+1 — eviction and defrag-freeing cannot touch them
+        while the transfer streams), and return the transfer header
+        ``{"xid", "fp", "page_size", "tokens"}`` (no KV bytes yet) —
+        or ``None`` when nothing hashes to ``fp``. Pins release at
+        :meth:`export_chain_end` (also call it on failure paths)."""
+        if self.prefix_cache is None:
+            return None
+        with self._tick_lock:
+            nodes = self.prefix_cache.chain_by_fingerprint(fp, max_depth)
+            if not nodes:
+                return None
+            for nd in nodes:
+                nd.refs += 1
+            xid = next(self._xfer_ids)
+            self._exports[xid] = {"nodes": nodes}
+            tokens = [tuple(int(t) for t in nd.toks) for nd in nodes]
+        return {"xid": xid, "fp": int(fp),
+                "page_size": int(self.pool.page_size), "tokens": tokens}
+
+    def export_chain_chunk(self, xid: int, start: int,
+                           count: int) -> dict:
+        """Gather one bounded chunk of the pinned export: pages
+        ``[start, start+count)`` of the chain, returned as
+        ``{"start", "count", "k", "v"}`` numpy blobs. Page ids are
+        re-read from the live nodes at gather time, so a defrag that
+        ran between chunks (``PrefixCache.remap``) is harmless — the
+        pins only stop the pages being FREED, not moved."""
+        jnp = self._jnp
+        with self._tick_lock:
+            ent = self._exports[xid]
+            nodes = ent["nodes"][start:start + count]
+            idx = jnp.asarray([nd.page for nd in nodes], jnp.int32)
+            k = np.asarray(jnp.take(self._kp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
+            v = np.asarray(jnp.take(self._vp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
+        return {"start": int(start), "count": len(nodes), "k": k, "v": v}
+
+    def export_chain_end(self, xid: int) -> None:
+        """Close a chunked export and release its pins. Idempotent —
+        an unknown/already-closed ``xid`` is a no-op, so failure paths
+        can call it unconditionally."""
+        with self._tick_lock:
+            ent = self._exports.pop(xid, None)
+            if ent is None:
+                return
+            for nd in ent["nodes"]:
+                nd.refs -= 1
+
+    def adopt_chain_begin(self, header: dict) -> dict:
+        """Open a chunked adopt from an :meth:`export_chain_begin`
+        header: match the warm prefix, PIN the matched nodes, allocate
+        pages for the uncached suffix (evicting under pressure, same
+        policy as admission) and return ``{"aid", "matched_pages",
+        "need"}``. When the whole chain is already cached, ``aid`` is
+        None and no state is held. The allocated pages belong to the
+        transfer (not the trie) until :meth:`adopt_chain_commit`;
+        :meth:`adopt_chain_abort` frees them. Raises ValueError on a
+        page-size mismatch, RuntimeError when the suffix cannot fit."""
+        if self.prefix_cache is None:
+            raise RuntimeError("adopt_chain needs prefix_cache=True")
+        if int(header["page_size"]) != int(self.pool.page_size):
+            raise ValueError(
+                f"page-size mismatch: exported {header['page_size']}, "
+                f"this engine serves {self.pool.page_size}")
+        tokens = [tuple(int(t) for t in tt) for tt in header["tokens"]]
+        with self._tick_lock:
+            pc = self.prefix_cache
+            pinned = pc.chain_nodes(tokens)
+            have = len(pinned)
+            need = len(tokens) - have
+            if need == 0:
+                return {"aid": None, "matched_pages": have, "need": 0}
+            if not self.pool.can_alloc(need):
+                pc.evict(need - self.pool.free_pages)
+            if not self.pool.can_alloc(need):
+                raise RuntimeError(
+                    f"cannot adopt chain: {need} pages needed, "
+                    f"{self.pool.free_pages} free after eviction")
+            for nd in pinned:
+                nd.refs += 1
+            pages = self.pool.alloc(need)
+            aid = next(self._xfer_ids)
+            self._adopts[aid] = {"tokens": tokens, "have": have,
+                                 "pages": pages, "pinned": pinned,
+                                 "filled": 0}
+        return {"aid": aid, "matched_pages": have, "need": need}
+
+    def adopt_chain_chunk(self, aid: int, start: int, k, v) -> None:
+        """Scatter one exported chunk (chain-page index ``start``,
+        blobs from :meth:`export_chain_chunk`) into this transfer's
+        pre-allocated pages. Chunks may arrive in any order; commit
+        checks completeness."""
+        jnp = self._jnp
+        with self._tick_lock:
+            ent = self._adopts[aid]
+            off = int(start) - ent["have"]
+            count = int(k.shape[2])
+            idx = jnp.asarray(ent["pages"][off:off + count], jnp.int32)
+            self._kp = self._kp.at[:, :, idx].set(jnp.asarray(k))
+            self._vp = self._vp.at[:, :, idx].set(jnp.asarray(v))
+            ent["filled"] += count
+
+    def adopt_chain_commit(self, aid: int) -> dict:
+        """Finalize a chunked adopt: verify every suffix page arrived,
+        re-check the warm match (a LOCAL prefill may have inserted the
+        same chain while chunks streamed — the duplicated leading
+        pages are freed instead of grafted, exactly-once by token
+        equality), graft the remainder into the trie at refs=0, and
+        release the prefix pins. Returns ``{"matched_pages",
+        "adopted_pages"}`` mirroring :meth:`adopt_chain`."""
+        with self._tick_lock:
+            ent = self._adopts.pop(aid)
+            pc = self.prefix_cache
+            dup = 0
+            try:
+                need = len(ent["tokens"]) - ent["have"]
+                if ent["filled"] != need:
+                    raise RuntimeError(
+                        f"adopt_chain_commit: {ent['filled']} of "
+                        f"{need} suffix pages arrived")
+                now_have = pc.match_chain(ent["tokens"])
+                dup = max(0, now_have - ent["have"])
+                if dup > 0:
+                    self.pool.free(ent["pages"][:dup])
+                pc.adopt_chain(ent["tokens"], ent["pages"][dup:],
+                               start=now_have)
+            except BaseException:
+                self.pool.free(ent["pages"][dup:])
+                raise
+            finally:
+                for nd in ent["pinned"]:
+                    nd.refs -= 1
+        return {"matched_pages": ent["have"],
+                "adopted_pages": len(ent["pages"]) - dup}
+
+    def adopt_chain_abort(self, aid: int) -> None:
+        """Abandon a chunked adopt: free its pages, release its pins.
+        Idempotent on unknown ``aid`` — safe from any failure path."""
+        with self._tick_lock:
+            ent = self._adopts.pop(aid, None)
+            if ent is None:
+                return
+            self.pool.free(ent["pages"])
+            for nd in ent["pinned"]:
+                nd.refs -= 1
+
+    def _audit_extras(self):
+        """(extra_refs, extra_pages) describing in-flight chunked
+        transfers for ``audit_serving_state`` — export/adopt pins as
+        per-node refcount credits, adopt-owned pages as expected
+        allocations. Caller holds the tick lock."""
+        extra_refs: Dict[int, int] = {}
+        extra_pages: Dict[int, str] = {}
+        for xid, ent in self._exports.items():
+            for nd in ent["nodes"]:
+                extra_refs[id(nd)] = extra_refs.get(id(nd), 0) + 1
+        for aid, ent in self._adopts.items():
+            for nd in ent["pinned"]:
+                extra_refs[id(nd)] = extra_refs.get(id(nd), 0) + 1
+            for p in ent["pages"]:
+                extra_pages[int(p)] = f"adopt-{aid}"
+        return extra_refs, extra_pages
+
+    # -------------------------------------------- host-memory cold tier ----
+    def _spill_node(self, nd) -> None:
+        """``PrefixCache.spill`` hook: page one evicted refcount-0
+        chain node's KV out to the host-RAM cold tier before its
+        device page is freed. Runs inside ``PrefixCache.evict`` —
+        tick lock already held; failures are swallowed by the caller
+        (spill is an optimization, eviction must always succeed)."""
+        if self._cold is None:
+            return
+        fp = self.prefix_cache.node_fingerprint(nd)
+        jnp = self._jnp
+        idx = jnp.asarray([nd.page], jnp.int32)
+        k = np.asarray(jnp.take(self._kp, idx, axis=2))  # noqa: PT005 — cold-tier spill is a sanctioned one-shot device pull
+        v = np.asarray(jnp.take(self._vp, idx, axis=2))  # noqa: PT005 — cold-tier spill is a sanctioned one-shot device pull
+        if self._cold.put(fp, nd.toks, k, v):
+            self.metrics.inc("cold_spills")
+
+    def _rewarm_cold(self) -> None:
+        """Cold-tier rewarm (engine loop, tick lock held, right before
+        admission): for each prompt at the admission frontier, if its
+        warm trie match ends where a spilled chain begins, re-adopt
+        the contiguous cold run — alloc + scatter + graft — so
+        ``_try_reserve`` attaches it as an ordinary warm hit and the
+        suffix prefill never recomputes those pages. Every adopted
+        page is verified by exact token-tuple equality (the
+        fingerprint only indexes); decode over re-adopted pages is
+        bitwise-equal to never having evicted. Best-effort: any
+        failure skips the request, never the loop."""
+        pc = self.prefix_cache
+        ps = self.pool.page_size
+        jnp = self._jnp
+        for req in self.scheduler.peek_queued(4):
+            try:
+                max_pages = (int(req.prompt.size) - 1) // ps
+                if max_pages <= 0:
+                    continue
+                tuples = [tuple(int(t) for t in
+                                req.prompt[i * ps:(i + 1) * ps])
+                          for i in range(max_pages)]
+                warm = pc.match_chain(tuples)
+                fp, fps = 0, []
+                for tt in tuples:
+                    fp = _fp_extend(fp, tt)
+                    fps.append(fp)
+                run = []
+                for i in range(warm, max_pages):
+                    ent = self._cold.get(fps[i])
+                    if ent is None or ent["toks"] != tuples[i]:
+                        break       # fp collision or gap: stop the run
+                    run.append(ent)
+                if not run:
+                    continue
+                t0 = time.monotonic()
+                n = len(run)
+                if not self.pool.can_alloc(n):
+                    # evict under pressure — with the warm prefix
+                    # PINNED: its leaf may be refs-0/childless (prime
+                    # eviction food) and the graft below walks it
+                    pinned = pc.chain_nodes(tuples[:warm])
+                    for nd in pinned:
+                        nd.refs += 1
+                    try:
+                        pc.evict(n - self.pool.free_pages)
+                    finally:
+                        for nd in pinned:
+                            nd.refs -= 1
+                if not self.pool.can_alloc(n):
+                    continue        # no room: leave it cold
+                pages = self.pool.alloc(n)
+                idx = jnp.asarray(pages, jnp.int32)
+                k = np.concatenate([e["k"] for e in run], axis=2)
+                v = np.concatenate([e["v"] for e in run], axis=2)
+                self._kp = self._kp.at[:, :, idx].set(jnp.asarray(k))
+                self._vp = self._vp.at[:, :, idx].set(jnp.asarray(v))
+                pc.adopt_chain(tuples[:warm + n], pages, start=warm)
+                for i in range(warm, warm + n):
+                    self._cold.pop(fps[i])
+                self.metrics.inc("cold_hits")
+                self.metrics.inc("cold_hit_pages", n)
+                self.metrics.observe("cold_adopt_s",
+                                     time.monotonic() - t0)
+            except Exception:
+                continue    # rewarm is opportunistic, never fatal
+
     def export_trace(self, path: str) -> str:
         """Write the span tracer's ring as Perfetto-loadable
         Chrome-trace JSON (one track per engine phase + per slot);
@@ -880,9 +1190,11 @@ class ServingEngine:
         ticks): returns the violation list — empty when healthy."""
         from ..analysis.kv_invariants import audit_serving_state
         with self._tick_lock:
+            extra_refs, extra_pages = self._audit_extras()
             return audit_serving_state(
                 self.pool, self.scheduler, self.prefix_cache,
-                prefill_queue=tuple(self._prefill_q))
+                prefill_queue=tuple(self._prefill_q),
+                extra_refs=extra_refs, extra_pages=extra_pages)
 
     def _geometry_desc(self) -> str:
         """One-line engine geometry for diagnostics: every raise and
@@ -901,9 +1213,11 @@ class ServingEngine:
         from ..analysis.kv_invariants import (KVInvariantError,
                                               audit_serving_state)
         with self.tracer.span("serving.audit", track="engine.audit"):
+            extra_refs, extra_pages = self._audit_extras()
             violations = audit_serving_state(
                 self.pool, self.scheduler, self.prefix_cache,
-                prefill_queue=tuple(self._prefill_q))
+                prefill_queue=tuple(self._prefill_q),
+                extra_refs=extra_refs, extra_pages=extra_pages)
         if violations:
             self.metrics.inc("invariant_violations", len(violations))
             raise KVInvariantError(violations,
@@ -938,6 +1252,12 @@ class ServingEngine:
             self.scheduler.remap_pages(plan)  # per-request page LISTS
             if self.prefix_cache is not None:
                 self.prefix_cache.remap(plan)  # cached-node page ids
+            # pending chunked-adopt pages are allocated (so the plan
+            # covers them) but live only in the transfer entries —
+            # remap those lists too or the eventual graft/scatter
+            # would target stale ids
+            for ent in self._adopts.values():
+                ent["pages"] = [plan.get(p, p) for p in ent["pages"]]
             self.pool.commit_defrag(plan)
             if self._check_invariants:
                 try:
@@ -1181,6 +1501,28 @@ class ServingEngine:
                     req.prompt, req.prefix_nodes, req.pages[:new_full])
                 req.prefix_nodes = req.prefix_nodes + adopted
                 req.pages = dup + req.pages[new_full:]
+            # chain-completion event: this prefill just registered /
+            # extended a prefix chain — surface its cumulative page
+            # fingerprints so a fleet policy can hand the chain to a
+            # decode-pool worker. Fingerprints are recomputed from the
+            # PROMPT (not req.prefix_nodes — dedup can make the node
+            # list skip chain nodes). Tick lock is held: the hook must
+            # stay cheap (the fleet worker just enqueues an event).
+            if self.on_chain_complete is not None:
+                ps = self.pool.page_size
+                n_pages = n // ps
+                if n_pages > 0:
+                    fp, fps = 0, []
+                    for i in range(n_pages):
+                        fp = _fp_extend(
+                            fp, req.prompt[i * ps:(i + 1) * ps])
+                        fps.append(fp)
+                    try:
+                        self.on_chain_complete(req, {
+                            "fp": fps[-1], "fps": fps,
+                            "pages": n_pages, "prompt_tokens": int(n)})
+                    except Exception:
+                        pass    # policy failure must not kill the tick
         self.scheduler.lengths[slot] = n
         self._cur_tok[slot] = tok
         if self._emit(slot, req, tok):
@@ -1527,6 +1869,13 @@ class ServingEngine:
                         if handed:
                             self._returned.extend(handed)
                             self.metrics.inc("handed_back", len(handed))
+                    if self._cold is not None and len(self._cold) \
+                            and self.scheduler.queued():
+                        # cold-tier rewarm BEFORE admission: a queued
+                        # prompt whose warm match ends where a spilled
+                        # chain begins re-adopts those pages now, so
+                        # _try_reserve sees them as a warm hit
+                        self._rewarm_cold()
                     t_adm = time.monotonic()
                     with RecordEvent("serving.admit"):
                         admitted = self.scheduler.admit()
@@ -1621,7 +1970,11 @@ class ServingEngine:
             if self.prefix_cache is not None:
                 # teardown hygiene: every request is retired, so all
                 # cached pages are refcount-0 — return them so the pool
-                # ends balanced (used_pages == 0 after close)
+                # ends balanced (used_pages == 0 after close). Detach
+                # the cold-tier spill hook first: teardown eviction is
+                # disposal, not pressure — spilling the whole trie to
+                # host RAM on close would be pure waste.
+                self.prefix_cache.spill = None
                 self.prefix_cache.evict(self.prefix_cache.cached_pages)
 
     def _fail_all(self, e: BaseException) -> None:

@@ -42,9 +42,9 @@ from typing import Callable, Dict, List, Optional
 
 from ..metrics import merge_exposition
 from ..scheduler import RequestHandle
-from .replica import (DRAINING, GONE, JOINING, ROLE_GENERAL, SERVING,
-                      Replica)
-from .router import FleetRouter
+from .replica import (DRAINING, GONE, JOINING, ROLE_DECODE,
+                      ROLE_GENERAL, ROLE_PREFILL, SERVING, Replica)
+from .router import FleetRouter, _rendezvous
 
 __all__ = ["ServingFleet"]
 
@@ -76,7 +76,9 @@ class ServingFleet:
                  roles: Optional[List[str]] = None,
                  policy: str = "affinity", summary_depth: int = 2,
                  prefill_len_ratio: float = 1.0, warm: bool = True,
-                 name_prefix: str = "r"):
+                 name_prefix: str = "r",
+                 health_ttl_s: Optional[float] = None,
+                 auto_migrate: Optional[bool] = None):
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
         self._factory = engine_factory
@@ -88,11 +90,23 @@ class ServingFleet:
         self._leaving: set = set()      # names mid-_leave: makes the
         # leave accounting (generation bump + drain/kill counter)
         # exactly-once under concurrent drain/kill/reap of one replica
-        self.router = FleetRouter(policy=policy,
-                                  summary_depth=summary_depth,
-                                  prefill_len_ratio=prefill_len_ratio)
+        router_kw = dict(policy=policy, summary_depth=summary_depth,
+                         prefill_len_ratio=prefill_len_ratio)
+        if health_ttl_s is not None:
+            # router staleness window (summary/load TTL caches)
+            router_kw["summary_ttl_s"] = float(health_ttl_s)
+        self.router = FleetRouter(**router_kw)
+        # router-driven prefill->decode handoff (same policy as the
+        # proc fleet): defaults ON exactly when both pools exist
+        role_list = list(roles or ())
+        if auto_migrate is None:
+            auto_migrate = (ROLE_PREFILL in role_list
+                            and ROLE_DECODE in role_list)
+        self.auto_migrate = bool(auto_migrate)
+        self._migrating: set = set()
         self.counters = {"joins": 0, "drains": 0, "kills": 0,
-                         "handed_back": 0, "closed": 0}
+                         "handed_back": 0, "closed": 0,
+                         "migrations": 0, "migration_failed": 0}
         for i in range(replicas):
             role = roles[i % len(roles)] if roles else ROLE_GENERAL
             self.join(role=role, warm=warm)
@@ -126,6 +140,15 @@ class ServingFleet:
         with self._lock:
             self._replicas[name] = rep
         rep.start(warm=warm)
+        if self.auto_migrate and role == ROLE_PREFILL \
+                and rep.engine is not None:
+            # wire the engine's chain-completion hook to the fleet's
+            # migration policy; the hook fires under the engine's tick
+            # lock, so it must only capture the event — the transfer
+            # runs on a background thread (_on_chain_complete)
+            rep.engine.on_chain_complete = (
+                lambda req, info, _rep=rep:
+                self._on_chain_complete(_rep, info))
         self.router.add(rep)
         self._inc("joins")
         return rep
@@ -184,6 +207,57 @@ class ServingFleet:
                 self.kill(rep.name)
                 reaped.append(rep.name)
         return reaped
+
+    # --------------------------------------------------------- migration ---
+    def migrate_chain(self, fp: int, src: str, dst: str,
+                      max_depth: int = 64) -> Optional[dict]:
+        """Move a completed chain's KV pages ``src`` -> ``dst`` by trie
+        fingerprint (in-process twin of the proc fleet's
+        ``migrate_chain``; engines share an address space, so the
+        transfer is one export + one adopt). The source keeps its copy
+        — migration is replication."""
+        s = self.replica(src).engine
+        d = self.replica(dst).engine
+        if s is None or d is None:
+            return None
+        blob = s.export_chain(fp, max_depth)
+        if blob is None:
+            return None
+        return d.adopt_chain(blob)
+
+    def _on_chain_complete(self, rep: Replica, info: dict) -> None:
+        """Chain-completion hook (fires under ``rep``'s engine tick
+        lock): pick the decode-pool target by rendezvous hash and run
+        the handoff on a background thread — export_chain re-takes the
+        source's tick lock, so migrating inline would deadlock."""
+        fp = int(info["fp"])
+        with self._lock:
+            if fp in self._migrating:
+                return
+            self._migrating.add(fp)
+        pool = [r for r in self.router.replicas()
+                if r.serving and r.role == ROLE_DECODE
+                and r.name != rep.name]
+        if not pool:
+            with self._lock:
+                self._migrating.discard(fp)
+            return
+        dst = max(pool, key=lambda r: _rendezvous(fp, r.name))
+
+        def _go():
+            try:
+                res = self.migrate_chain(fp, rep.name, dst.name)
+                if res is not None:
+                    self._inc("migrations")
+                    self.router.note_migration(
+                        info.get("fps", [fp]), dst.name)
+            except Exception:
+                self._inc("migration_failed")
+            finally:
+                with self._lock:
+                    self._migrating.discard(fp)
+        threading.Thread(target=_go, daemon=True,
+                         name=f"migrate-{rep.name}-{dst.name}").start()
 
     # --------------------------------------------------------- admission ----
     def submit(self, prompt, max_new_tokens: int,

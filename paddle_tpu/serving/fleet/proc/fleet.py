@@ -31,8 +31,9 @@ from typing import Dict, List, Optional
 
 from ...metrics import merge_exposition
 from ...scheduler import RequestHandle
-from ..replica import DRAINING, GONE, JOINING, ROLE_GENERAL, SERVING
-from ..router import FleetRouter
+from ..replica import (DRAINING, GONE, JOINING, ROLE_DECODE,
+                       ROLE_GENERAL, ROLE_PREFILL, SERVING)
+from ..router import FleetRouter, _rendezvous
 from .replica import ProcReplica
 
 __all__ = ["ProcServingFleet"]
@@ -53,22 +54,44 @@ class ProcServingFleet:
                  name_prefix: str = "w",
                  start_timeout: float = 180.0,
                  rpc_timeout: float = 30.0,
-                 drain_timeout: float = 120.0):
+                 drain_timeout: float = 120.0,
+                 health_ttl_s: Optional[float] = None,
+                 health_rpc_timeout: float = 5.0,
+                 auto_migrate: Optional[bool] = None,
+                 migrate_chunk_pages: int = 1):
         if replicas < 1:
             raise ValueError("replicas must be >= 1")
         self.spec = spec
         self._prefix = str(name_prefix)
         self._timeouts = (start_timeout, rpc_timeout, drain_timeout)
+        self._health_rpc_timeout = float(health_rpc_timeout)
         self._lock = threading.Lock()
         self._n = 0
         self.generation = 0
         self._replicas: Dict[str, ProcReplica] = {}
         self._leaving: set = set()
-        self.router = FleetRouter(policy=policy,
-                                  summary_depth=summary_depth,
-                                  prefill_len_ratio=prefill_len_ratio)
+        router_kw = dict(policy=policy, summary_depth=summary_depth,
+                         prefill_len_ratio=prefill_len_ratio)
+        if health_ttl_s is not None:
+            # staleness window for the router's TTL-cached summary/
+            # load reads (WorkerSpec deployments tune this per fleet)
+            router_kw["summary_ttl_s"] = float(health_ttl_s)
+        self.router = FleetRouter(**router_kw)
+        # router-driven prefill->decode handoff: ON by default exactly
+        # when the fleet is disaggregated (both pools present) —
+        # a chain completed on a prefill worker is then handed to a
+        # rendezvous-chosen decode worker automatically, chunked so
+        # neither tick loop stalls; explicit True/False overrides
+        role_list = list(roles or ())
+        if auto_migrate is None:
+            auto_migrate = (ROLE_PREFILL in role_list
+                            and ROLE_DECODE in role_list)
+        self.auto_migrate = bool(auto_migrate)
+        self.migrate_chunk_pages = max(1, int(migrate_chunk_pages))
+        self._migrating: set = set()    # fps with a handoff in flight
         self.counters = {"joins": 0, "drains": 0, "kills": 0,
-                         "crashes": 0, "handed_back": 0, "closed": 0}
+                         "crashes": 0, "handed_back": 0, "closed": 0,
+                         "migrations": 0, "migration_failed": 0}
         # bring the initial fleet up CONCURRENTLY: spawn + engine
         # build + warm overlap across workers (they are separate
         # processes — this is the first place that buys real time)
@@ -115,8 +138,10 @@ class ProcServingFleet:
             self.generation += 1
             gen = self.generation
         rep = ProcReplica(name, self.spec, role=role, generation=gen,
-                          on_death=self._on_crash, start_timeout=st,
-                          rpc_timeout=rt, drain_timeout=dt)
+                          on_death=self._on_crash,
+                          on_event=self._on_event, start_timeout=st,
+                          rpc_timeout=rt, drain_timeout=dt,
+                          health_rpc_timeout=self._health_rpc_timeout)
         with self._lock:
             self._replicas[name] = rep
         return rep
@@ -222,11 +247,97 @@ class ProcServingFleet:
         (``{"matched_pages", "adopted_pages"}``) or None when ``src``
         does not hold the chain. The source KEEPS its copy (migration
         is replication — the trie refcounts make eviction safe on
-        both sides independently)."""
-        blob = self.replica(src).export_chain(fp, max_depth)
-        if blob is None:
+        both sides independently).
+
+        Since r17 the transfer is CHUNKED and decode-overlapped: the
+        source pins the chain and streams ``migrate_chunk_pages``-page
+        blobs between its ticks, the target scatters them as they
+        arrive, and the trie graft happens only at the final commit —
+        so neither worker's tick loop stalls longer than one chunk's
+        gather/scatter, and a failure at any step leaves both tries
+        exactly as they were (abort frees the target's staged pages,
+        end releases the source's pins)."""
+        s, d = self.replica(src), self.replica(dst)
+        hdr = s.export_chain_begin(fp, max_depth)
+        if hdr is None:
             return None
-        return self.replica(dst).adopt_chain(blob)
+        try:
+            st = d.adopt_chain_begin(
+                {"page_size": hdr["page_size"],
+                 "tokens": hdr["tokens"]})
+            if st["aid"] is None:       # fully cached already
+                return {"matched_pages": st["matched_pages"],
+                        "adopted_pages": 0}
+            try:
+                total = len(hdr["tokens"])
+                step = self.migrate_chunk_pages
+                for i in range(st["matched_pages"], total, step):
+                    ch = s.export_chain_chunk(
+                        hdr["xid"], i, min(step, total - i))
+                    d.adopt_chain_chunk(st["aid"], ch["start"],
+                                        ch["k"], ch["v"])
+                return d.adopt_chain_commit(st["aid"])
+            except BaseException:
+                try:
+                    d.adopt_chain_abort(st["aid"])
+                except Exception:
+                    pass    # target may be the one that died
+                raise
+        finally:
+            try:
+                s.export_chain_end(hdr["xid"])
+            except Exception:
+                pass        # source may be the one that died
+
+    def _on_event(self, rep: ProcReplica, kind: str,
+                  payload: dict) -> None:
+        """Worker event callback (transport pump thread). The policy:
+        a chain COMPLETED on a prefill-pool worker is handed to the
+        decode pool — target picked by rendezvous hash on the chain
+        fingerprint (deterministic, stable under churn), transfer on a
+        background thread (the pump must never block on a multi-rpc
+        exchange), dedup by fingerprint so a burst of same-prefix
+        completions migrates once."""
+        if kind != "chain_complete" or not self.auto_migrate:
+            return
+        if rep.role != ROLE_PREFILL:
+            return      # decode/general completions stay put
+        fp = int(payload["fp"])
+        with self._lock:
+            if fp in self._migrating:
+                return
+            self._migrating.add(fp)
+        pool = [r for r in self.router.replicas()
+                if r.serving and r.role == ROLE_DECODE
+                and r.name != rep.name]
+        if not pool:
+            with self._lock:
+                self._migrating.discard(fp)
+            return
+        dst = max(pool, key=lambda r: _rendezvous(fp, r.name))
+        threading.Thread(
+            target=self._do_migrate, args=(fp, payload, rep, dst),
+            daemon=True, name=f"migrate-{rep.name}-{dst.name}").start()
+
+    def _do_migrate(self, fp: int, payload: dict, src: ProcReplica,
+                    dst: ProcReplica) -> None:
+        """One handoff, exactly-once semantics: success notes the new
+        home with the router (next session turn routes there);
+        failure of EITHER side mid-transfer is counted and abandoned —
+        the chain is simply re-prefilled cold wherever the next turn
+        lands, which is always correct (migration is replication, the
+        trie never holds half a transfer)."""
+        try:
+            res = self.migrate_chain(fp, src.name, dst.name)
+            if res is not None:
+                self._inc("migrations")
+                self.router.note_migration(
+                    payload.get("fps", [fp]), dst.name)
+        except Exception:
+            self._inc("migration_failed")
+        finally:
+            with self._lock:
+                self._migrating.discard(fp)
 
     # ----------------------------------------------------- observability ----
     def arm_sentinels(self) -> None:

@@ -56,9 +56,11 @@ class ProcReplica:
     def __init__(self, name: str, spec, *, role: str = ROLE_GENERAL,
                  generation: int = 0,
                  on_death: Optional[Callable] = None,
+                 on_event: Optional[Callable] = None,
                  start_timeout: float = 180.0,
                  rpc_timeout: float = 30.0,
-                 drain_timeout: float = 120.0):
+                 drain_timeout: float = 120.0,
+                 health_rpc_timeout: float = 5.0):
         if role not in _ROLES:
             raise ValueError(f"role must be one of {_ROLES}, "
                              f"got {role!r}")
@@ -69,6 +71,16 @@ class ProcReplica:
         self.state = JOINING
         self.engine: Optional[_EngineShim] = None
         self._t: Optional[WorkerTransport] = None
+        # out-of-band worker events, called as on_event(replica, kind,
+        # payload) from the transport pump thread — the fleet's
+        # migration policy listens for "chain_complete" here
+        self._on_event_cb = on_event
+        # staleness window for the health-poll rpcs (health/load/
+        # affinity): how long the router may wait on a wedged worker
+        # before treating it as unhealthy — tunable per deployment
+        # through the fleet ctor (health_ttl_s governs how often these
+        # fire; this governs how long each may hang)
+        self._health_rpc_timeout = float(health_rpc_timeout)
         self._lock = threading.RLock()
         # rid -> [req, skip, cancel_sent]
         self._outstanding: dict = {}
@@ -94,7 +106,8 @@ class ProcReplica:
         t = WorkerTransport(self.spec, name=self.name,
                             start_timeout=self._start_timeout,
                             on_frame=self._frame,
-                            on_death=self._death)
+                            on_death=self._death,
+                            on_event=self._event)
         with self._lock:
             self._t = t
             self.engine = _EngineShim(t.ready["page_size"])
@@ -191,6 +204,14 @@ class ProcReplica:
                     f"replica {self.name}: {err}")
             req.finish(state)
 
+    def _event(self, kind: str, payload: dict) -> None:
+        """Out-of-band worker event (pump thread) — forward with this
+        replica as the source so the fleet policy knows which worker's
+        chain completed."""
+        cb = self._on_event_cb
+        if cb is not None:
+            cb(self, kind, payload)
+
     def _death(self) -> None:
         """Transport death callback (pump thread): the worker crashed.
         Every unfinished outstanding request is handed back to the
@@ -268,7 +289,8 @@ class ProcReplica:
                     if isinstance(v, (int, float))}
             return h
         try:
-            h["gauges"] = self._rpc("gauges", timeout=5.0)
+            h["gauges"] = self._rpc(
+                "gauges", timeout=self._health_rpc_timeout)
         except TransportError:
             h["alive"] = False
         return h
@@ -280,7 +302,8 @@ class ProcReplica:
         if self.state != SERVING or not self.alive:
             return float("inf")
         try:
-            g = self._rpc("gauges", timeout=5.0)
+            g = self._rpc("gauges",
+                          timeout=self._health_rpc_timeout)
         except TransportError:
             return float("inf")
         return float(g.get("queued", 0)
@@ -291,7 +314,7 @@ class ProcReplica:
             return {}
         try:
             return self._rpc("affinity", {"max_depth": max_depth},
-                             timeout=5.0)
+                             timeout=self._health_rpc_timeout)
         except TransportError:
             return {}
 
@@ -347,3 +370,50 @@ class ProcReplica:
         (decode side)."""
         return self._rpc("adopt_chain", {"blob": blob},
                          timeout=timeout)
+
+    # chunked protocol (decode-overlapped transfer): one rpc per
+    # bounded step — the worker's tick loop runs between steps, so
+    # neither side stalls longer than one chunk's gather/scatter
+    def export_chain_begin(self, fp: int, max_depth: int = 64,
+                           timeout: float = 30.0) -> Optional[dict]:
+        return self._rpc("export_chain_begin",
+                         {"fp": int(fp), "max_depth": max_depth},
+                         timeout=timeout)
+
+    def export_chain_chunk(self, xid: int, start: int, count: int,
+                           timeout: float = 30.0) -> dict:
+        return self._rpc("export_chain_chunk",
+                         {"xid": int(xid), "start": int(start),
+                          "count": int(count)}, timeout=timeout)
+
+    def export_chain_end(self, xid: int,
+                         timeout: float = 30.0) -> None:
+        self._rpc("export_chain_end", {"xid": int(xid)},
+                  timeout=timeout)
+
+    def adopt_chain_begin(self, header: dict,
+                          timeout: float = 30.0) -> dict:
+        return self._rpc("adopt_chain_begin", {"header": header},
+                         timeout=timeout)
+
+    def adopt_chain_chunk(self, aid: int, start: int, k, v,
+                          timeout: float = 30.0) -> None:
+        self._rpc("adopt_chain_chunk",
+                  {"aid": int(aid), "start": int(start),
+                   "k": k, "v": v}, timeout=timeout)
+
+    def adopt_chain_commit(self, aid: int,
+                           timeout: float = 30.0) -> dict:
+        return self._rpc("adopt_chain_commit", {"aid": int(aid)},
+                         timeout=timeout)
+
+    def adopt_chain_abort(self, aid: int,
+                          timeout: float = 30.0) -> None:
+        self._rpc("adopt_chain_abort", {"aid": int(aid)},
+                  timeout=timeout)
+
+    def flight_ticks(self, timeout: float = 30.0) -> List[dict]:
+        """The worker's flight-recorder tick records (t_mono_s/dur_s);
+        inter-tick gaps measure per-tick stall — how the
+        decode-overlap claim is verified against the sync baseline."""
+        return list(self._rpc("flight", timeout=timeout)["ticks"])

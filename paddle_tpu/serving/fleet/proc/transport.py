@@ -61,10 +61,15 @@ class WorkerTransport:
     def __init__(self, spec, name: str = "w", *,
                  start_timeout: float = 180.0,
                  on_frame: Optional[Callable] = None,
-                 on_death: Optional[Callable] = None):
+                 on_death: Optional[Callable] = None,
+                 on_event: Optional[Callable] = None):
         self.name = str(name)
         self.on_frame = on_frame
         self.on_death = on_death
+        # out-of-band worker events (``("evt", kind, payload)`` frames,
+        # e.g. chain_complete) — called as on_event(kind, payload) from
+        # the pump thread; keep it cheap/non-blocking
+        self.on_event = on_event
         self._ctx = mp.get_context("spawn")
         self._cmd = self._ctx.Queue()
         self._evt = self._ctx.Queue()
@@ -166,6 +171,12 @@ class WorkerTransport:
                     self._fseq.pop(rid, None)
             if self.on_frame is not None:
                 self.on_frame(msg)
+        elif kind == "evt":
+            if self.on_event is not None:
+                try:
+                    self.on_event(msg[1], msg[2])
+                except Exception:
+                    pass    # a policy callback must not kill the pump
         elif kind == "fatal":
             self._fatal = msg[1]
             self._ready_evt.set()   # unblock a waiting constructor

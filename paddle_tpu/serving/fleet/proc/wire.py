@@ -33,6 +33,17 @@ Event frames (worker -> parent, on the event queue)
                                     dedup drops ``fseq < skip``.
     ``("done", rid, fseq, state, err)``  terminal frame; fseq equals
                                     the number of tok frames emitted.
+    ``("evt", kind, payload)``      out-of-band worker event (no seq,
+                                    no ordering contract): the engine's
+                                    chain-completion hook surfaces as
+                                    ``kind="chain_complete"`` with
+                                    ``payload={"rid", "fp", "fps",
+                                    "pages", "prompt_tokens"}`` — what
+                                    the fleet's migration policy rides
+                                    (router-driven prefill→decode
+                                    handoff). Delivered to the
+                                    transport's ``on_event`` callback;
+                                    unknown kinds are dropped.
     ``("fatal", traceback_text)``   worker crashed outside an rpc.
 
 Request serialization

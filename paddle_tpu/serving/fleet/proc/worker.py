@@ -90,7 +90,22 @@ def _run(spec, cmd_q, evt_q) -> None:
 
     local: dict = {}        # parent rid -> local Request
     relays: dict = {}       # parent rid -> relay thread
+    rids: dict = {}         # id(local Request) -> parent rid
     reg = threading.Lock()
+
+    # chain-completion events (router-driven migration): the engine
+    # hook fires under the worker's tick lock, so it only ENQUEUES —
+    # the parent's pump thread delivers it to the fleet policy. The
+    # payload carries the PARENT rid (what the router knows requests
+    # by), not the worker-local one.
+    def on_chain_complete(req, info) -> None:
+        with reg:
+            rid = rids.get(id(req))
+        if rid is None:
+            return      # not an injected request (shouldn't happen)
+        evt_q.put(("evt", "chain_complete", dict(info, rid=rid)))
+
+    eng.on_chain_complete = on_chain_complete
 
     def relay(rid: int, req) -> None:
         fseq = 0
@@ -104,7 +119,14 @@ def _run(spec, cmd_q, evt_q) -> None:
     def op_inject(payload):
         req = request_from_wire(payload)
         rid = int(payload["rid"])
+        # register the rid mapping BEFORE inject: the engine loop may
+        # prefill and fire the chain-complete hook before inject even
+        # returns, and the event must carry the parent rid
+        with reg:
+            rids[id(req)] = rid
         if not eng.inject(req):
+            with reg:
+                rids.pop(id(req), None)
             return {"accepted": False}
         th = threading.Thread(target=relay, args=(rid, req),
                               daemon=True, name=f"relay-{rid}")
@@ -158,6 +180,27 @@ def _run(spec, cmd_q, evt_q) -> None:
         "export_chain": lambda p: eng.export_chain(
             int(p["fp"]), int(p.get("max_depth", 64))),
         "adopt_chain": lambda p: eng.adopt_chain(p["blob"]),
+        # chunked (decode-overlapped) migration protocol: each op holds
+        # the worker's tick lock only for its one bounded step, so the
+        # tick loops on BOTH sides keep streaming while pages cross
+        "export_chain_begin": lambda p: eng.export_chain_begin(
+            int(p["fp"]), int(p.get("max_depth", 64))),
+        "export_chain_chunk": lambda p: eng.export_chain_chunk(
+            int(p["xid"]), int(p["start"]), int(p["count"])),
+        "export_chain_end": lambda p: (
+            eng.export_chain_end(int(p["xid"])), {})[1],
+        "adopt_chain_begin": lambda p: eng.adopt_chain_begin(
+            p["header"]),
+        "adopt_chain_chunk": lambda p: (eng.adopt_chain_chunk(
+            int(p["aid"]), int(p["start"]), p["k"], p["v"]), {})[1],
+        "adopt_chain_commit": lambda p: eng.adopt_chain_commit(
+            int(p["aid"])),
+        "adopt_chain_abort": lambda p: (
+            eng.adopt_chain_abort(int(p["aid"])), {})[1],
+        # flight-recorder tick records (t_mono_s/dur_s per tick): the
+        # parent computes per-tick stall = inter-tick gaps from these —
+        # how migration overlap is MEASURED rather than asserted
+        "flight": lambda p: {"ticks": eng.flight.ticks()},
         "shutdown": op_shutdown,
     }
 

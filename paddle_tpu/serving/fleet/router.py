@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import OrderedDict
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 from ..prefix_cache import prefix_fingerprints
@@ -107,7 +108,17 @@ class FleetRouter:
         # serialize every submit against in-flight decode ticks
         # (same reason the affinity summary is TTL-cached)
         self._loads: Dict[str, Tuple[float, float]] = {}
+        # fp -> replica name: chains the fleet MIGRATED (router-driven
+        # prefill->decode handoff). Consulted before the TTL-cached
+        # summaries in _pick, because a summary can be up to one TTL
+        # stale — without this, a session's next turn raced the cache
+        # refresh and re-landed on the prefill worker it just left.
+        # Bounded LRU: correctness never depends on an evicted entry
+        # (the adopting replica's own summary advertises the chain).
+        self._migrated: "OrderedDict[int, str]" = OrderedDict()
+        self._migrated_cap = 4096
         self.counters = {"routed_affinity": 0, "routed_hash": 0,
+                         "routed_migrated": 0,
                          "routed_fallback": 0, "routed_round_robin": 0,
                          "redispatched": 0, "redispatch_failed": 0,
                          "rejected": 0}
@@ -128,6 +139,19 @@ class FleetRouter:
     def replicas(self) -> List[Replica]:
         with self._lock:
             return list(self._replicas)
+
+    def note_migration(self, fps: Sequence[int], name: str) -> None:
+        """Record that the chain behind ``fps`` (its cumulative
+        leading-page fingerprints) now lives on replica ``name`` — the
+        migration policy calls this right after a successful handoff
+        so the SESSION'S NEXT TURN routes to the adopting worker
+        immediately, without waiting out the affinity-summary TTL."""
+        with self._lock:
+            for fp in fps:
+                self._migrated.pop(int(fp), None)
+                self._migrated[int(fp)] = str(name)
+            while len(self._migrated) > self._migrated_cap:
+                self._migrated.popitem(last=False)
 
     def _candidates(self, exclude: Sequence[str] = ()) -> List[Replica]:
         return [r for r in self.replicas()
@@ -194,6 +218,20 @@ class FleetRouter:
                 and eng is not None:
             fps = prefix_fingerprints(req.prompt, eng.pool.page_size,
                                       self.summary_depth)
+            # migrated chains first, deepest fingerprint wins: the
+            # handoff just placed these pages — fresher than any
+            # TTL-cached summary can be
+            for d in range(len(fps) - 1, -1, -1):
+                with self._lock:
+                    home = self._migrated.get(fps[d])
+                if home is None:
+                    continue
+                rep = next((r for r in pool if r.name == home), None)
+                if rep is not None and rep.serving:
+                    self.counters_inc("routed_migrated")
+                    return ([rep] + [r for r in by_load if r is not rep]
+                            + rest)
+                break       # target left the pool: fall through
             best, best_key = None, None
             for r in by_load:
                 summ = self._summary(r)

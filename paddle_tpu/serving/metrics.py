@@ -94,10 +94,15 @@ class ServingMetrics:
     decoding (spec_ticks — verify launches; draft_tokens /
     draft_accepted / draft_rejected — per-draft-token outcomes:
     launches-per-emitted-token is decode_steps / tokens_out, mean
-    acceptance draft_accepted / draft_tokens), and handed_back
+    acceptance draft_accepted / draft_tokens), handed_back
     (queued-but-unadmitted requests a hand-back drain returned to the
     caller for re-dispatch instead of finalizing — the fleet drain
-    protocol, serving/fleet/).
+    protocol, serving/fleet/), and the host-memory cold tier
+    (cold_hits — rewarm events that pulled a spilled chain back onto
+    the device instead of recomputing prefill; cold_hit_pages — pages
+    those rewarm events scattered; cold_spills — pages paged out to
+    host at eviction; live cold-tier occupancy — entries/bytes — is a
+    ``cold_tier_*`` gauge, see ``ServingEngine._gauges``).
     Labeled counters (``inc_labeled``): the same monotonic semantics
     with a small label set — e.g. ``recompiles{during="serving.tick"}``
     names WHAT a post-warmup compile interrupted. Kept separate from
@@ -113,7 +118,10 @@ class ServingMetrics:
     slots / max_batch per tick), page_utilization (used / allocatable
     pages, sampled per tick), chunk_queue_depth (requests mid
     chunked-prefill, sampled per tick), spec_accept_rate (accepted /
-    drafted per speculative verify launch). Histogram summaries report the
+    drafted per speculative verify launch), cold_adopt_s (one
+    cold-tier rewarm: host lookup + page alloc + KV scatter + trie
+    graft — the latency a re-hit session pays INSTEAD of recomputing
+    its prefill). Histogram summaries report the
     lifetime mean AND the windowed mean/percentiles separately — see
     :class:`Histogram`.
     """
@@ -124,11 +132,12 @@ class ServingMetrics:
                 "prefix_misses", "prefix_hit_tokens",
                 "prefix_pages_saved", "invariant_violations",
                 "recompiles", "spec_ticks", "draft_tokens",
-                "draft_accepted", "draft_rejected", "handed_back")
+                "draft_accepted", "draft_rejected", "handed_back",
+                "cold_hits", "cold_hit_pages", "cold_spills")
     HISTOGRAMS = ("queue_wait_s", "ttft_s", "decode_step_s",
                   "decode_stall_s", "batch_occupancy",
                   "page_utilization", "chunk_queue_depth",
-                  "spec_accept_rate")
+                  "spec_accept_rate", "cold_adopt_s")
 
     def __init__(self):
         self._lock = threading.Lock()

@@ -45,9 +45,10 @@ from __future__ import annotations
 
 import heapq
 import itertools
-from typing import Dict, List, Tuple
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
 
-__all__ = ["PrefixCache", "prefix_fingerprints"]
+__all__ = ["PrefixCache", "ColdTier", "prefix_fingerprints"]
 
 # Rolling-hash base/mask for the fleet affinity signal: a chain's
 # fingerprint is a polynomial hash over its concatenated page token
@@ -85,6 +86,83 @@ def prefix_fingerprints(prompt, page_size: int, max_depth: int = 2):
         fp = _fp_extend(fp, prompt[i * ps:(i + 1) * ps])
         out.append(fp)
     return out
+
+
+class ColdTier:
+    """Bounded host-RAM store for evicted-but-warm KV pages.
+
+    Device page pressure evicts refcount-0 chains from the trie; with a
+    cold tier configured (``ServingEngine(cold_tier_bytes=N)``) each
+    evicted page's KV is pulled to host memory HERE instead of being
+    discarded, keyed by the chain fingerprint up to that page — the
+    same rolling hash the fleet router and the migration protocol use.
+    A later prompt whose warm trie match ends where a cold chain begins
+    re-adopts the pages (alloc + scatter, engine ``_rewarm_cold``)
+    instead of recomputing prefill, bitwise-equal to a warm hit: the
+    bytes stored are the bytes the device computed.
+
+    LRU by BYTES: ``put`` drops least-recently-touched entries until
+    the new entry fits; an entry larger than the whole budget is
+    refused. Correctness never depends on the fingerprint key — every
+    entry carries its page's exact token tuple and the rewarm path
+    verifies it against the prompt before adopting (a 64-bit collision
+    costs a missed rewarm, never aliased KV).
+
+    Single-threaded like the trie (engine tick lock serializes all
+    calls)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        # chain-fp -> {"toks", "k", "v", "nbytes"} in LRU order
+        self._by_fp: "OrderedDict[int, dict]" = OrderedDict()
+        self.bytes = 0
+        self.spills = 0       # pages paged out to host
+        self.hits = 0         # pages re-adopted from host
+        self.drops = 0        # pages LRU-dropped to fit the budget
+
+    def __len__(self) -> int:
+        return len(self._by_fp)
+
+    def put(self, fp: int, toks: tuple, k, v) -> bool:
+        """Store one evicted page's KV under its chain fingerprint;
+        returns False when it can never fit the budget."""
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        if nbytes > self.max_bytes:
+            return False
+        old = self._by_fp.pop(int(fp), None)
+        if old is not None:
+            self.bytes -= old["nbytes"]
+        while self._by_fp and self.bytes + nbytes > self.max_bytes:
+            _, dropped = self._by_fp.popitem(last=False)
+            self.bytes -= dropped["nbytes"]
+            self.drops += 1
+        self._by_fp[int(fp)] = {"toks": tuple(toks), "k": k, "v": v,
+                                "nbytes": nbytes}
+        self.bytes += nbytes
+        self.spills += 1
+        return True
+
+    def get(self, fp: int) -> Optional[dict]:
+        """Peek (and LRU-touch) one entry; None when absent."""
+        ent = self._by_fp.get(int(fp))
+        if ent is not None:
+            self._by_fp.move_to_end(int(fp))
+        return ent
+
+    def pop(self, fp: int) -> Optional[dict]:
+        """Remove one entry (the rewarm path pops what it adopted —
+        the KV is back on device, holding the host copy would double
+        the footprint and go stale if decode extends the chain)."""
+        ent = self._by_fp.pop(int(fp), None)
+        if ent is not None:
+            self.bytes -= ent["nbytes"]
+            self.hits += 1
+        return ent
+
+    def stats(self) -> Dict[str, int]:
+        return {"entries": len(self._by_fp), "bytes": self.bytes,
+                "max_bytes": self.max_bytes, "spills": self.spills,
+                "hits": self.hits, "drops": self.drops}
 
 
 class _Node:
@@ -130,6 +208,13 @@ class PrefixCache:
         self._nodes = set()                 # every cached node
         self._tick = itertools.count(1)
         self.evictions = 0
+        # cold-tier hook: when set, evict() calls ``spill(node)`` for
+        # every node it is about to free, BEFORE the page returns to
+        # the pool — the engine's spill callback gathers the page's KV
+        # to host while the pool entry still holds it. A raising spill
+        # must not wedge eviction (admission depends on it), so
+        # failures are swallowed by the caller side.
+        self.spill = None
 
     # ------------------------------------------------------------ sizing ----
     def nodes(self):
@@ -253,6 +338,11 @@ class PrefixCache:
             if nd.refs or nd.children or nd not in self._nodes:
                 continue  # pinned/extended/evicted since it was pushed
             parent = nd.parent
+            if self.spill is not None:
+                try:
+                    self.spill(nd)
+                except Exception:
+                    pass    # cold tier is best-effort; eviction isn't
             del parent.children[nd.toks]
             self._nodes.discard(nd)
             self.pool.free([nd.page])
@@ -324,13 +414,36 @@ class PrefixCache:
         """How many leading page token tuples of ``tokens`` are already
         cached (the adopt side's dedup walk: only the uncached suffix
         needs pages + KV scattered)."""
-        node, n = self._root, 0
+        return len(self.chain_nodes(tokens))
+
+    def chain_nodes(self, tokens: List[tuple]) -> List[_Node]:
+        """The cached node path matching a leading run of ``tokens``
+        (root-side first; possibly empty). The chunked-adopt protocol
+        PINS these (refs += 1) for the transfer's lifetime so a
+        concurrent eviction cannot cut the graft point out from under
+        the commit; pair every pin with :meth:`release`."""
+        node, out = self._root, []
         for tt in tokens:
             nxt = node.children.get(tuple(int(x) for x in tt))
             if nxt is None:
                 break
-            node, n = nxt, n + 1
-        return n
+            out.append(nxt)
+            node = nxt
+        return out
+
+    def node_fingerprint(self, nd: _Node) -> int:
+        """Rolling chain fingerprint of the chain ending at ``nd`` —
+        the same hash :func:`prefix_fingerprints` computes for the
+        token chain root..nd, and the key the cold tier stores the
+        node's page under when it is spilled."""
+        toks = []
+        while nd is not None and nd.parent is not None:
+            toks.append(nd.toks)
+            nd = nd.parent
+        fp = 0
+        for tt in reversed(toks):
+            fp = _fp_extend(fp, tt)
+        return fp
 
     # ------------------------------------------------------------ defrag ----
     def remap(self, plan: Dict[int, int]) -> None:

@@ -242,6 +242,16 @@ class Scheduler:
         with self._lock:
             return len(self._queue)
 
+    def peek_queued(self, n: int) -> List[Request]:
+        """Snapshot of the first ``n`` queued requests, FIFO order,
+        WITHOUT removing them — the engine's cold-tier rewarm hook
+        inspects the admission frontier each tick to decide which
+        spilled chains are worth pulling back onto the device before
+        ``admit()`` runs."""
+        with self._lock:
+            return [self._queue[i]
+                    for i in range(min(int(n), len(self._queue)))]
+
     def drop_queued(self, pred) -> List[Request]:
         """Remove queued requests matching ``pred`` (cancel/timeout
         sweeps); returns them."""

@@ -343,6 +343,7 @@ class Bench:
                   prefix_cache=not a.no_prefix_cache,
                   prefill_chunk=a.prefill_chunk or None,
                   admission_window=a.admission_window,
+                  cold_tier_bytes=getattr(a, "cold_tier", 0),
                   # None = env default; True = per-tick paged-KV
                   # invariant checking (violations raise inside the
                   # tick -> every handle errors -> main exits non-zero)
@@ -1194,6 +1195,238 @@ class Bench:
             out["kill"]["completed"] = kill_row["completed"]
         return out
 
+    def run_migration_ab(self, trace=None):
+        """Router-driven KV-migration A/B (ISSUE r17): the SAME
+        multi-turn session workload (heavy-tailed lognormal arrivals
+        by default) served by
+
+        * **disaggregated_migrate** — a 3-proc fleet split 1 prefill
+          + 2 decode with the automatic handoff policy ON: a
+          session's header chain prefills on the prefill worker, the
+          chain-completion event triggers a chunked transfer to the
+          rendezvous-chosen decode worker, and the session's
+          decode-heavy turns route there warm
+          (``router.routed_migrated``);
+        * **monolithic** — the same 3 workers untagged (no pools, no
+          migration): the control arm.
+
+        Reports per-arm tok/s + TTFT, follow-up-turn (turn >= 2) TTFT,
+        migration/router counters, decode-side prefix hit rate, and
+        each worker's max inter-tick stall from its flight recorder —
+        the overlap evidence: chunked transfer must not open tick gaps
+        beyond one chunk's gather/scatter."""
+        from collections import defaultdict
+
+        from paddle_tpu.serving.fleet.proc import ProcServingFleet
+        a = self.args
+        arrival = parse_arrival(a.arrival or f"lognormal:{a.seed}")
+        header = a.fleet_header or max(2 * a.page_size, 16)
+        header = min(header, a.max_prompt - 6)
+        mnt_lo, mnt_hi = min(a.mnt_choices), max(a.mnt_choices)
+        strace = build_session_trace(
+            a.fleet_groups, a.fleet_group_size, a.rate, header,
+            4, max(5, a.max_prompt - header), [mnt_lo], a.seed,
+            arrival=arrival)
+        # the handoff workload: each session opens with one expensive
+        # header prefill (small mnt -> prefill-classed on the split
+        # fleet), then decode-heavy follow-up turns (large mnt ->
+        # decode-classed). prefill_len_ratio is computed from the
+        # trace so the split is exact for any geometry: turn-0
+        # requests satisfy plen >= r*mnt_lo, follow-ups plen < r*mnt_hi
+        turns = defaultdict(int)
+        shaped = []
+        for t, g, p, _ in strace:
+            k = turns[g]
+            turns[g] += 1
+            shaped.append((t, g, p, mnt_lo if k == 0 else mnt_hi))
+        strace = shaped
+        plens = [len(p) for _, _, p, _ in strace]
+        ratio = (max(plens) + 1) / mnt_hi
+        if ratio > min(plens) / mnt_lo:
+            ratio = 1.0             # degenerate mnt choices: best effort
+
+        def run(roles, label):
+            fleet = ProcServingFleet(
+                self._proc_spec(), replicas=3, roles=roles,
+                prefill_len_ratio=ratio)
+            sessions = defaultdict(list)
+            for idx, (arr, g, prompt, mnt) in enumerate(strace):
+                sessions[g].append((idx, arr, prompt, mnt))
+            results = [None] * len(strace)
+            t0 = time.perf_counter()
+
+            def _session(items):
+                for turn, (idx, arr, prompt, mnt) in enumerate(items):
+                    now = time.perf_counter() - t0
+                    if now < arr:
+                        time.sleep(arr - now)
+                    try:
+                        h = fleet.submit(prompt, mnt)
+                        out = h.result(timeout=600)
+                    except BaseException:
+                        continue
+                    results[idx] = (turn, h.ttft_s, len(out))
+            ths = [threading.Thread(target=_session, args=(items,),
+                                    daemon=True)
+                   for items in sessions.values()]
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join()
+            wall = time.perf_counter() - t0
+            # max inter-tick stall per worker: gap between one tick's
+            # end (t_mono_s + dur_s) and the next tick's start — what
+            # a chunked transfer must keep bounded
+            stalls = {}
+            for rep in fleet.replicas():
+                try:
+                    ticks = rep.flight_ticks()
+                except Exception:
+                    continue
+                gaps = [ticks[i + 1]["t_mono_s"]
+                        - (ticks[i]["t_mono_s"] + ticks[i]["dur_s"])
+                        for i in range(len(ticks) - 1)]
+                stalls[rep.name] = round(max(gaps), 4) if gaps else 0.0
+            snap = fleet.snapshot()
+            fleet.close()
+            done = [r for r in results if r is not None]
+            useful = sum(r[2] for r in done)
+            ttfts = [r[1] for r in done if r[1] is not None]
+            follow = [r[1] for r in done
+                      if r[0] >= 1 and r[1] is not None]
+            decode_hits = decode_total = 0
+            for name, rh in snap["replicas"].items():
+                c = rh.get("counters")
+                if c and rh.get("role") == "decode":
+                    decode_hits += c.get("prefix_hits", 0)
+                    decode_total += (c.get("prefix_hits", 0)
+                                     + c.get("prefix_misses", 0))
+            row = _report(f"migration[{label}]", wall, useful, ttfts)
+            row.update(
+                arm=label, drops=int(len(strace) - len(done)),
+                followup_ttft_p50_ms=round(_pctl(follow, 50) * 1e3, 1),
+                followup_ttft_p99_ms=round(_pctl(follow, 99) * 1e3, 1),
+                migrations=snap["fleet"]["migrations"],
+                migration_failed=snap["fleet"]["migration_failed"],
+                routed_migrated=snap["router"].get("routed_migrated", 0),
+                decode_prefix_hit_rate=round(
+                    decode_hits / max(decode_total, 1), 3),
+                max_tick_stall_s=stalls)
+            return row
+
+        dis = run(["prefill", "decode", "decode"],
+                  "disaggregated_migrate")
+        mono = run(None, "monolithic")
+        return {
+            "mode": "migration_ab",
+            "workload": {"groups": a.fleet_groups,
+                         "group_size": a.fleet_group_size,
+                         "header_tokens": int(header),
+                         "requests": len(strace),
+                         "arrival": a.arrival or f"lognormal:{a.seed}"},
+            "disaggregated_migrate": dis, "monolithic": mono,
+            "migrations_happened": bool(dis["migrations"] > 0),
+            "zero_drops_both": bool(dis["drops"] == 0
+                                    and mono["drops"] == 0),
+        }
+
+    def run_cold_tier(self, trace=None):
+        """Host-memory cold-tier A/B (ISSUE r17): one engine, device
+        page budget deliberately too small for the working set of
+        session header chains, revisited over two rounds:
+
+        * **cold_tier on** — evicted chains spill to host RAM; a
+          round-2 revisit re-adopts the pages (``cold_hits``) instead
+          of recomputing prefill;
+        * **cold_tier off** — the control: a round-2 revisit
+          re-prefills from scratch.
+
+        Outputs must be BITWISE identical between arms (the cold tier
+        stores the bytes the device computed); the win is round-2
+        TTFT. Reports per-arm revisit TTFT, cold counters and the
+        cold-tier gauges."""
+        a = self.args
+        groups = max(4, a.fleet_groups)
+        header = a.fleet_header or max(2 * a.page_size, 16)
+        header = min(header, a.max_prompt - 6)
+        mnt = min(m for m in a.mnt_choices)
+        rng = np.random.RandomState(a.seed)
+        headers = [rng.randint(0, 256, (header,)).astype(np.int32)
+                   for _ in range(groups)]
+        tails = [rng.randint(0, 256, (4,)).astype(np.int32)
+                 for _ in range(groups)]
+        prompts = [np.concatenate([h, t])
+                   for h, t in zip(headers, tails)]
+        # pool sized for ONE in-flight request + ~1 cached chain: by
+        # the time a session's header is revisited its chain has been
+        # evicted (admission matches the trie BEFORE evicting, so a
+        # roomier pool would let revisits stay warm and the control
+        # arm would never re-prefill)
+        pages_per_slot = -(-(_bucket(a.max_prompt, self.buckets)
+                             + self.mnt_cap - 1) // a.page_size)
+        chain_pages = header // a.page_size
+        total_pages = pages_per_slot + chain_pages + 2
+        cold_bytes = int(getattr(a, "cold_tier", 0)) or (64 << 20)
+        wrng = np.random.RandomState(a.seed + 17)
+        warm_prompts = [wrng.randint(0, 256, (header + 4,))
+                        .astype(np.int32) for _ in range(3)]
+
+        def run(tier_bytes):
+            eng = self._mk_engine(max_batch=1,
+                                  total_pages=total_pages,
+                                  cold_tier_bytes=tier_bytes)
+            # unmeasured warm lap: compile prefill/decode (+ the
+            # rewarm gather/scatter when the tier is on — submit A,
+            # evict it via B, revisit A) so the measured revisits
+            # compare steady-state costs, not XLA compiles
+            for p in (*warm_prompts, warm_prompts[0]):
+                eng.submit(p, mnt).result(timeout=600)
+            c0 = eng.snapshot()["counters"]
+            outs, ttfts = {}, []
+            t0 = time.perf_counter()
+            for rnd in range(2):
+                for g in range(groups):
+                    h = eng.submit(prompts[g], mnt)
+                    outs[(rnd, g)] = list(h.result(timeout=600))
+                    if rnd == 1 and h.ttft_s is not None:
+                        ttfts.append(h.ttft_s)
+            wall = time.perf_counter() - t0
+            snap = eng.snapshot()
+            eng.close()
+            c = {k: int(v - c0.get(k, 0))
+                 for k, v in snap["counters"].items()}
+            row = {
+                "wall_s": round(wall, 3),
+                "revisit_ttft_p50_ms": round(
+                    _pctl(ttfts, 50) * 1e3, 2),
+                "revisit_ttft_mean_ms": round(
+                    float(np.mean(ttfts)) * 1e3, 2),
+                "cold_hits": c.get("cold_hits", 0),
+                "cold_hit_pages": c.get("cold_hit_pages", 0),
+                "cold_spills": c.get("cold_spills", 0),
+                "prefix_hits": c.get("prefix_hits", 0),
+                "cold_tier": snap["gauges"].get("cold_tier"),
+            }
+            hist = snap.get("histograms", {}).get("cold_adopt_s")
+            if hist:
+                row["cold_adopt_s"] = hist
+            return row, outs
+
+        off, outs_off = run(0)
+        on, outs_on = run(cold_bytes)
+        bitwise = all(outs_on[k] == outs_off[k] for k in outs_on)
+        return {
+            "mode": "cold_tier",
+            "workload": {"groups": groups, "header_tokens": int(header),
+                         "mnt": int(mnt), "rounds": 2,
+                         "total_pages": int(total_pages),
+                         "cold_tier_bytes": int(cold_bytes)},
+            "cold_tier_on": on, "cold_tier_off": off,
+            "bitwise_equal": bool(bitwise),
+            "rehit_beats_cold_prefill": bool(
+                on["revisit_ttft_p50_ms"] < off["revisit_ttft_p50_ms"]),
+        }
+
     def _tick_chain(self, kind, ctx=24, iters=12, reps=3):
         """Controlled pure-decode tick latency on matched state: all
         slots live at cache length ``ctx``, ``iters`` chained fused
@@ -1355,9 +1588,15 @@ def main(argv=None):
                     help="export the engine run's span timeline as "
                          "Perfetto-loadable Chrome-trace JSON (one "
                          "track per engine phase + per slot)")
+    ap.add_argument("--cold-tier", type=int, default=0,
+                    help="host-memory cold-chain tier byte budget "
+                         "(engine mode: passed straight to the "
+                         "engine's cold_tier_bytes=; cold_tier mode: "
+                         "the ON arm's budget, 0 = 64 MiB default)")
     ap.add_argument("--modes", nargs="+", default=None,
                     help="any of: sequential batcher engine prefix_ab "
                          "ragged_ab trace_overhead spec_ab fleet "
+                         "migration_ab cold_tier "
                          "(default: sequential batcher engine, or "
                          "fleet when --replicas > 1)")
     args = ap.parse_args(argv)
@@ -1382,7 +1621,7 @@ def main(argv=None):
                         arrival=parse_arrival(args.arrival))
     bench.warmup([m for m in args.modes
                   if m not in ("prefix_ab", "ragged_ab", "spec_ab",
-                               "fleet")])
+                               "fleet", "migration_ab", "cold_tier")])
     results = {}
     for mode in args.modes:
         results[mode] = getattr(bench, f"run_{mode}")(list(trace))
