@@ -70,11 +70,17 @@ def register_rewrite(cls):
 
 
 def default_rewrites(names=None) -> List["RewritePass"]:
-    """One instance of every registered rewrite (or of ``names``), in
-    registration order."""
+    """One instance of every registered rewrite (or of ``names``),
+    ordered by ``priority`` (stable: registration order breaks ties).
+    The rewriter hands each anchor to the FIRST rule that matches it,
+    so bigger-subgraph passes (the decode tail swallows an rms-norm;
+    the conv epilogue swallows a layout-normalizable conv) must sort
+    ahead of the smaller passes they contain."""
     if names is None:
-        return [cls() for cls in REWRITE_REGISTRY.values()]
-    return [REWRITE_REGISTRY[n]() for n in names]
+        rules = [cls() for cls in REWRITE_REGISTRY.values()]
+    else:
+        rules = [REWRITE_REGISTRY[n]() for n in names]
+    return sorted(rules, key=lambda r: r.priority)
 
 
 def default_passes(**ctor_kwargs) -> List["LintPass"]:
@@ -208,6 +214,10 @@ class RewritePass:
     name: str = "rewrite"
     contract: ExactnessContract = ExactnessContract(bitwise=True)
     arg_names: Tuple[str, ...] = ()
+    #: rule order handed to the rewriter — lower runs first; passes
+    #: whose pattern CONTAINS another pass's pattern must sort lower
+    #: (see :func:`default_rewrites`)
+    priority: int = 100
 
     def patterns(self):
         raise NotImplementedError

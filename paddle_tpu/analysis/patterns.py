@@ -232,6 +232,8 @@ def _match_node(pat: Pat, atom, producers, st: _State) -> Optional[_State]:
                 return got
             if hops >= max_hops:
                 return None
+            if isinstance(cur, jax_core.Literal):
+                return None       # literals have no producer to walk
             prod = producers.get(cur)
             if prod is None:
                 return None
@@ -244,6 +246,8 @@ def _match_node(pat: Pat, atom, producers, st: _State) -> Optional[_State]:
             hops += 1
 
     if isinstance(pat, Op):
+        if isinstance(atom, jax_core.Literal):
+            return None           # an Op's output is never a literal
         prod = producers.get(atom)
         if prod is None:
             return None

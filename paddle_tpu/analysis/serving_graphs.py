@@ -305,7 +305,11 @@ def rewrite_targets(models=("llama",), *, slots: int = 4,
                 continue
             if ("serving_tick_block" in t.name
                     or "serving_tick[mixed]" in t.name):
-                t.meta["expect_rewrites"] = ("fused-rmsnorm",)
+                # the tail (final norm → last-row gather → lm_head →
+                # f32 cast) belongs to decode-tail-fuse; the per-layer
+                # norms still fall through to the plain substitution
+                t.meta["expect_rewrites"] = ("fused-rmsnorm",
+                                             "decode-tail-fuse")
                 targets.append(t)
 
     # --- int8: the un-fused dequant-matmul decode step (llama is the

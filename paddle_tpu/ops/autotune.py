@@ -1,4 +1,4 @@
-"""Runtime kernel autotune cache.
+"""Runtime kernel autotune cache + the persistent KForge winner store.
 
 Reference: paddle/phi/kernels/autotune/ — algorithm selection by timing
 (cuDNN algo search, transpose/layout autotune) with a per-process cache
@@ -10,6 +10,17 @@ given key times each candidate with a warm-up plus chained timed
 iterations and caches the winner. All later calls dispatch straight to
 the cached choice.
 
+The KForge flywheel (PAPERS.md 2606.02963) rides a second, PERSISTENT
+tier: ``tools/kernel_bench.py`` sweeps *record* the winning block
+shapes per geometry (``record(kind, winner, **geom)``) into a JSON file
+under ``$PADDLE_TPU_AUTOTUNE_DIR``, and the Pallas entry points
+(``fused_rms_norm``, ``ragged_paged_attention``, the conv-epilogue
+matmul) *look up* that store at call time (``lookup(kind, **geom)``).
+A swept geometry therefore picks its searched tiling automatically; an
+unswept one (or an unset env var, or a corrupt store) falls back to the
+entry point's static default — never a crash, never a numerics change
+(tilings partition the same arithmetic).
+
 Timing caveat documented for the tunnelled dev runtime: host wall time
 carries ~100 ms dispatch noise per sync there, so use ``iters`` high
 enough (or run where the device is locally attached) for the deltas to
@@ -17,23 +28,142 @@ dominate; tests exercise the machinery on CPU where timing is honest.
 """
 from __future__ import annotations
 
+import json
+import os
 import time
-from typing import Any, Callable, Dict, Sequence, Tuple
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import jax
 
 _CACHE: Dict[Any, int] = {}
 _STATS: Dict[Any, Tuple[float, ...]] = {}
 
+#: in-memory mirror of the on-disk winner store, keyed by the dir it
+#: was loaded from so tests (and long-lived processes pointed at a new
+#: dir) reload instead of serving a stale mirror
+_DISK: Optional[Dict[str, Dict[str, Any]]] = None
+_DISK_FROM: Optional[str] = None
+
+_ENV_DIR = "PADDLE_TPU_AUTOTUNE_DIR"
+_STORE_FILE = "winners.json"
+
 
 def clear():
+    """Drop BOTH tiers' in-process state (the on-disk store survives —
+    the next ``lookup`` reloads it, which is what the fresh-process
+    round-trip test exercises)."""
+    global _DISK, _DISK_FROM
     _CACHE.clear()
     _STATS.clear()
+    _DISK = None
+    _DISK_FROM = None
 
 
 def cache_info():
     return dict(_CACHE), dict(_STATS)
 
+
+def make_key(op: str, args: Sequence[Any] = (),
+             blocks: Tuple = (), extra: Tuple = ()) -> tuple:
+    """Canonical in-process cache key: op name + every arg's shape AND
+    dtype + the candidate block-shape tuple. Shape-only keys collide
+    across bf16/int8 callers of the same geometry (and across candidate
+    sets of different block shapes) — this helper is the one place the
+    key schema lives so callers cannot under-key."""
+    sig = tuple((tuple(getattr(a, "shape", ())),
+                 str(getattr(a, "dtype", type(a).__name__)))
+                for a in args)
+    return (op, sig, tuple(blocks), tuple(extra))
+
+
+# ---------------------------------------------------------------------------
+# persistent winner store (the KForge flywheel)
+# ---------------------------------------------------------------------------
+
+def store_dir() -> Optional[str]:
+    """The env-pointed winner-store directory, or None (persistence
+    off, entry points use their static defaults)."""
+    d = os.environ.get(_ENV_DIR)
+    return d or None
+
+
+def store_path() -> Optional[str]:
+    d = store_dir()
+    return os.path.join(d, _STORE_FILE) if d else None
+
+
+def geometry_key(**geom) -> str:
+    """Canonical string key for one kernel geometry: sorted fields,
+    JSON-encoded, so writers and readers agree byte-for-byte. Dtypes
+    must be passed as strings (``str(jnp.dtype(dt))``)."""
+    return json.dumps({k: geom[k] for k in sorted(geom)},
+                      separators=(",", ":"))
+
+
+def _load_store() -> Dict[str, Dict[str, Any]]:
+    """Lazy-load (and cache) the winner store. A missing or corrupt
+    file degrades to an empty store — unswept behavior, not a crash."""
+    global _DISK, _DISK_FROM
+    path = store_path()
+    if path is None:
+        return {}
+    if _DISK is not None and _DISK_FROM == path:
+        return _DISK
+    store: Dict[str, Dict[str, Any]] = {}
+    try:
+        with open(path) as f:
+            raw = json.load(f)
+        if isinstance(raw, dict):
+            store = {str(k): dict(v) for k, v in raw.items()
+                     if isinstance(v, dict)}
+    except FileNotFoundError:
+        pass
+    except (OSError, ValueError, TypeError) as e:
+        import warnings
+        warnings.warn(f"autotune winner store {path} unreadable "
+                      f"({type(e).__name__}: {e}); using defaults",
+                      stacklevel=2)
+    _DISK, _DISK_FROM = store, path
+    return store
+
+
+def lookup(kind: str, **geom) -> Optional[Dict[str, Any]]:
+    """The swept winner for ``kind`` at ``geom``, or None (caller falls
+    back to its default tiling — the unswept path is bitwise-unchanged
+    because block shape never changes the math, only the schedule)."""
+    entry = _load_store().get(kind)
+    if not entry:
+        return None
+    win = entry.get(geometry_key(**geom))
+    return dict(win) if isinstance(win, dict) else None
+
+
+def record(kind: str, winner: Dict[str, Any], **geom) -> str:
+    """Persist one sweep winner (``{"tile_n": 128, ...}``) for
+    ``kind``/``geom``. Requires ``$PADDLE_TPU_AUTOTUNE_DIR``. Writes
+    atomically (tmp + rename) so a concurrent reader never sees a torn
+    file. Returns the store path."""
+    path = store_path()
+    if path is None:
+        raise RuntimeError(
+            f"set ${_ENV_DIR} to record autotune winners")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    store = dict(_load_store())
+    per_kind = dict(store.get(kind, {}))
+    per_kind[geometry_key(**geom)] = dict(winner)
+    store[kind] = per_kind
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(store, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    global _DISK, _DISK_FROM
+    _DISK, _DISK_FROM = store, path
+    return path
+
+
+# ---------------------------------------------------------------------------
+# in-process candidate timing
+# ---------------------------------------------------------------------------
 
 def _time_once(fn, args, iters: int) -> float:
     out = fn(*args)
@@ -50,8 +180,9 @@ def autotune(key, candidates: Sequence[Callable], args: tuple,
     """Run the fastest of ``candidates`` for ``args``; first call per
     ``key`` measures, later calls hit the cache.
 
-    key: hashable (op name, shapes, dtypes, ...). candidates: callables
-    with identical semantics. Returns the chosen candidate's output.
+    key: hashable — build it with :func:`make_key` so shapes, dtypes
+    and block tuples are all in it. candidates: callables with
+    identical semantics. Returns the chosen candidate's output.
     """
     if not candidates:
         raise ValueError("need at least one candidate")

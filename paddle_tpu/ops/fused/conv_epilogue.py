@@ -1,0 +1,181 @@
+"""Conv + folded-BN + activation: the replacement surface of the
+ResNet rewrite passes (analysis/rewrite_conv.py).
+
+Reference capability: the conv_bn_fuse / conv_elementwise_add_act IR
+passes (paddle/fluid/framework/ir/) that PaddlePaddle applies to every
+deployed CNN. Here the fold happens at the jaxpr level — the rewrite
+pass matches ``conv → batch_norm(infer) → relu`` and substitutes this
+module's entry points, which:
+
+* fold the BN affine into the conv weights per output channel
+  (``s = gamma·rsqrt(var+eps); w' = w·s; bias = beta − mean·s`` —
+  O(C·k·k) arithmetic instead of three extra HBM round-trips over the
+  activation);
+* normalise layout to NHWC (channels-last is the TPU-native conv
+  layout; the rewrite keeps NCHW only at the matched region's border);
+* route 1×1/stride-1 convolutions — 36 of ResNet-50's 53 convs —
+  through the authored matmul+bias+relu epilogue kernel
+  (ops/pallas/conv_epilogue.py) when ``PADDLE_TPU_CONV_EPILOGUE_IMPL=
+  pallas``, the same ``fused_impl()`` discipline as int8_matmul (a
+  rewrite must never resolve back to the baseline it replaced);
+* space-to-depth the 7×7/stride-2 stem: the input's 2×2 phases move
+  into channels (3 → 12) so the conv becomes a dense 4×4/stride-1 conv
+  at 112×112 — the stem stops being the one sparse, misaligned conv in
+  the network (`stem_s2d_conv`).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fused_impl", "conv_bias_act", "conv_bn_act_nchw",
+           "space_to_depth_nhwc", "space_to_depth_stem_kernel",
+           "stem_s2d_conv_nchw", "decode_precision"]
+
+
+def _default_impl() -> str:
+    return os.environ.get("PADDLE_TPU_CONV_EPILOGUE_IMPL", "auto")
+
+
+def fused_impl() -> str:
+    """The FUSED implementation the environment selects — ``"pallas"``
+    under ``PADDLE_TPU_CONV_EPILOGUE_IMPL=pallas``, else ``"jnp"``.
+    The conv-bn-fold rewrite resolves its replacement through this so
+    it can never route back to the unfused conv→BN→relu baseline."""
+    return "pallas" if _default_impl() == "pallas" else "jnp"
+
+
+def _is_rowwise_matmul(w_hwio, strides, padding, dilation, groups) -> bool:
+    kh, kw = w_hwio.shape[0], w_hwio.shape[1]
+    return (kh == 1 and kw == 1 and tuple(strides) == (1, 1)
+            and all(p == (0, 0) for p in padding)
+            and tuple(dilation) == (1, 1) and groups == 1)
+
+
+def decode_precision(precision):
+    """The rewrite passes stash a matched conv's precision request as
+    None or a pair of ``lax.Precision`` names (strings serialize into
+    match statics); decode back to what lax accepts."""
+    if precision is None:
+        return None
+    return tuple(lax.Precision[p] if isinstance(p, str) else p
+                 for p in precision)
+
+
+def _precision_is_default(precision) -> bool:
+    decoded = decode_precision(precision)
+    return decoded is None or all(p == lax.Precision.DEFAULT
+                                  for p in decoded)
+
+
+def conv_bias_act(x, w, bias, *, strides=(1, 1),
+                  padding=((0, 0), (0, 0)), dilation=(1, 1),
+                  groups=1, relu=True, impl="auto", precision=None):
+    """NHWC conv + bias + optional relu in one fused surface.
+
+    ``x`` [B,H,W,Cin] NHWC, ``w`` [kh,kw,Cin/groups,Cout] HWIO,
+    ``bias`` [Cout]. 1×1/stride-1/ungrouped shapes dispatch to the
+    Pallas epilogue kernel under ``impl="pallas"`` (only when the
+    caller asked for default precision — the kernel's MXU passes don't
+    honour HIGHEST); everything else is the jnp formulation (one
+    conv_general_dilated + vector epilogue, which XLA fuses)."""
+    resolved = _default_impl() if impl == "auto" else impl
+    if (resolved == "pallas" and _precision_is_default(precision)
+            and _is_rowwise_matmul(w, strides, padding, dilation, groups)):
+        from ..pallas.conv_epilogue import matmul_bias_act
+        b, h, wd, cin = x.shape
+        cout = w.shape[-1]
+        out = matmul_bias_act(x.reshape(b * h * wd, cin),
+                              w.reshape(cin, cout), bias, relu=relu)
+        return out.reshape(b, h, wd, cout)
+    out = lax.conv_general_dilated(
+        x, w.astype(x.dtype), window_strides=tuple(strides),
+        padding=tuple(padding), rhs_dilation=tuple(dilation),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        feature_group_count=groups,
+        precision=decode_precision(precision))
+    out = out + bias.astype(out.dtype)
+    if relu:
+        out = jax.nn.relu(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# space-to-depth stem (7x7/stride-2 -> dense 4x4/stride-1 at 4x channels)
+# ---------------------------------------------------------------------------
+
+def space_to_depth_nhwc(x):
+    """[B,H,W,C] -> [B,H/2,W/2,4C]: each output pixel stacks its 2x2
+    input phase block into channels (channel order (h2, w2, c))."""
+    b, h, w, c = x.shape
+    xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+    return xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+
+
+def space_to_depth_stem_kernel(w_hwio):
+    """[7,7,Cin,Cout] HWIO -> the [4,4,4Cin,Cout] kernel that, applied
+    stride-1 with padding ((2,1),(2,1)) to the space-to-depth input,
+    computes exactly the original 7x7/stride-2/pad-3 conv: pad the taps
+    to 8x8 (one leading zero row/col — stride-2 phase alignment), split
+    each spatial axis into (block, phase), and fold the phases into the
+    input-channel axis in the same (h2, w2, c) order as the data."""
+    kh, kw, cin, cout = w_hwio.shape
+    assert (kh, kw) == (7, 7), (kh, kw)
+    wp = jnp.pad(w_hwio, ((1, 0), (1, 0), (0, 0), (0, 0)))
+    wp = wp.reshape(4, 2, 4, 2, cin, cout)
+    return wp.transpose(0, 2, 1, 3, 4, 5).reshape(4, 4, 4 * cin, cout)
+
+
+def stem_s2d_conv_nchw(x, w_oihw, *, precision=None):
+    """The full stem substitution on NCHW tensors: NHWC-ify, space-to-
+    depth both operands, run the dense 4x4/stride-1 conv, NCHW-ify.
+    Numerically the same taps in a different association (zero-padded
+    positions contribute exact zeros)."""
+    xt = space_to_depth_nhwc(jnp.transpose(x, (0, 2, 3, 1)))
+    ws = space_to_depth_stem_kernel(jnp.transpose(w_oihw, (2, 3, 1, 0)))
+    y = lax.conv_general_dilated(
+        xt, ws.astype(xt.dtype), window_strides=(1, 1),
+        padding=((2, 1), (2, 1)),
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        precision=decode_precision(precision))
+    return jnp.transpose(y, (0, 3, 1, 2))
+
+
+def _is_stem_shape(w_oihw, strides, padding, dilation, groups,
+                   hw) -> bool:
+    return (w_oihw.shape[1] == 3 and w_oihw.shape[2:] == (7, 7)
+            and tuple(strides) == (2, 2)
+            and tuple(padding) == ((3, 3), (3, 3))
+            and tuple(dilation) == (1, 1) and groups == 1
+            and hw[0] % 2 == 0 and hw[1] % 2 == 0)
+
+
+def conv_bn_act_nchw(x, w, gamma, beta, mean, var, *, eps,
+                     strides=(1, 1), padding=((0, 0), (0, 0)),
+                     dilation=(1, 1), groups=1, relu=True,
+                     impl="auto", precision=None):
+    """Inference-mode ``relu?(batch_norm(conv(x, w)))`` with the BN
+    folded into the conv — NCHW in, NCHW out (the rewrite anchor's
+    aval), NHWC inside. ``w`` is OIHW; BN stats/affine are per-channel
+    [C]. Stem-shaped convs additionally take the space-to-depth form."""
+    s = (gamma.astype(jnp.float32)
+         * lax.rsqrt(var.astype(jnp.float32) + eps))
+    bias = beta.astype(jnp.float32) - mean.astype(jnp.float32) * s
+    wf = w.astype(jnp.float32) * s[:, None, None, None]
+    if _is_stem_shape(w, strides, padding, dilation, groups,
+                      x.shape[2:]):
+        xt = space_to_depth_nhwc(jnp.transpose(x, (0, 2, 3, 1)))
+        wt = space_to_depth_stem_kernel(jnp.transpose(wf, (2, 3, 1, 0)))
+        out = conv_bias_act(xt, wt, bias, strides=(1, 1),
+                            padding=((2, 1), (2, 1)), relu=relu,
+                            impl=impl, precision=precision)
+    else:
+        out = conv_bias_act(
+            jnp.transpose(x, (0, 2, 3, 1)),
+            jnp.transpose(wf, (2, 3, 1, 0)), bias,
+            strides=strides, padding=padding, dilation=dilation,
+            groups=groups, relu=relu, impl=impl, precision=precision)
+    return jnp.transpose(out, (0, 3, 1, 2)).astype(x.dtype)

@@ -1,0 +1,46 @@
+"""Fused serving decode tail: last-row gather + final RMSNorm + lm_head.
+
+Reference capability: the fused lm-head epilogues of the deployed
+inference graphs (paddle/phi/kernels/fusion/ — e.g.
+fused_bias_act/fused_linear chains the IR passes stitch onto the last
+decode op). In the serving tick the tail is
+
+    ``logits = (rms_norm(h)[last] @ lm_head).astype(f32)``
+
+— per-op it measures under 1% of step time, but it costs separate
+launches and an HBM round-trip of the FULL ``[T, D]`` normed stream per
+tick when only ``S`` rows are read. The decode-tail rewrite pass
+(analysis/rewrite.py) substitutes this entry point, which:
+
+* gathers the ``S`` live rows FIRST (rms_norm is row-local, so
+  norm∘gather == gather∘norm exactly — the dead ``T−S`` rows are never
+  normalised, and the pre-head HBM traffic drops from ``T·D`` to
+  ``S·D``);
+* routes the norm through the Pallas ``fused_rms_norm`` kernel (the
+  kernel-substitution contract the fused-rmsnorm pass already pins,
+  and an opaque call the rewriter cannot re-match — idempotence);
+* leaves the head matmul adjacent so XLA (or a later authored kernel)
+  consumes the normed rows straight out of registers/VMEM.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["fused_decode_tail"]
+
+
+def fused_decode_tail(x, w, idx, head, *, eps, out_dtype=jnp.float32):
+    """``(rms_norm(x, w, eps)[idx] @ head).astype(out_dtype)`` with the
+    gather hoisted above the norm. ``x`` [T, D] packed hidden stream,
+    ``w`` [D] norm weight, ``idx`` int [S] row indices (negative wraps,
+    same as jnp indexing), ``head`` [D, V].
+
+    The head matmul runs in ``head.dtype`` — in the AMP serving graphs
+    the normed f32 rows are cast DOWN to bf16 before the dot, and the
+    substitution must mirror that (computing the dot in f32 instead is
+    *more* precise, but reads as drift against the original under the
+    exactness contract)."""
+    from ..pallas.fused_norm_rope import fused_rms_norm
+    rows = x[idx]
+    rows = fused_rms_norm(rows, w, float(eps))
+    return (rows.astype(head.dtype) @ head).astype(out_dtype)

@@ -264,16 +264,27 @@ def sort_and_pad_by_expert(expert_ids: jax.Array, num_experts: int,
 
 
 def moe_mlp_dropless(x, expert_ids, combine_weights, w_gate, w_up, w_down,
-                     *, tile_m: int = 128, tile_n: int = 128):
+                     *, tile_m: int = None, tile_n: int = None):
     """Dropless token-choice MoE FFN (SwiGLU experts) via grouped matmul.
 
     x: ``[S, D]`` tokens; expert_ids/combine_weights: ``[S, k]`` top-k
     routing (no capacity, nothing dropped); w_gate/w_up: ``[E, D, F]``;
     w_down: ``[E, F, D]``. Returns ``[S, D]``.
+
+    ``tile_m``/``tile_n`` default to the persistent autotune winner for
+    this routing geometry when ``kernel_bench --block-sweep`` has swept
+    it (the KForge flywheel), else the static 128/128; explicit ints
+    always win.
     """
     S, D = x.shape
     k = expert_ids.shape[1]
     E = w_gate.shape[0]
+    if tile_m is None or tile_n is None:
+        from .. import autotune as at
+        win = at.lookup("grouped_matmul", S=S, D=D, F=int(w_gate.shape[2]),
+                        E=E, k=k, dtype=str(jnp.dtype(x.dtype))) or {}
+        tile_m = int(win.get("tile_m", 128)) if tile_m is None else tile_m
+        tile_n = int(win.get("tile_n", 128)) if tile_n is None else tile_n
     flat_e = expert_ids.reshape(-1).astype(jnp.int32)
     order, dest, tile_expert, m_pad = sort_and_pad_by_expert(
         flat_e, E, tile_m)

@@ -531,9 +531,11 @@ def ragged_paged_attention(q, k_pages, v_pages, q_len, kv_len, tables,
     elsewhere), "pallas" (strict — interpreter mode off-TPU), "dense".
 
     kv_tile_pages: the KV walk. None (default) = geometry AUTO on the
-    pallas path — one-shot while its scratch fits the VMEM budget,
-    the tiled flash combine past the knee (``default_kv_tile_pages``;
-    the dense path stays one-shot, it has no VMEM to protect);
+    pallas path — a persistent autotune winner for this geometry if
+    ``kernel_bench --ragged-sweep`` recorded one, else one-shot while
+    its scratch fits the VMEM budget and the tiled flash combine past
+    the knee (``default_kv_tile_pages``; the dense path stays
+    one-shot, it has no VMEM to protect);
     0 forces one-shot; N > 0 forces the tiled walk at an N-page tile
     (dense included — the tiled dense reference the kernel's bitwise
     pin runs against).
@@ -559,10 +561,25 @@ def ragged_paged_attention(q, k_pages, v_pages, q_len, kv_len, tables,
     use_pallas = impl == "pallas" or (impl == "auto" and _on_tpu())
     tile = kv_tile_pages
     if tile is None:
-        tile = (default_kv_tile_pages(tables.shape[1],
-                                      k_pages.shape[2], Dh,
-                                      k_pages.dtype)
-                if use_pallas else 0)
+        if use_pallas:
+            # KForge flywheel: a ragged-sweep winner recorded for this
+            # geometry overrides the static VMEM-budget selection; an
+            # unswept geometry (or unset store) keeps the default —
+            # either way the same flash-combine math, only retiled.
+            from .. import autotune as at
+            win = at.lookup("ragged_paged_attention",
+                            pages_per_slot=int(tables.shape[1]),
+                            page_size=int(k_pages.shape[2]),
+                            head_dim=int(Dh),
+                            dtype=str(jnp.dtype(k_pages.dtype)))
+            if win is not None and "kv_tile_pages" in win:
+                tile = int(win["kv_tile_pages"])
+            else:
+                tile = default_kv_tile_pages(tables.shape[1],
+                                             k_pages.shape[2], Dh,
+                                             k_pages.dtype)
+        else:
+            tile = 0
     tile = int(tile)
     if use_pallas:
         if tile:

@@ -114,6 +114,93 @@ def test_autotune_skips_failing_candidates():
         at.autotune("k3", [broken], (jnp.zeros(2),), iters=1)
 
 
+def test_autotune_key_includes_dtype_and_blocks():
+    # shape-only keys collide across bf16/int8 callers of the same
+    # geometry and across candidate block-shape sets
+    from paddle_tpu.ops import autotune as at
+    a16 = jnp.zeros((4, 8), jnp.bfloat16)
+    a32 = jnp.zeros((4, 8), jnp.float32)
+    keys = {at.make_key("op", (a16,), blocks=(128, 128)),
+            at.make_key("op", (a32,), blocks=(128, 128)),
+            at.make_key("op", (a16,), blocks=(256, 128))}
+    assert len(keys) == 3
+
+
+# ------------------------------------------- persistent winner store ----
+
+def test_winner_store_disk_round_trip(tmp_path, monkeypatch):
+    from paddle_tpu.ops import autotune as at
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_DIR", str(tmp_path))
+    at.clear()
+    at.record("fused_rms_norm", {"tile_n": 4},
+              rows=64, d=32, dtype="float32")
+    at.record("conv_epilogue", {"tm": 8, "tn": 128, "tk": 8},
+              M=64, K=32, N=128, dtype="float32")
+    # drop ALL in-process state — the next lookup must reload the file,
+    # which is what a fresh benching->serving process pair does
+    at.clear()
+    assert at.lookup("fused_rms_norm", rows=64, d=32,
+                     dtype="float32") == {"tile_n": 4}
+    assert at.lookup("conv_epilogue", M=64, K=32, N=128,
+                     dtype="float32") == {"tm": 8, "tn": 128, "tk": 8}
+    # unswept geometry / kind / dtype -> None (caller keeps defaults)
+    assert at.lookup("fused_rms_norm", rows=128, d=32,
+                     dtype="float32") is None
+    assert at.lookup("fused_rms_norm", rows=64, d=32,
+                     dtype="bfloat16") is None
+    assert at.lookup("never_swept", rows=1) is None
+    at.clear()
+
+
+def test_winner_store_corrupt_file_degrades_to_defaults(tmp_path,
+                                                        monkeypatch):
+    from paddle_tpu.ops import autotune as at
+    from paddle_tpu.ops.pallas.fused_norm_rope import (_pick_row_tile,
+                                                       _row_tile)
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_DIR", str(tmp_path))
+    (tmp_path / "winners.json").write_text("{not json")
+    at.clear()
+    # corrupt store == empty store: lookups miss, entry points resolve
+    # their static defaults, nothing raises
+    assert at.lookup("fused_rms_norm", rows=64, d=32,
+                     dtype="float32") is None
+    assert _pick_row_tile(64, 32, jnp.float32, None) == _row_tile(64, 32)
+    at.clear()
+
+
+def test_winner_store_drives_entry_point_tiles(tmp_path, monkeypatch):
+    import jax
+    from paddle_tpu.ops import autotune as at
+    from paddle_tpu.ops.pallas.fused_norm_rope import _pick_row_tile
+    from paddle_tpu.ops.pallas.grouped_matmul import moe_mlp_dropless
+    monkeypatch.setenv("PADDLE_TPU_AUTOTUNE_DIR", str(tmp_path))
+    at.clear()
+    at.record("fused_rms_norm", {"tile_n": 4},
+              rows=64, d=32, dtype="float32")
+    assert _pick_row_tile(64, 32, jnp.float32, None) == 4
+    # a recorded tile that does not divide the rows is ignored
+    at.record("fused_rms_norm", {"tile_n": 5},
+              rows=64, d=32, dtype="float32")
+    assert _pick_row_tile(64, 32, jnp.float32, None) != 5
+    # the 4th reader: a tiles-unspecified moe call resolves the swept
+    # winner and matches the explicit-tiles call bitwise
+    S, D, F, E, k = 32, 16, 32, 4, 2
+    at.record("grouped_matmul", {"tile_m": 16, "tile_n": 32},
+              S=S, D=D, F=F, E=E, k=k, dtype="float32")
+    ks = jax.random.split(jax.random.PRNGKey(0), 5)
+    x = jax.random.normal(ks[0], (S, D), jnp.float32)
+    wg = jax.random.normal(ks[1], (E, D, F), jnp.float32) * 0.02
+    wu = jax.random.normal(ks[2], (E, D, F), jnp.float32) * 0.02
+    wd = jax.random.normal(ks[3], (E, F, D), jnp.float32) * 0.02
+    logits = jax.random.normal(ks[4], (S, E), jnp.float32)
+    cw, eids = jax.lax.top_k(jax.nn.softmax(logits), k)
+    y_default = moe_mlp_dropless(x, eids, cw, wg, wu, wd)
+    y_winner = moe_mlp_dropless(x, eids, cw, wg, wu, wd,
+                                tile_m=16, tile_n=32)
+    assert (np.asarray(y_default) == np.asarray(y_winner)).all()
+    at.clear()
+
+
 # ----------------------------------------------------------- SOT fallback ----
 
 def test_to_static_full_graph_false_falls_back_on_graph_break():

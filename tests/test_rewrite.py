@@ -19,12 +19,16 @@ import jax.numpy as jnp
 
 from paddle_tpu.analysis.framework import (ExactnessContract,
                                            REWRITE_REGISTRY, Severity)
-from paddle_tpu.analysis.rewrite import (FusedRmsNormPass,
+from paddle_tpu.analysis.rewrite import (DecodeTailFusePass,
+                                         FusedRmsNormPass,
                                          Int8EpilogueFusePass,
                                          count_matches, rewrite_jaxpr,
                                          rewrite_callable,
                                          run_rewrite_suite,
                                          verify_rewrite)
+from paddle_tpu.analysis.rewrite_conv import (ConvBnFoldPass,
+                                              ConvNhwcLayoutPass,
+                                              StemSpaceToDepthPass)
 from paddle_tpu.models import llama as L
 
 
@@ -382,6 +386,188 @@ def test_rewritten_train_numerics_within_declared_tolerance():
         np.testing.assert_allclose(np.asarray(a, np.float64),
                                    np.asarray(b, np.float64),
                                    rtol=1e-3, atol=1e-5)
+
+
+# ---------------------------------------------------------------------------
+# conv passes (rewrite_conv.py): fire / no-fire / idempotence / contracts
+# ---------------------------------------------------------------------------
+
+def _conv(x, w, strides=(1, 1), padding=((1, 1), (1, 1))):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+
+def _bn_infer(y, g, b, m, v, eps=1e-5, shape=(1, -1, 1, 1)):
+    """The inference-BN eqn chain the fold pattern targets (what
+    nn.BatchNorm2D traces to in eval mode)."""
+    return ((y - m.reshape(shape)) * jax.lax.rsqrt(v.reshape(shape) + eps)
+            * g.reshape(shape) + b.reshape(shape))
+
+
+def _conv_bn_args(cout=4, cin=3, image=6, k=3):
+    r = np.random.RandomState(2)
+    x = jnp.asarray(r.standard_normal((2, cin, image, image)), jnp.float32)
+    w = jnp.asarray(r.standard_normal((cout, cin, k, k)) * 0.1,
+                    jnp.float32)
+    g, b, m = (jnp.asarray(r.standard_normal(cout), jnp.float32)
+               for _ in range(3))
+    v = jnp.asarray(np.abs(r.standard_normal(cout)) + 0.5, jnp.float32)
+    return x, w, g, b, m, v
+
+
+def test_conv_bn_fold_fires_verifies_idempotent():
+    rules = [ConvBnFoldPass()]
+    for relu in (True, False):   # both anchor spellings
+        def f(x, w, g, b, m, v):
+            out = _bn_infer(_conv(x, w), g, b, m, v)
+            return jax.nn.relu(out) if relu else out
+        cj = jax.make_jaxpr(f)(*_conv_bn_args())
+        res = rewrite_jaxpr(cj, rules=rules, retrace=True)
+        assert res.fired.get("conv-bn-fold") == 1, relu
+        assert res.idempotent, res.residual
+        vo = verify_rewrite(res, rules=rules)
+        assert vo.ok, vo
+
+
+def test_conv_bn_fold_must_not_fire_when_conv_escapes():
+    # the conv output is also a graph output — folding would change it
+    def f(x, w, g, b, m, v):
+        y = _conv(x, w)
+        return jax.nn.relu(_bn_infer(y, g, b, m, v)), y
+    assert not count_matches(jax.make_jaxpr(f)(*_conv_bn_args()),
+                             rules=[ConvBnFoldPass()])
+
+
+def test_conv_bn_fold_must_not_fire_wrong_axis_bn():
+    # channels-LAST stats ([1,1,1,C]) on a channels-first conv: it
+    # broadcasts (image == cout) but normalises the wrong axis
+    def f(x, w, g, b, m, v):
+        return _bn_infer(_conv(x, w), g, b, m, v, shape=(1, 1, 1, 4))
+    assert not count_matches(jax.make_jaxpr(f)(*_conv_bn_args(image=4)),
+                             rules=[ConvBnFoldPass()])
+
+
+def test_conv_bn_fold_must_not_fire_on_batch_stats():
+    # train-mode BN: the stats are reductions OF the conv output, which
+    # therefore escapes the match — the no-fire is structural
+    def f(x, w, g, b, m, v):
+        y = _conv(x, w)
+        return jax.nn.relu(_bn_infer(y, g, b, y.mean(axis=(0, 2, 3)),
+                                     y.var(axis=(0, 2, 3))))
+    assert not count_matches(jax.make_jaxpr(f)(*_conv_bn_args()),
+                             rules=[ConvBnFoldPass()])
+
+
+def _stem_args(cin=3, image=8):
+    r = np.random.RandomState(3)
+    x = jnp.asarray(r.standard_normal((1, cin, image, image)),
+                    jnp.float32)
+    w = jnp.asarray(r.standard_normal((4, cin, 7, 7)) * 0.1, jnp.float32)
+    return x, w
+
+
+def test_stem_s2d_fires_verifies_idempotent():
+    def f(x, w):
+        return _conv(x, w, strides=(2, 2), padding=((3, 3), (3, 3)))
+    rules = [StemSpaceToDepthPass()]
+    cj = jax.make_jaxpr(f)(*_stem_args())
+    res = rewrite_jaxpr(cj, rules=rules, retrace=True)
+    assert res.fired.get("stem-space-to-depth") == 1
+    assert res.idempotent, res.residual
+    assert verify_rewrite(res, rules=rules).ok
+
+
+def test_stem_s2d_must_not_fire_off_stem_shapes():
+    def f(x, w):
+        return _conv(x, w, strides=(2, 2), padding=((3, 3), (3, 3)))
+    rules = [StemSpaceToDepthPass()]
+    # 4 input channels: not the RGB stem
+    assert not count_matches(jax.make_jaxpr(f)(*_stem_args(cin=4)),
+                             rules=rules)
+    # odd image: the 2x2 phase split does not exist
+    assert not count_matches(jax.make_jaxpr(f)(*_stem_args(image=7)),
+                             rules=rules)
+
+
+def test_layout_pass_fires_on_any_nchw_conv():
+    rules = [ConvNhwcLayoutPass()]
+    cj = jax.make_jaxpr(_conv)(*_conv_bn_args()[:2])
+    res = rewrite_jaxpr(cj, rules=rules, retrace=True)
+    assert res.fired.get("conv-nhwc-layout") == 1
+    # the rewritten conv is NHWC — the NCHW pattern can never re-fire
+    assert res.idempotent, res.residual
+    assert verify_rewrite(res, rules=rules).ok
+
+
+# ---------------------------------------------------------------------------
+# decode-tail-fuse: fire / no-fire / exactness
+# ---------------------------------------------------------------------------
+
+def _tail_args(rows=6, d=16, vocab=32):
+    r = np.random.RandomState(4)
+    x = jnp.asarray(r.standard_normal((rows, d)), jnp.bfloat16)
+    w = jnp.asarray(r.standard_normal(d), jnp.float32)
+    idx = jnp.asarray([1, 4], jnp.int32)
+    head = jnp.asarray(r.standard_normal((d, vocab)), jnp.bfloat16)
+    return x, w, idx, head
+
+
+def test_decode_tail_fires_and_is_exact_on_seeded_graph():
+    def f(x, w, idx, head):
+        h = L.rms_norm(x, w, 1e-5)
+        return (h[idx] @ head).astype(jnp.float32)
+    rules = [DecodeTailFusePass()]
+    cj = jax.make_jaxpr(f)(*_tail_args())
+    res = rewrite_jaxpr(cj, rules=rules, retrace=True)
+    assert res.fired.get("decode-tail-fuse") == 1
+    assert res.idempotent, res.residual
+    vo = verify_rewrite(res, rules=rules)
+    # dtype mirroring (dot in head.dtype, like the matched graph) makes
+    # the substitution drift-free on the seeded sites — not just within
+    # the 1e-3 pin
+    assert vo.ok and vo.max_abs == 0.0, vo
+
+
+def test_decode_tail_must_not_fire_when_rows_escape():
+    def f(x, w, idx, head):
+        h = L.rms_norm(x, w, 1e-5)
+        rows = h[idx]
+        return (rows @ head).astype(jnp.float32), rows
+    assert not count_matches(jax.make_jaxpr(f)(*_tail_args()),
+                             rules=[DecodeTailFusePass()])
+
+
+def test_decode_tail_must_not_fire_on_column_gather():
+    def f(x, w, idx, head):
+        h = L.rms_norm(x, w, 1e-5)
+        return (h[:, idx].T @ head).astype(jnp.float32)
+    x, w, idx, _ = _tail_args()
+    r = np.random.RandomState(5)
+    head = jnp.asarray(r.standard_normal((x.shape[0], 8)), jnp.bfloat16)
+    assert not count_matches(jax.make_jaxpr(f)(x, w, idx, head),
+                             rules=[DecodeTailFusePass()])
+
+
+def test_new_pass_contracts_pinned():
+    # the measured pins documented in each pass docstring — a contract
+    # loosened (or tightened past the measurement) without re-measuring
+    # should fail here
+    assert REWRITE_REGISTRY["conv-bn-fold"] is ConvBnFoldPass
+    assert REWRITE_REGISTRY["stem-space-to-depth"] is StemSpaceToDepthPass
+    assert REWRITE_REGISTRY["conv-nhwc-layout"] is ConvNhwcLayoutPass
+    assert REWRITE_REGISTRY["decode-tail-fuse"] is DecodeTailFusePass
+    c = ConvBnFoldPass.contract
+    assert (c.rtol, c.atol) == (5e-2, 1e-3) and not c.bitwise
+    for cls in (StemSpaceToDepthPass, ConvNhwcLayoutPass):
+        assert (cls.contract.rtol, cls.contract.atol) == (5e-2, 2e-2)
+    c = DecodeTailFusePass.contract
+    assert (c.rtol, c.atol) == (1e-3, 1e-3)
+    # the tail swallows the rms core, so it must outrank the plain
+    # substitution — and the fold must outrank stem/layout
+    assert DecodeTailFusePass.priority < FusedRmsNormPass.priority
+    assert (ConvBnFoldPass.priority < StemSpaceToDepthPass.priority
+            < ConvNhwcLayoutPass.priority)
 
 
 # ---------------------------------------------------------------------------

@@ -14,7 +14,19 @@ remat, so HFU == MFU); falls back to the 2*4.09 GMAC torchvision
 convention * 3 (fwd+bwd) if the backend hides cost analysis.
 
 Run (TPU): python tools/resnet_bench.py
+
+Profile mode — the measurement behind the conv rewrite passes
+(analysis/rewrite_conv.py):
+
+    python tools/resnet_bench.py --profile out.json [--mode infer]
+        [--depth 50] [--image 224]
+
+emits the per-region table (analysis/resnet_profile.py): every site
+the rewrite passes match, slope-timed and XLA-cost-analyzed baseline
+vs rewritten, plus the full-graph A/B. Batch comes from
+RESNET_BENCH_B (keep it small on CPU).
 """
+import argparse
 import json
 import os
 import sys
@@ -36,6 +48,38 @@ def peak_flops() -> float:
         if k in kind:
             return v
     return 197e12
+
+
+def run_profile(path: str, mode: str, depth: int, image: int) -> None:
+    from paddle_tpu.analysis.resnet_profile import profile_resnet
+
+    B = int(os.environ.get("RESNET_BENCH_B", "8"))
+    prof = profile_resnet(depth=depth, image=image, batch=B, mode=mode)
+    with open(path, "w") as f:
+        json.dump(prof, f, indent=1)
+    hdr = (f"{'region':<34} {'rule':<20} {'n':>2} {'GF':>7} "
+           f"{'MB/op':>8} {'MB/fus':>8} {'ms':>8} {'%step':>6} "
+           f"{'MB(rw)':>8} {'ms(rw)':>8}")
+    print(hdr)
+    print("-" * len(hdr))
+    for r in prof["regions"]:
+        rw = r["rewritten"]
+        print(f"{r['name']:<34} {r['rule']:<20} {r['count']:>2} "
+              f"{r['flops'] / 1e9:>7.2f} {r['bytes'] / 1e6:>8.2f} "
+              f"{r['fused']['bytes'] / 1e6:>8.2f} "
+              f"{r['ms']:>8.3f} {str(r['pct_of_step']):>6} "
+              f"{rw['bytes'] / 1e6:>8.2f} {rw['ms']:>8.3f}")
+    t = prof["totals"]
+    print(f"totals: per-op {t['baseline_per_op']['bytes'] / 1e6:.1f} MB, "
+          f"region-fused {t['baseline_fused']['bytes'] / 1e6:.1f} MB, "
+          f"rewritten {t['rewritten']['bytes'] / 1e6:.1f} MB -> "
+          f"bytes_ratio per-op {t['bytes_ratio_per_op']}, fused "
+          f"{t['bytes_ratio_fused']}; ms_ratio {t['ms_ratio']}")
+    fg = prof["full_graph"]
+    print(f"full-graph: {prof['step_ms']:.2f} -> "
+          f"{prof['step_ms_rewritten']:.2f} ms, bytes_ratio "
+          f"{fg['bytes_ratio']} ({fg['note']})")
+    print(f"wrote {path}")
 
 
 def main():
@@ -126,4 +170,15 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", metavar="OUT_JSON", default=None,
+                    help="write the per-region rewrite profile and exit")
+    ap.add_argument("--mode", choices=("infer", "train"),
+                    default="infer")
+    ap.add_argument("--depth", type=int, default=50)
+    ap.add_argument("--image", type=int, default=224)
+    args = ap.parse_args()
+    if args.profile:
+        run_profile(args.profile, args.mode, args.depth, args.image)
+    else:
+        main()

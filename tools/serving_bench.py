@@ -344,6 +344,7 @@ class Bench:
                   prefill_chunk=a.prefill_chunk or None,
                   admission_window=a.admission_window,
                   cold_tier_bytes=getattr(a, "cold_tier", 0),
+                  rewrites=getattr(a, "rewrites", False),
                   # None = env default; True = per-tick paged-KV
                   # invariant checking (violations raise inside the
                   # tick -> every handle errors -> main exits non-zero)
@@ -1578,6 +1579,12 @@ def main(argv=None):
     ap.add_argument("--no-kill", action="store_true",
                     help="fleet mode: skip the kill-one-replica "
                          "scenario")
+    ap.add_argument("--rewrites", action="store_true",
+                    help="route engine step functions through the "
+                         "verified rewrite passes (decode-tail fuse + "
+                         "fused rmsnorm); greedy outputs are pinned "
+                         "bitwise-identical, so --check-invariants "
+                         "and the recompile sentinel apply unchanged")
     ap.add_argument("--check-invariants", action="store_true",
                     help="run the paged-KV invariant checker "
                          "(analysis/kv_invariants.py) after every "
