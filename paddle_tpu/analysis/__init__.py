@@ -17,6 +17,11 @@ through) and over the serving stack's host-side state. Entry points:
   pass stack under the ``planner-contract`` tolerance.
 * ``ServingEngine(check_invariants=True)`` — per-tick paged-KV
   invariant checking (race-detector-style debug mode).
+* ``graph_lint --suite concurrency`` — the host-side concurrency
+  analysis (``analysis/concurrency.py``): static guarded-by lint +
+  lock-order cycle detection over every lock in
+  ``paddle_tpu/serving/``, paired with the runtime ``LockTracer`` and
+  seeded schedule fuzzer (``serving/locktrace.py``).
 * ``audit_engine(engine)`` — standalone audit of a live engine;
   ``audit_engine_plan(engine)`` — mpu-hint audit of an auto-parallel
   Engine's plan; ``Engine.donation_audit()`` — donation audit of the
@@ -24,6 +29,8 @@ through) and over the serving stack's host-side state. Entry points:
 
 See docs/ANALYSIS.md for each pass's invariant and how to add one.
 """
+from .concurrency import (analyze_source, analyze_tree, check_tree,
+                          fuzz_fleet_scenario, mutate_remove_with)
 from .collectives import (CollectiveConsistencyPass,
                           check_stage_consistency,
                           collective_cost_bytes, collective_signature,
@@ -73,14 +80,16 @@ __all__ = [
     "RecompileHazardPass", "RewritePass", "RewriteResult",
     "ServingGeometry", "Severity", "ShardingLintPass",
     "TRAIN_GEOMETRIES", "VerifyOutcome", "Violation",
+    "analyze_source", "analyze_tree",
     "audit_defrag_plan", "audit_engine", "audit_engine_plan",
-    "audit_serving_state", "build_train_target",
+    "audit_serving_state", "build_train_target", "check_tree",
     "check_stage_consistency", "collective_cost_bytes",
     "collective_signature", "count_matches", "default_passes",
     "default_rewrites", "engine_geometry", "enumerate_chunk_programs",
     "enumerate_plan_points", "enumerate_tick_programs",
     "estimate_hbm_peak", "flagship_train_objects",
-    "jit_donation_flags", "plan_auto_parallel", "pp_stage_targets",
+    "fuzz_fleet_scenario", "jit_donation_flags",
+    "mutate_remove_with", "plan_auto_parallel", "pp_stage_targets",
     "price_plan_point", "register_pass",
     "register_rewrite", "rewrite_callable", "rewrite_jaxpr",
     "rewrite_target", "rewrite_targets", "run_passes",

@@ -41,6 +41,15 @@ lints source, with ruff layered on top when available:
   ``# noqa: PT005`` with a justification; everything else in the
   serving tree is a hot path where an extra sync is the
   [S,V]-logits-pull bug class all over again.
+* **thread attribution** (CC002) — *library code only*
+  (``paddle_tpu/``): every ``threading.Thread(...)`` must pass
+  ``name=`` and ``daemon=`` explicitly. The concurrency analysis
+  (analysis/concurrency.py) attributes lock traces, inversion records
+  and flight-recorder postmortems by thread name — an anonymous
+  ``Thread-7`` in a postmortem is unactionable. Reasoned suppression:
+  ``# noqa: CC002(reason)``; a CC-series noqa WITHOUT a reason flags
+  as CC004 (the concurrency pass owns that check inside
+  ``paddle_tpu/serving/``, this lint covers the rest of the tree).
 * **host-sync** (PT001/PT002/PT003) — *library code only*
   (``paddle_tpu/``; tools and tests, which legitimately pull results
   to the host, are exempt): the source-level companion of the
@@ -116,20 +125,44 @@ def _code_text_without_import_lines(src: str, tree) -> str:
     return text
 
 
-_NOQA = re.compile(r"#\s*noqa(?::\s*(?P<codes>[A-Z0-9, ]+))?",
-                   re.IGNORECASE)
+_NOQA = re.compile(r"#\s*noqa(?::\s*(?P<codes>.*))?", re.IGNORECASE)
+_NOQA_CODE = re.compile(r"\s*([A-Za-z][A-Za-z0-9]*)"
+                        r"(?:\(([^)]*)\))?\s*")
+
+
+def _parse_noqa_codes(s: str) -> dict:
+    """``"F401,E711"`` / ``"PT005 — text"`` / ``"CC001(reason)"`` ->
+    {code: reason-or-None}. Stops at the first token that is not a
+    (possibly reasoned) code — the trailing ``— free text`` of the
+    legacy form is ignored, and a hyphen INSIDE a ``(reason)`` does
+    not truncate it."""
+    out, pos = {}, 0
+    while pos < len(s):
+        m = _NOQA_CODE.match(s, pos)
+        if m is None:
+            break
+        out[m.group(1).upper()] = (
+            m.group(2).strip() if m.group(2) else None)
+        pos = m.end()
+        if pos < len(s) and s[pos] == ",":
+            pos += 1
+        else:
+            break
+    return out
 
 
 def _noqa_map(src: str):
-    """lineno -> set of suppressed codes (empty set = suppress all)."""
+    """lineno -> {code: reason-or-None} (empty dict = suppress all).
+
+    Accepts the legacy ``# noqa: F401,E711`` and ``# noqa: PT005 —
+    text`` forms plus the CC-series reasoned form ``# noqa:
+    CC001(why this lock-free access is safe)``."""
     out = {}
     for i, ln in enumerate(src.splitlines(), start=1):
         m = _NOQA.search(ln)
         if m:
             codes = m.group("codes")
-            out[i] = (set(c.strip().upper()
-                          for c in codes.split(",") if c.strip())
-                      if codes else set())
+            out[i] = _parse_noqa_codes(codes) if codes else {}
     return out
 
 
@@ -140,8 +173,9 @@ def lint_file(path: Path, src: str = None,
     """[(rule, lineno, message)] for one file. ``# noqa`` (optionally
     ``# noqa: F401,E711``) on the statement's first line suppresses.
     ``host_sync_scope=True`` (library code under ``paddle_tpu/``)
-    additionally runs the PT00x host-sync rules; ``pallas_scope=True``
-    (``ops/pallas/``) the PT004 VMEM-scratch rule; ``serving_scope=True``
+    additionally runs the PT00x host-sync rules AND the CC002
+    thread-attribution rule; ``pallas_scope=True`` (``ops/pallas/``)
+    the PT004 VMEM-scratch rule; ``serving_scope=True``
     (``paddle_tpu/serving/``) the PT005 hot-path host-sync rule."""
     if src is None:
         src = Path(path).read_text()
@@ -285,6 +319,44 @@ def lint_file(path: Path, src: str = None,
                         "sanctioned site (# noqa: PT005 with a "
                         "justification) or pass a dtype if this "
                         "converts a host container"))
+
+    # ---- thread attribution in library code (CC002) -----------------
+    # Unnamed threads make tracer spans, flight-recorder postmortems
+    # and LockTracer inversion records unattributable; an implicit
+    # daemon flag makes shutdown behaviour an accident of the default.
+    if host_sync_scope:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_thread = (
+                (isinstance(f, ast.Attribute) and f.attr == "Thread"
+                 and isinstance(f.value, ast.Name)
+                 and f.value.id == "threading")
+                or (isinstance(f, ast.Name) and f.id == "Thread"))
+            if not is_thread:
+                continue
+            kw = {k.arg for k in node.keywords}
+            missing = [k for k in ("name", "daemon") if k not in kw]
+            if missing and not suppressed("CC002", node.lineno):
+                findings.append((
+                    "CC002", node.lineno,
+                    "threading.Thread(...) without explicit "
+                    + " and ".join(f"{k}=" for k in missing)
+                    + " — unnamed/implicit threads make tracer spans "
+                    "and postmortems unattributable"))
+        # reasonless CC-series noqa (CC004). The concurrency pass owns
+        # this check for serving files (it sees guarded-by context);
+        # source_lint covers the rest of the library tree.
+        if not serving_scope:
+            for line, codes in sorted(noqa.items()):
+                for code, reason in codes.items():
+                    if (code.startswith("CC") and code != "CC004"
+                            and not reason):
+                        findings.append((
+                            "CC004", line,
+                            f"# noqa: {code} without a justification — "
+                            f"write # noqa: {code}(reason)"))
 
     # ---- host syncs in library code (PT001/PT002/PT003) -------------
     if host_sync_scope:

@@ -106,7 +106,8 @@ class TCPStore:
             else:
                 self._pysrv = _PyKV(("0.0.0.0", port))
                 threading.Thread(target=self._pysrv.serve_forever,
-                                 daemon=True).start()
+                                 daemon=True,
+                                 name="kv-store-server").start()
         ip = socket.gethostbyname(host)
         if self._lib is not None:
             self._cli = self._lib.pt_store_connect(

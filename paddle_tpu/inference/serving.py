@@ -93,7 +93,8 @@ class DynamicBatcher:
         self.stats = {"requests": 0, "batches": 0, "padded_rows": 0}
         self._closed = False
         self._lock = threading.Lock()  # orders submit() vs close()
-        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="inference-serving")
         self._worker.start()
 
     # ------------------------------------------------------------- API ----

@@ -241,7 +241,8 @@ class DataLoader:
                 except Exception as e:  # surface worker errors
                     out_q.put((i, e))
 
-        threads = [threading.Thread(target=worker, args=(w,), daemon=True)
+        threads = [threading.Thread(target=worker, args=(w,), daemon=True,
+                                    name=f"dataloader-{w}")
                    for w in range(self.num_workers)]
         for t in threads:
             t.start()

@@ -70,6 +70,7 @@ import itertools
 from ..inference.paged_kv import PagePool, apply_defrag
 from ..observability import FlightRecorder, RecompileSentinel, SpanTracer
 from ..profiler import RecordEvent
+from .locktrace import get_tracer, host_sync, wrap_lock
 from .metrics import ServingMetrics
 from .prefix_cache import ColdTier, PrefixCache, _fp_extend
 from .scheduler import (CANCELLED, COMPLETED, REJECTED, TIMED_OUT,
@@ -300,6 +301,29 @@ class ServingEngine:
     involvement (serving/fleet/proc/fleet.py).
     """
 
+    # Sanctioned lock-free READS (analysis/concurrency.py guarded-by
+    # pass; writes still flag). These engine-private objects are
+    # mutated only on the worker tick thread under the tick lock;
+    # cross-thread readers either call internally-synchronized
+    # methods or take the tick lock themselves right after the
+    # None/flag check, and tolerate one-tick staleness.
+    _CC_LOCK_FREE_READS = {
+        "scheduler": "queue methods serialize on Scheduler._lock; "
+                     "slot/table state is read only under the tick "
+                     "lock or after worker join",
+        "prefix_cache": "is-enabled None-check only; every trie "
+                        "touch below it runs under the tick lock",
+        "tracer": "SpanTracer serializes on its own internal lock",
+        "_closing": "handshake flag written under the _cond mutex; "
+                    "the tick loop re-reads it each iteration "
+                    "(worst case: one extra idle tick)",
+    }
+    # Caller-must-hold contracts the entry-point detector cannot see.
+    _CC_REQUIRES = {
+        "_spill_node": ["_tick_lock", "trie spill hook: PrefixCache "
+                        "only evicts under the engine tick lock"],
+    }
+
     def __init__(self, params, cfg, *, model=None, max_batch: int = 8,
                  page_size: int = 16, total_pages: Optional[int] = None,
                  max_prompt_len: int = 64, max_new_tokens_cap: int = 64,
@@ -509,8 +533,13 @@ class ServingEngine:
         if self._cold is not None:
             self.prefix_cache.spill = self._spill_node
 
+        # _cond stays a RAW Condition (its internal mutex cannot be
+        # traced without modelling wait()'s release semantics); the
+        # tick lock goes through wrap_lock so the LockTracer / fuzzer
+        # see every acquisition when enabled (zero cost otherwise)
         self._cond = threading.Condition()
-        self._tick_lock = threading.Lock()
+        self._tick_lock = wrap_lock(threading.Lock(),
+                                    "ServingEngine._tick_lock")
         self._closing = False
         self._drain = True
         # hand-back drain (the fleet drain protocol): when set, the
@@ -656,9 +685,9 @@ class ServingEngine:
                 if self.sentinel is not None:
                     self.sentinel.close()
                 return self._take_returned()
-            self._closing = True
-            self._drain = drain
-            self._hand_back = bool(hand_back)
+            self._closing = True     # noqa: CC001(handshake flags are written under the _cond mutex; the tick loop re-reads them under the tick lock every iteration)
+            self._drain = drain      # noqa: CC001(same _cond handshake as _closing above)
+            self._hand_back = bool(hand_back)  # noqa: CC001(same _cond handshake as _closing above)
             self._cond.notify_all()
         self._worker.join()
         if self.sentinel is not None:
@@ -669,7 +698,7 @@ class ServingEngine:
         """Drain the hand-back list atomically (worker is not running
         when this is called; the cond lock guards racing closers)."""
         with self._cond:
-            out, self._returned = self._returned, []
+            out, self._returned = self._returned, []  # noqa: CC001(worker has exited by the time any closer gets here; the _cond mutex serializes racing closers)
         return out
 
     def __enter__(self):
@@ -783,6 +812,7 @@ class ServingEngine:
             # numpy to pickle across the fleet/proc worker boundary
             k = np.asarray(jnp.take(self._kp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
             v = np.asarray(jnp.take(self._vp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
+            host_sync("serving.migrate_export")
         return {"fp": int(fp), "page_size": int(self.pool.page_size),
                 "tokens": tokens, "k": k, "v": v}
 
@@ -876,6 +906,7 @@ class ServingEngine:
             idx = jnp.asarray([nd.page for nd in nodes], jnp.int32)
             k = np.asarray(jnp.take(self._kp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
             v = np.asarray(jnp.take(self._vp, idx, axis=2))  # noqa: PT005 — migration export is a sanctioned one-shot device pull
+            host_sync("serving.migrate_export")
         return {"start": int(start), "count": len(nodes), "k": k, "v": v}
 
     def export_chain_end(self, xid: int) -> None:
@@ -1018,6 +1049,7 @@ class ServingEngine:
         idx = jnp.asarray([nd.page], jnp.int32)
         k = np.asarray(jnp.take(self._kp, idx, axis=2))  # noqa: PT005 — cold-tier spill is a sanctioned one-shot device pull
         v = np.asarray(jnp.take(self._vp, idx, axis=2))  # noqa: PT005 — cold-tier spill is a sanctioned one-shot device pull
+        host_sync("serving.cold_spill")
         if self._cold.put(fp, nd.toks, k, v):
             self.metrics.inc("cold_spills")
 
@@ -1330,6 +1362,11 @@ class ServingEngine:
         }
         if self.prefix_cache is not None:
             state["prefix_cache"] = self.prefix_cache.stats()
+        lt = get_tracer()
+        if lt is not None:
+            # runtime acquisition graph + wait/hold aggregates: which
+            # lock the dying engine was living under (locktrace.py)
+            state["lock_trace"] = lt.report()
         spans = [s.to_dict() for s in self.tracer.spans()] \
             if self.tracer.enabled else None
         self.postmortem_path = self.flight.dump(
@@ -1713,6 +1750,7 @@ class ServingEngine:
                 # [S, 1+spec_k] i32 + [S] i32 — the eager pulls
                 toks = np.asarray(toks_d)      # noqa: PT005 - THE sanctioned per-tick verify read-back
                 accept = np.asarray(accept_d)  # noqa: PT005 - rides the same sync
+                host_sync("serving.tick.readback")
             else:
                 toks_d, _logits_d, self._kp, self._vp = self._tick_jit(
                     self._params, jnp.asarray(tok), meta, self._kp,
@@ -1721,6 +1759,7 @@ class ServingEngine:
                 # pull: sampling happens IN-GRAPH (r16), so no [S, V]
                 # logits row ever crosses to the host
                 toks = np.asarray(toks_d)  # noqa: PT005 - THE sanctioned per-tick token read-back
+                host_sync("serving.tick.readback")
         m1 = time.monotonic()
         if toks.ndim == 1:
             toks = toks[:, None]
@@ -1802,6 +1841,7 @@ class ServingEngine:
                 self._vp, num_steps=k,
                 sampling=self._sampling_arrays())
             toks = np.asarray(toks)  # noqa: PT005 - sanctioned per-block token read-back ([S, k] i32)
+            host_sync("serving.tick.readback")
         self.metrics.inc("decode_steps", k)
         self.metrics.observe("decode_step_s",
                              (time.perf_counter() - t0) / k)
@@ -1957,25 +1997,32 @@ class ServingEngine:
                     self._write_postmortem(e)
             except Exception:
                 pass        # a failing dump must not mask the error
-            self._fail_all(e)
+            with self._tick_lock:
+                self._fail_all(e)
             raise
         finally:
-            # post-drain (or cancel-close): flush whatever remains
-            for r in self.scheduler.drop_queued(lambda r: True):
-                r.finish(CANCELLED)
-                self.metrics.inc("cancelled")
-            for slot, req in self.scheduler.occupied():
-                self._retire(slot, CANCELLED)
-            self._prefill_q.clear()
-            if self.prefix_cache is not None:
-                # teardown hygiene: every request is retired, so all
-                # cached pages are refcount-0 — return them so the pool
-                # ends balanced (used_pages == 0 after close). Detach
-                # the cold-tier spill hook first: teardown eviction is
-                # disposal, not pressure — spilling the whole trie to
-                # host RAM on close would be pure waste.
-                self.prefix_cache.spill = None
-                self.prefix_cache.evict(self.prefix_cache.cached_pages)
+            # post-drain (or cancel-close): flush whatever remains —
+            # under the tick lock: snapshot()/gauges()/defragment()
+            # callers may still be mid-read, and the teardown rewrites
+            # the very slot/table/trie state they walk
+            with self._tick_lock:
+                for r in self.scheduler.drop_queued(lambda r: True):
+                    r.finish(CANCELLED)
+                    self.metrics.inc("cancelled")
+                for slot, req in self.scheduler.occupied():
+                    self._retire(slot, CANCELLED)
+                self._prefill_q.clear()
+                if self.prefix_cache is not None:
+                    # teardown hygiene: every request is retired, so
+                    # all cached pages are refcount-0 — return them so
+                    # the pool ends balanced (used_pages == 0 after
+                    # close). Detach the cold-tier spill hook first:
+                    # teardown eviction is disposal, not pressure —
+                    # spilling the whole trie to host RAM on close
+                    # would be pure waste.
+                    self.prefix_cache.spill = None
+                    self.prefix_cache.evict(
+                        self.prefix_cache.cached_pages)
 
     def _fail_all(self, e: BaseException) -> None:
         for r in self.scheduler.drop_queued(lambda r: True):

@@ -40,6 +40,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Dict, List, Optional
 
+from ..locktrace import fuzz_point, wrap_lock
 from ..metrics import merge_exposition
 from ..scheduler import RequestHandle
 from .replica import (DRAINING, GONE, JOINING, ROLE_DECODE,
@@ -83,7 +84,7 @@ class ServingFleet:
             raise ValueError("replicas must be >= 1")
         self._factory = engine_factory
         self._prefix = str(name_prefix)
-        self._lock = threading.Lock()
+        self._lock = wrap_lock(threading.Lock(), "ServingFleet._lock")
         self._n = 0
         self.generation = 0
         self._replicas: Dict[str, Replica] = {}   # join order, ALL states
@@ -165,6 +166,9 @@ class ServingFleet:
             # flip to DRAINING through the replica itself so the
             # router stops selecting it the moment the leave begins
             handed = rep.drain()
+            # schedule-fuzz window: the handed-back queue exists but
+            # is not yet re-dispatched — the exactly-once seam
+            fuzz_point("fleet.leave.handed")
             # prune the router's membership + TTL caches: a GONE
             # replica must not cost every future submit a filter pass
             self.router.remove(name)

@@ -29,6 +29,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ...locktrace import wrap_lock
 from ...metrics import merge_exposition
 from ...scheduler import RequestHandle
 from ..replica import (DRAINING, GONE, JOINING, ROLE_DECODE,
@@ -65,7 +66,7 @@ class ProcServingFleet:
         self._prefix = str(name_prefix)
         self._timeouts = (start_timeout, rpc_timeout, drain_timeout)
         self._health_rpc_timeout = float(health_rpc_timeout)
-        self._lock = threading.Lock()
+        self._lock = wrap_lock(threading.Lock(), "ProcServingFleet._lock")
         self._n = 0
         self.generation = 0
         self._replicas: Dict[str, ProcReplica] = {}
@@ -106,7 +107,8 @@ class ProcServingFleet:
                 rep.start()
             except BaseException as e:     # noqa: BLE001
                 errs.append((rep.name, e))
-        ths = [threading.Thread(target=_start, args=(r,), daemon=True)
+        ths = [threading.Thread(target=_start, args=(r,), daemon=True,
+                                name=f"fleet-start-{r.name}")
                for r in reps]
         for th in ths:
             th.start()
@@ -408,7 +410,8 @@ class ProcServingFleet:
                 rep.close(drain=drain, hand_back=False)
             except Exception:
                 pass
-        ths = [threading.Thread(target=_close, args=(r,), daemon=True)
+        ths = [threading.Thread(target=_close, args=(r,), daemon=True,
+                                name=f"fleet-close-{r.name}")
                for r in reps]
         for th in ths:
             th.start()

@@ -31,6 +31,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ...locktrace import wrap_lock
+
 from ..replica import (DRAINING, GONE, JOINING, ROLE_GENERAL, SERVING,
                        _ROLES)
 from .transport import TransportError, WorkerTransport
@@ -53,6 +55,18 @@ class _EngineShim:
 
 
 class ProcReplica:
+    _CC_LOCK_FREE_READS = {
+        "state": "single opaque string replaced atomically under "
+                 "_lock; health/load readers accept one stale "
+                 "transition by design (the router re-polls)",
+        "_t": "transport ref is written once at start() and cleared "
+              "only by kill_process(); readers bind t = self._t once "
+              "and a cleared ref degrades to a dead-replica refusal",
+        "_max_batch": "written once when the worker's ready frame "
+                      "lands; load() reading the pre-ready default "
+                      "just overestimates pressure for one poll",
+    }
+
     def __init__(self, name: str, spec, *, role: str = ROLE_GENERAL,
                  generation: int = 0,
                  on_death: Optional[Callable] = None,
@@ -81,7 +95,7 @@ class ProcReplica:
         # through the fleet ctor (health_ttl_s governs how often these
         # fire; this governs how long each may hang)
         self._health_rpc_timeout = float(health_rpc_timeout)
-        self._lock = threading.RLock()
+        self._lock = wrap_lock(threading.RLock(), "ProcReplica._lock")
         # rid -> [req, skip, cancel_sent]
         self._outstanding: dict = {}
         self._on_death_cb = on_death

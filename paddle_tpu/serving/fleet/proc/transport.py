@@ -35,6 +35,7 @@ import queue
 import threading
 from typing import Callable, Optional
 
+from ...locktrace import wrap_lock
 from .worker import worker_main
 
 __all__ = ["WorkerTransport", "TransportError", "TransportTimeout",
@@ -58,6 +59,13 @@ _DIED = object()        # waiter resolution marker for a dead worker
 
 
 class WorkerTransport:
+    _CC_LOCK_FREE_READS = {
+        "_dead": "monotonic None->reason flag written under _lock; "
+                 "unlocked pre-checks only race toward one rpc/cast "
+                 "observing death a beat late, and those paths re-check "
+                 "or fail on the queue anyway",
+    }
+
     def __init__(self, spec, name: str = "w", *,
                  start_timeout: float = 180.0,
                  on_frame: Optional[Callable] = None,
@@ -73,7 +81,7 @@ class WorkerTransport:
         self._ctx = mp.get_context("spawn")
         self._cmd = self._ctx.Queue()
         self._evt = self._ctx.Queue()
-        self._lock = threading.Lock()
+        self._lock = wrap_lock(threading.Lock(), "WorkerTransport._lock")
         self._seq = itertools.count(1)
         self._waiters: dict = {}    # seq -> [Event, ok, payload]
         self._fseq: dict = {}       # rid -> next expected frame seq

@@ -48,6 +48,8 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from ..locktrace import wrap_lock
+
 __all__ = ["Replica", "JOINING", "SERVING", "DRAINING", "GONE",
            "ROLE_GENERAL", "ROLE_PREFILL", "ROLE_DECODE"]
 
@@ -78,6 +80,16 @@ class Replica:
     launcher's generation rendezvous (distributed/launch/).
     """
 
+    _CC_LOCK_FREE_READS = {
+        "engine": "health-view snapshot pattern: accessors bind eng = "
+                  "self.engine once and tolerate staleness; close() "
+                  "races degrade to a refusal or an empty view, never "
+                  "a torn read",
+        "state": "single opaque string replaced atomically under "
+                 "_lock; health/load readers accept one stale "
+                 "transition by design (the router re-polls)",
+    }
+
     def __init__(self, name: str, engine_factory: Callable, *,
                  role: str = ROLE_GENERAL, generation: int = 0):
         if role not in _ROLES:
@@ -87,7 +99,7 @@ class Replica:
         self.role = role
         self.generation = int(generation)   # fleet generation at join
         self._factory = engine_factory
-        self._lock = threading.RLock()
+        self._lock = wrap_lock(threading.RLock(), "Replica._lock")
         self.state = JOINING
         self.engine = None
         self.joined_t = time.monotonic()

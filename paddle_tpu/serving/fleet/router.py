@@ -39,6 +39,7 @@ import time
 from collections import OrderedDict
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+from ..locktrace import fuzz_point, wrap_lock
 from ..prefix_cache import prefix_fingerprints
 from ..scheduler import CANCELLED, Request, RequestHandle
 from .replica import ROLE_DECODE, ROLE_GENERAL, ROLE_PREFILL, Replica
@@ -92,7 +93,7 @@ class FleetRouter:
         self.summary_depth = int(summary_depth)
         self.summary_ttl_s = float(summary_ttl_s)
         self.prefill_len_ratio = float(prefill_len_ratio)
-        self._lock = threading.Lock()
+        self._lock = wrap_lock(threading.Lock(), "FleetRouter._lock")
         self._replicas: List[Replica] = list(replicas)
         self._rr = 0
         # id -> Request already re-dispatched once (exactly-once
@@ -281,6 +282,9 @@ class FleetRouter:
         cands = self._candidates(exclude)
         if not cands:
             return None
+        # schedule-fuzz window: candidates chosen, none injected yet —
+        # a replica may drain/die between selection and inject
+        fuzz_point("router.dispatch.picked")
         for rep in self._pick(req, cands):
             if rep.inject(req):
                 # optimistically bump the TTL-cached load: within one
@@ -339,6 +343,8 @@ class FleetRouter:
             with self._lock:
                 again = req.id in self._redispatched
                 self._redispatched[req.id] = req
+            # schedule-fuzz window: dedup recorded, dispatch pending
+            fuzz_point("router.redispatch.window")
             home = None if again else self._dispatch(req, exclude)
             if home is None:
                 req.error = RuntimeError(

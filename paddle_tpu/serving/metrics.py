@@ -16,6 +16,8 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional, Tuple
 
+from .locktrace import wrap_lock
+
 import numpy as np
 
 __all__ = ["Histogram", "ServingMetrics", "merge_exposition"]
@@ -140,7 +142,7 @@ class ServingMetrics:
                   "spec_accept_rate", "cold_adopt_s")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = wrap_lock(threading.Lock(), "ServingMetrics._lock")
         self.counters = {k: 0 for k in self.COUNTERS}
         self.histograms = {k: Histogram() for k in self.HISTOGRAMS}
         # name -> {tuple(sorted(label items)) -> count}

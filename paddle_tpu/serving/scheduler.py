@@ -28,6 +28,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..inference.paged_kv import PagePool
+from .locktrace import wrap_lock
 
 __all__ = ["Request", "RequestHandle", "Scheduler",
            "QUEUED", "RUNNING", "COMPLETED", "CANCELLED", "TIMED_OUT",
@@ -204,7 +205,7 @@ class Scheduler:
         # advertised bound real
         self._head_id: Optional[int] = None
         self._head_overtakes = 0
-        self._lock = threading.Lock()
+        self._lock = wrap_lock(threading.Lock(), "Scheduler._lock")
         self._queue: "deque[Request]" = deque()
         self.slots: List[Optional[Request]] = [None] * self.max_batch
         # host-side mirrors of the jitted step's table/length operands

@@ -31,6 +31,15 @@ runs, in seconds and with zero XLA compiles:
     rewrite required to fire, the rewriter required to be idempotent,
     and every fired site verified against its exactness contract
     (bitwise / pinned tolerance) on concrete seeded inputs;
+  * the CONCURRENCY suite (analysis/concurrency.py, also under
+    --ci): the static guarded-by lint + lock-order cycle analysis
+    over every threading.Lock/RLock in paddle_tpu/serving/ — `--json`
+    carries the lock inventory, the acquisition-order graph
+    (`concurrency.lock_order.edges`), per-rule counts and the
+    suppression/annotation inventories; any unsuppressed finding or
+    order cycle fails the run (static passes only here — the runtime
+    LockTracer and the schedule fuzzer run in the test suite and
+    under `serving_bench --check-invariants`);
   * (--ci) the AST source lint over paddle_tpu/ + tools/
     (analysis/source_lint.py), plus `ruff check` when the binary is
     installed (the container image does not ship it; the AST subset
@@ -97,7 +106,8 @@ def main(argv=None):
     ap.add_argument("--limit", type=int, default=16,
                     help="recompile-hazard programs-per-bucket bound")
     ap.add_argument("--suite",
-                    choices=["all", "serving", "training", "rewrite"],
+                    choices=["all", "serving", "training", "rewrite",
+                             "concurrency"],
                     default="all")
     ap.add_argument("--ci", action="store_true",
                     help="also run the source lint (+ruff if installed)"
@@ -202,6 +212,24 @@ def main(argv=None):
          "top": [{"bytes": b, "value": lbl} for b, lbl in est.top]}
         for name, est in sorted(hbm.items())]
 
+    if args.suite in ("all", "concurrency") or args.ci:
+        # the static half of the concurrency analysis (guarded-by,
+        # lock-order cycles, noqa discipline) over paddle_tpu/serving/
+        # — pure AST, no tracing, well under the --ci 10s budget
+        from paddle_tpu.analysis.concurrency import check_tree
+        cres = check_tree()
+        out["concurrency"] = {
+            "by_rule": cres["by_rule"],
+            "findings": cres["findings"],
+            "suppressed": cres["suppressed"],
+            "lock_free_reads": cres["lock_free_reads"],
+            "requires": cres["requires"],
+            "locks": cres["locks"],
+            "lock_order": cres["lock_order"],
+            "errors": cres["errors"],
+        }
+        ok = ok and not cres["findings"] and not cres["errors"]
+
     if args.ci:
         from paddle_tpu.analysis.source_lint import lint_tree
         root = os.path.join(os.path.dirname(__file__), "..")
@@ -228,6 +256,17 @@ def main(argv=None):
         if args.verbose:
             for name, est in sorted(hbm.items()):
                 print(est)
+        if "concurrency" in out:
+            c = out["concurrency"]
+            for item in c["findings"]:
+                print(f"[error] {item['rule']} @ {item['path']}:"
+                      f"{item['line']}: {item['message']}")
+            lo = c["lock_order"]
+            print(f"concurrency: {len(c['locks'])} locks, "
+                  f"{len(lo['edges'])} order edges, "
+                  f"{len(lo['cycles'])} cycles, "
+                  f"{sum(c['by_rule'].values())} findings "
+                  f"({len(c['suppressed'])} suppressed)")
         if args.ci:
             for item in out.get("source", []):
                 print(f"[error] source-lint @ {item['file']}:"

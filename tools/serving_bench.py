@@ -1588,9 +1588,13 @@ def main(argv=None):
     ap.add_argument("--check-invariants", action="store_true",
                     help="run the paged-KV invariant checker "
                          "(analysis/kv_invariants.py) after every "
-                         "engine tick + a final audit, AND require a "
+                         "engine tick + a final audit, require a "
                          "clean recompile sentinel (any post-warmup "
-                         "XLA compile exits non-zero)")
+                         "XLA compile exits non-zero), AND enable the "
+                         "runtime LockTracer (serving/locktrace.py): "
+                         "an observed lock-order inversion also exits "
+                         "non-zero; the acquisition graph + wait/hold "
+                         "stats land in the results as `lock_trace`")
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="export the engine run's span timeline as "
                          "Perfetto-loadable Chrome-trace JSON (one "
@@ -1621,6 +1625,19 @@ def main(argv=None):
         ap.error(f"--prefill-chunk ({args.prefill_chunk}) must be a "
                  f"multiple of --page-size ({args.page_size})")
 
+    lt_tracer = None
+    if args.check_invariants:
+        # --check-invariants also turns on the runtime lock tracer
+        # (analysis/concurrency.py's dynamic half): every serving lock
+        # built from here on records acquisition order, and an
+        # observed order inversion — two locks taken in both orders,
+        # i.e. a latent deadlock the static cycle check may not see
+        # across dynamic call paths — fails the bench after the modes
+        # run. Enable BEFORE Bench construction: wrapping is decided
+        # at lock construction time.
+        from paddle_tpu.serving import locktrace
+        lt_tracer = locktrace.enable()
+
     bench = Bench(args)
     trace = build_trace(args.requests, args.rate, args.max_prompt,
                         args.mnt_choices, args.seed,
@@ -1643,6 +1660,16 @@ def main(argv=None):
         }
         print(json.dumps(verdict), flush=True)
         results["verdict"] = verdict
+    if lt_tracer is not None:
+        rep = lt_tracer.report()
+        results["lock_trace"] = rep
+        print(json.dumps({"lock_trace": {
+            "edges": rep["edges"], "inversions": rep["inversions"],
+            "host_sync_held": rep["host_sync_held"]}}), flush=True)
+        if rep["inversions"]:
+            raise SystemExit(
+                "serving_bench --check-invariants: lock-order "
+                f"inversion(s) observed at runtime: {rep['inversions']}")
     return results
 
 
