@@ -22,6 +22,12 @@ through) and over the serving stack's host-side state. Entry points:
   lock-order cycle detection over every lock in
   ``paddle_tpu/serving/``, paired with the runtime ``LockTracer`` and
   seeded schedule fuzzer (``serving/locktrace.py``).
+* ``graph_lint --suite kernels`` — the Pallas kernel auditor
+  (``analysis/kernel_audit.py``): static VMEM-footprint, grid/index-
+  map, DMA-discipline, and accumulator-dtype proofs (KA001–KA004)
+  over every registered kernel geometry plus every swept winner in
+  the autotune store; the same verdict gates autotune admission
+  (``ops.autotune.record(audit=True)``, audited ``lookup``).
 * ``audit_engine(engine)`` — standalone audit of a live engine;
   ``audit_engine_plan(engine)`` — mpu-hint audit of an auto-parallel
   Engine's plan; ``Engine.donation_audit()`` — donation audit of the
@@ -46,6 +52,12 @@ from .framework import (ExactnessContract, Finding, GraphTarget,
 from .hbm import (HbmEstimate, HbmPeakPass, estimate_hbm_peak,
                   xla_cost_analysis, xla_peak_bytes)
 from .host_sync import HostSyncPass
+from .kernel_audit import (ALL_RULES as KERNEL_AUDIT_RULES,
+                           GATE_RULES as KERNEL_AUDIT_GATE_RULES,
+                           KernelAuditError, KernelSpec,
+                           VMEM_AUDIT_BUDGET, Waiver, audit_callable,
+                           audit_config, audit_kernel,
+                           kernel_signatures, run_kernel_audit)
 from .kv_invariants import (KVInvariantError, Violation,
                             audit_defrag_plan, audit_engine,
                             audit_serving_state)
@@ -74,25 +86,30 @@ __all__ = [
     "DtypeDriftPass",
     "ExactnessContract", "Finding", "FusedRmsNormPass", "GraphTarget",
     "HbmEstimate", "HbmPeakPass", "HostSyncPass",
-    "Int8EpilogueFusePass", "KVInvariantError", "LintPass",
+    "Int8EpilogueFusePass", "KERNEL_AUDIT_GATE_RULES",
+    "KERNEL_AUDIT_RULES", "KVInvariantError", "KernelAuditError",
+    "KernelSpec", "LintPass",
     "LintReport", "PASS_REGISTRY", "PlanCost", "PlanPoint",
     "PlannerContractPass", "REWRITE_REGISTRY",
     "RecompileHazardPass", "RewritePass", "RewriteResult",
     "ServingGeometry", "Severity", "ShardingLintPass",
-    "TRAIN_GEOMETRIES", "VerifyOutcome", "Violation",
-    "analyze_source", "analyze_tree",
-    "audit_defrag_plan", "audit_engine", "audit_engine_plan",
+    "TRAIN_GEOMETRIES", "VMEM_AUDIT_BUDGET", "VerifyOutcome",
+    "Violation", "Waiver",
+    "analyze_source", "analyze_tree", "audit_callable",
+    "audit_config", "audit_defrag_plan", "audit_engine",
+    "audit_engine_plan", "audit_kernel",
     "audit_serving_state", "build_train_target", "check_tree",
     "check_stage_consistency", "collective_cost_bytes",
     "collective_signature", "count_matches", "default_passes",
     "default_rewrites", "engine_geometry", "enumerate_chunk_programs",
     "enumerate_plan_points", "enumerate_tick_programs",
     "estimate_hbm_peak", "flagship_train_objects",
-    "fuzz_fleet_scenario", "jit_donation_flags",
+    "fuzz_fleet_scenario", "jit_donation_flags", "kernel_signatures",
     "mutate_remove_with", "plan_auto_parallel", "pp_stage_targets",
     "price_plan_point", "register_pass",
     "register_rewrite", "rewrite_callable", "rewrite_jaxpr",
     "rewrite_target", "rewrite_targets", "run_passes",
+    "run_kernel_audit",
     "run_rewrite_suite", "scan_trip_counts", "serving_targets",
     "spec_shard_factor", "trace_graph", "train_stage_targets",
     "train_step_target", "training_targets", "verify_plan",

@@ -46,6 +46,41 @@ _DISK_FROM: Optional[str] = None
 
 _ENV_DIR = "PADDLE_TPU_AUTOTUNE_DIR"
 _STORE_FILE = "winners.json"
+#: set to "0" to disable the audit-at-load gate (debugging escape
+#: hatch; the default ON is what keeps a stale store from silently
+#: applying an inadmissible tiling)
+_ENV_AUDIT = "PADDLE_TPU_AUTOTUNE_AUDIT"
+
+
+class AutotuneAuditError(RuntimeError):
+    """``record(..., audit=True)`` refused a winner whose config fails
+    the static kernel audit (KA001 VMEM / KA002 coverage) — the sweep
+    measured something the kernel cannot actually serve."""
+
+
+def _audit_on() -> bool:
+    return os.environ.get(_ENV_AUDIT, "1").lower() not in ("0", "false",
+                                                           "off")
+
+
+def _audit_verdict(kind: str, geom: Dict[str, Any],
+                   winner: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """KA001/KA002 admission verdict from the kernel auditor, or None
+    when the analysis stack is unavailable (autotune degrades open —
+    persistence must not hard-require the auditor)."""
+    try:
+        from ..analysis import kernel_audit as ka
+        return ka.audit_config(kind, geom, winner)
+    except Exception:
+        return None
+
+
+def _kernel_signatures() -> Optional[Dict[str, Dict[str, Any]]]:
+    try:
+        from ..analysis import kernel_audit as ka
+        return ka.kernel_signatures()
+    except Exception:
+        return None
 
 
 def clear():
@@ -123,30 +158,120 @@ def _load_store() -> Dict[str, Dict[str, Any]]:
         warnings.warn(f"autotune winner store {path} unreadable "
                       f"({type(e).__name__}: {e}); using defaults",
                       stacklevel=2)
+    store = _validate_store(store, path)
     _DISK, _DISK_FROM = store, path
     return store
+
+
+def _validate_store(store: Dict[str, Dict[str, Any]],
+                    path: str) -> Dict[str, Dict[str, Any]]:
+    """Schema-check loaded entries against the registered kernel
+    signatures: an entry whose kind is no longer registered, whose
+    geometry keys don't match the kernel's lookup kwargs, or whose
+    winner carries unknown config keys is warned about and SKIPPED —
+    a renamed kernel must not silently orphan (or worse, misapply) its
+    winners. With the auditor unavailable the store passes through
+    unvalidated (degrade open)."""
+    sigs = _kernel_signatures()
+    if sigs is None or not store:
+        return store
+    import warnings
+    out: Dict[str, Dict[str, Any]] = {}
+    for kind, per_kind in store.items():
+        sig = sigs.get(kind)
+        if sig is None:
+            warnings.warn(
+                f"autotune store {path}: kind {kind!r} matches no "
+                f"registered kernel signature; skipping its "
+                f"{len(per_kind)} entries", stacklevel=3)
+            continue
+        kept: Dict[str, Any] = {}
+        for gkey, winner in per_kind.items():
+            try:
+                geom = json.loads(gkey)
+            except ValueError:
+                geom = None
+            if (not isinstance(geom, dict)
+                    or tuple(sorted(geom)) != tuple(sig["geom_keys"])):
+                warnings.warn(
+                    f"autotune store {path}: {kind} entry {gkey!r} "
+                    f"does not match geometry keys "
+                    f"{list(sig['geom_keys'])}; skipping", stacklevel=3)
+                continue
+            if (not isinstance(winner, dict) or not winner
+                    or not set(winner) <= set(sig["config_keys"])):
+                warnings.warn(
+                    f"autotune store {path}: {kind} winner {winner!r} "
+                    f"does not match config keys "
+                    f"{list(sig['config_keys'])}; skipping",
+                    stacklevel=3)
+                continue
+            kept[gkey] = winner
+        if kept:
+            out[kind] = kept
+    return out
+
+
+def raw_store() -> Dict[str, Dict[str, Any]]:
+    """A copy of the loaded winner store, ``{kind: {geom_key:
+    winner}}`` — the kernel auditor sweeps this to audit every stored
+    geometry, and tests inspect it directly."""
+    return {k: dict(v) for k, v in _load_store().items()}
 
 
 def lookup(kind: str, **geom) -> Optional[Dict[str, Any]]:
     """The swept winner for ``kind`` at ``geom``, or None (caller falls
     back to its default tiling — the unswept path is bitwise-unchanged
-    because block shape never changes the math, only the schedule)."""
+    because block shape never changes the math, only the schedule).
+
+    Audit-at-load: a stored winner whose geometry no longer passes the
+    static kernel audit (KA001 VMEM / KA002 coverage) is ignored with a
+    warning instead of silently applied — the flywheel's admission gate
+    on the read side. Verdicts are cached per (kind, geom, config), so
+    a hot entry audits once per process; set
+    ``PADDLE_TPU_AUTOTUNE_AUDIT=0`` to disable."""
     entry = _load_store().get(kind)
     if not entry:
         return None
     win = entry.get(geometry_key(**geom))
-    return dict(win) if isinstance(win, dict) else None
+    if not isinstance(win, dict):
+        return None
+    if _audit_on():
+        v = _audit_verdict(kind, dict(geom), dict(win))
+        if v is not None and not v.get("ok", True):
+            import warnings
+            warnings.warn(
+                f"autotune winner {win} for {kind} @ "
+                f"{geometry_key(**geom)} fails the kernel audit "
+                f"({','.join(v.get('rules', []))}: "
+                f"{v.get('detail', '')}); ignoring it", stacklevel=2)
+            return None
+    return dict(win)
 
 
-def record(kind: str, winner: Dict[str, Any], **geom) -> str:
+def record(kind: str, winner: Dict[str, Any], *, audit: bool = False,
+           **geom) -> str:
     """Persist one sweep winner (``{"tile_n": 128, ...}``) for
     ``kind``/``geom``. Requires ``$PADDLE_TPU_AUTOTUNE_DIR``. Writes
     atomically (tmp + rename) so a concurrent reader never sees a torn
-    file. Returns the store path."""
+    file. Returns the store path.
+
+    ``audit=True`` (what ``kernel_bench`` passes) runs the static
+    kernel audit's admission rules (KA001/KA002) first and raises
+    :class:`AutotuneAuditError` instead of writing a winner the kernel
+    cannot serve — the flywheel's write-side gate."""
     path = store_path()
     if path is None:
         raise RuntimeError(
             f"set ${_ENV_DIR} to record autotune winners")
+    if audit and _audit_on():
+        v = _audit_verdict(kind, dict(geom), dict(winner))
+        if v is not None and not v.get("ok", True):
+            raise AutotuneAuditError(
+                f"refusing to record {winner} for {kind} @ "
+                f"{geometry_key(**geom)}: fails kernel audit "
+                f"({','.join(v.get('rules', []))}: "
+                f"{v.get('detail', '')})")
     os.makedirs(os.path.dirname(path), exist_ok=True)
     store = dict(_load_store())
     per_kind = dict(store.get(kind, {}))

@@ -120,3 +120,36 @@ def matmul_bias_act(x2, w, bias, relu: bool = True,
     return _call(x2, w.astype(x2.dtype),
                  bias.reshape(1, N).astype(jnp.float32),
                  tm, tn, tk, relu, interpret=not _on_tpu())
+
+
+# ---------------------------------------------------------------------------
+# kernel-audit registration (analysis/kernel_audit.py)
+# ---------------------------------------------------------------------------
+# Geometry keys match matmul_bias_act's autotune lookup kwargs, so
+# block-sweep winners.json entries audit directly (audit-at-record /
+# audit-at-load in ops/autotune.py ride this registration).
+
+AUDIT_KIND = "conv_epilogue"
+AUDIT_GEOM_KEYS = ("M", "K", "N", "dtype")
+AUDIT_CONFIG_KEYS = ("tm", "tn", "tk")
+AUDIT_GEOMETRIES = (
+    # ResNet-50 B=8 stage-3 1x1 (M = 8*28*28) — the profiled rewrite's
+    # hottest epilogue shape class
+    {"M": 6272, "K": 512, "N": 512, "dtype": "bfloat16"},
+    {"M": 512, "K": 2048, "N": 512, "dtype": "float32"},
+)
+
+
+def audit_launches(geom, config=None):
+    M, K, N = int(geom["M"]), int(geom["K"]), int(geom["N"])
+    dt = jnp.dtype(geom["dtype"])
+    if config is not None and {"tm", "tn", "tk"} <= set(config):
+        tm, tn, tk = int(config["tm"]), int(config["tn"]), int(config["tk"])
+    else:
+        tm, tn, tk = default_tiles(M, K, N, dt)
+    x = jax.ShapeDtypeStruct((M, K), dt)
+    w = jax.ShapeDtypeStruct((K, N), dt)
+    b = jax.ShapeDtypeStruct((1, N), jnp.float32)
+    fn = functools.partial(_call, tm=tm, tn=tn, tk=tk, relu=True,
+                           interpret=False)
+    return [(f"matmul_bias_act[{tm}x{tn}x{tk}]", fn, (x, w, b))]

@@ -125,3 +125,37 @@ def flash_attention(q, k, v, *, causal: bool = True, sm_scale=None,
                     f"pallas flash attention unavailable, using dense "
                     f"O(T^2) fallback: {type(e).__name__}: {e}")
     return _dense_reference(q, k, v, causal, sm_scale)
+
+
+# ---------------------------------------------------------------------------
+# kernel-audit registration (analysis/kernel_audit.py)
+# ---------------------------------------------------------------------------
+# No autotune kind (block sizes are pinned at 512 by the on-chip
+# sweep). The splash kernel's three stats outputs (running max /
+# denominator / logsumexp) are revisited across the kv grid axis, but
+# kv is innermost so the revisits are consecutive runs — KA002's
+# sequential-accumulation allowance covers them with no waiver.
+
+AUDIT_KIND = None
+AUDIT_CONFIG_KEYS = ()
+AUDIT_GEOMETRIES = (
+    {"batch": 2, "seq": 1024, "heads": 8, "kv_heads": 8,
+     "head_dim": 128, "causal": True, "dtype": "bfloat16"},
+)
+
+
+def audit_launches(geom, config=None):
+    B, T = int(geom["batch"]), int(geom["seq"])
+    H, Hkv = int(geom["heads"]), int(geom["kv_heads"])
+    dh = int(geom["head_dim"])
+    dt = jnp.dtype(geom["dtype"])
+    causal = bool(geom["causal"])
+    sm_scale = float(dh) ** -0.5
+    q = jax.ShapeDtypeStruct((B, T, H, dh), dt)
+    k = jax.ShapeDtypeStruct((B, T, Hkv, dh), dt)
+    v = jax.ShapeDtypeStruct((B, T, Hkv, dh), dt)
+
+    def fn(q, k, v):
+        return _splash(q, k, v, causal, sm_scale)
+
+    return [("splash_fwd", fn, (q, k, v))]

@@ -299,3 +299,59 @@ def moe_mlp_dropless(x, expert_ids, combine_weights, w_gate, w_up, w_down,
     w = combine_weights.reshape(-1)[order].astype(ys.dtype)
     return (jnp.zeros((S, D), ys.dtype)
             .at[token_of].add(ys[dest] * w[:, None]))
+
+
+# ---------------------------------------------------------------------------
+# kernel-audit registration (analysis/kernel_audit.py)
+# ---------------------------------------------------------------------------
+# Geometry keys match moe_mlp_dropless's autotune lookup kwargs, so
+# block-sweep winners audit directly. The launches mirror the dropless
+# MoE call sites: the gate/down gmms and the weight-gradient tgmm, with
+# a sorted tile_expert covering every expert (the layout
+# sort_and_pad_by_expert always produces).
+
+AUDIT_KIND = "grouped_matmul"
+AUDIT_GEOM_KEYS = ("S", "D", "F", "E", "k", "dtype")
+AUDIT_CONFIG_KEYS = ("tile_m", "tile_n")
+AUDIT_GEOMETRIES = (
+    {"S": 256, "D": 512, "F": 1024, "E": 4, "k": 2, "dtype": "bfloat16"},
+)
+
+
+def audit_launches(geom, config=None):
+    import numpy as np
+    S, D, F, E = (int(geom[k]) for k in ("S", "D", "F", "E"))
+    k = int(geom["k"])
+    dt = jnp.dtype(geom["dtype"])
+    cfg = config or {}
+    tile_m = int(cfg.get("tile_m", 128))
+    tile_n = int(cfg.get("tile_n", 128))
+    A = S * k
+    m_pad = ((A + tile_m - 1) // tile_m + (E - 1)) * tile_m
+    n_tiles = m_pad // tile_m
+    # sorted, all experts owning at least one tile — the layout the
+    # sorted-precondition check and tgmm's contiguous-run accumulation
+    # rely on
+    te = np.sort(np.arange(n_tiles, dtype=np.int32) % E)
+    xs = jax.ShapeDtypeStruct((m_pad, D), dt)
+    hs = jax.ShapeDtypeStruct((m_pad, F), dt)
+    w_gate = jax.ShapeDtypeStruct((E, D, F), dt)
+    w_down = jax.ShapeDtypeStruct((E, F, D), dt)
+    item = dt.itemsize
+    tn_gate = _fit_tile_n(D, tile_m, tile_n, F, item)
+    tn_down = _fit_tile_n(F, tile_m, tile_n, D, item)
+    tn_grad = _fit_tile_n(D, tile_m, tile_n, F, item)
+    return [
+        (f"gmm_gate[{tile_m}x{tn_gate}]",
+         functools.partial(_gmm_call, tile_m=tile_m, tile_n=tn_gate,
+                           interpret=False),
+         (xs, w_gate, te)),
+        (f"gmm_down[{tile_m}x{tn_down}]",
+         functools.partial(_gmm_call, tile_m=tile_m, tile_n=tn_down,
+                           interpret=False),
+         (hs, w_down, te)),
+        (f"tgmm_dw[{tile_m}x{tn_grad}]",
+         functools.partial(_tgmm_call, num_experts=E, tile_m=tile_m,
+                           tile_n=tn_grad, interpret=False),
+         (xs, hs, te)),
+    ]

@@ -109,3 +109,31 @@ def int8_matmul_pallas(x, q, scale):
     out = _call(x2, q, scale.reshape(1, N).astype(jnp.float32),
                 tm, tn, tk, interpret=not _on_tpu())
     return out[:M].reshape(*lead, N)
+
+
+# ---------------------------------------------------------------------------
+# kernel-audit registration (analysis/kernel_audit.py)
+# ---------------------------------------------------------------------------
+# No autotune kind: the entry derives its tiles statically
+# (_pick_tile), so the audit pins the derived tiling at the decode
+# flagship shape (and the int8 weight operand arms KA004).
+
+AUDIT_KIND = None
+AUDIT_CONFIG_KEYS = ()
+AUDIT_GEOMETRIES = (
+    {"M": 128, "K": 4096, "N": 4096, "dtype": "bfloat16"},
+)
+
+
+def audit_launches(geom, config=None):
+    M, K, N = int(geom["M"]), int(geom["K"]), int(geom["N"])
+    dt = jnp.dtype(geom["dtype"])
+    sub = 16 if dt == jnp.bfloat16 else 8
+    tk = _pick_tile(K, 512, sub)
+    tn = _pick_tile(N, 512, 128)
+    tm = _pick_tile(-(-M // sub) * sub, 128, sub)
+    x = jax.ShapeDtypeStruct((-(-M // sub) * sub, K), dt)
+    q = jax.ShapeDtypeStruct((K, N), jnp.int8)
+    s = jax.ShapeDtypeStruct((1, N), jnp.float32)
+    fn = functools.partial(_call, tm=tm, tn=tn, tk=tk, interpret=False)
+    return [(f"int8_matmul[{tm}x{tn}x{tk}]", fn, (x, q, s))]

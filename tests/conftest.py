@@ -65,6 +65,7 @@ _SMOKE_MODULES = {
     "test_observability", "test_pipeline_async", "test_speculative",
     "test_fused_sampling", "test_auto_parallel_planner", "test_fleet",
     "test_fleet_proc", "test_migration", "test_concurrency_lint",
+    "test_kernel_audit",
 }
 
 

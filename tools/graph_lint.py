@@ -40,6 +40,18 @@ runs, in seconds and with zero XLA compiles:
     order cycle fails the run (static passes only here — the runtime
     LockTracer and the schedule fuzzer run in the test suite and
     under `serving_bench --check-invariants`);
+  * the KERNELS suite (analysis/kernel_audit.py, also under --ci):
+    the static Pallas kernel auditor — per registered kernel geometry
+    (plus every swept winner in the autotune store) it proves the
+    VMEM footprint fits the per-core budget (KA001), every index_map
+    stays in bounds and the output tiling covers exactly (KA002),
+    every async-copy start has a matching wait ordered before any
+    read (KA003), and reduction carries over bf16/int8 inputs are f32
+    (KA004); `--json` carries the per-launch VMEM table
+    (`kernels.vmem`), per-rule finding counts and per-rule evaluation
+    counts (the non-vacuity proof), the suppression inventory, and
+    stale-waiver list — any finding, error, or stale waiver fails the
+    run;
   * (--ci) the AST source lint over paddle_tpu/ + tools/
     (analysis/source_lint.py), plus `ruff check` when the binary is
     installed (the container image does not ship it; the AST subset
@@ -107,7 +119,7 @@ def main(argv=None):
                     help="recompile-hazard programs-per-bucket bound")
     ap.add_argument("--suite",
                     choices=["all", "serving", "training", "rewrite",
-                             "concurrency"],
+                             "concurrency", "kernels"],
                     default="all")
     ap.add_argument("--ci", action="store_true",
                     help="also run the source lint (+ruff if installed)"
@@ -230,6 +242,20 @@ def main(argv=None):
         }
         ok = ok and not cres["findings"] and not cres["errors"]
 
+    if args.suite in ("all", "kernels") or args.ci:
+        # the Pallas kernel auditor (analysis/kernel_audit.py): static
+        # VMEM/grid/DMA/accumulator proofs over every registered kernel
+        # geometry plus every swept winner in the autotune store — jaxpr
+        # inspection only, no Mosaic compiles, well inside the --ci
+        # budget. `--json` carries the per-launch VMEM table and the
+        # per-rule finding/evaluation counts; rule_evals being all
+        # non-zero is the non-vacuity proof (a rule that evaluated
+        # nothing proves nothing)
+        from paddle_tpu.analysis.kernel_audit import run_kernel_audit
+        kres = run_kernel_audit()
+        out["kernels"] = kres
+        ok = ok and kres["ok"]
+
     if args.ci:
         from paddle_tpu.analysis.source_lint import lint_tree
         root = os.path.join(os.path.dirname(__file__), "..")
@@ -267,6 +293,23 @@ def main(argv=None):
                   f"{len(lo['cycles'])} cycles, "
                   f"{sum(c['by_rule'].values())} findings "
                   f"({len(c['suppressed'])} suppressed)")
+        if "kernels" in out:
+            k = out["kernels"]
+            for item in k["findings"]:
+                print(f"[error] {item['pass']} @ {item['graph']}: "
+                      f"{item['message']}")
+            for msg in k["errors"]:
+                print(f"[error] kernel-audit: {msg}")
+            for w in k["stale_waivers"]:
+                print(f"[error] kernel-audit stale waiver: "
+                      f"{w['kernel']} {w['rule']} {w['match']!r}")
+            peak = max((row["total_bytes"] for row in k["vmem"]),
+                       default=0)
+            print(f"kernel audit: {len(k['kernels'])} kernels, "
+                  f"{k['launches']} launches, peak VMEM "
+                  f"{peak / 2**20:.2f} MiB, "
+                  f"{sum(k['by_rule'].values())} findings "
+                  f"({len(k['suppressed'])} suppressed)")
         if args.ci:
             for item in out.get("source", []):
                 print(f"[error] source-lint @ {item['file']}:"

@@ -33,6 +33,14 @@ the persistent winner store when ``$PADDLE_TPU_AUTOTUNE_DIR`` is set
 point's ``lookup`` byte-for-byte), then emits a resolution row showing
 what a default call now resolves to (``tiling_source: "swept"`` vs
 ``"default"``). The ragged sweep records its winner the same way.
+
+Every sweep row additionally carries the static kernel-audit verdict
+(``audit: "ok" | "failed:<rule>"`` — analysis/kernel_audit.py run on
+that exact geometry+tiling, no compile), and the record path runs
+with ``audit=True``: a measured winner that fails KA001/KA002 is
+REFUSED admission to the store — the row keeps its timing but gains
+an ``audit_failed`` marker and the resolution row shows what actually
+resolves without it. Fast-but-unsound never enters the flywheel.
 """
 import functools
 import glob
@@ -48,6 +56,19 @@ import jax.numpy as jnp
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+
+def _audit_verdict(kind, geom, config):
+    """Static kernel-audit verdict for one sweep row: ``"ok"`` or
+    ``"failed:<rule>"`` (KA001/KA002 gate rules), ``None`` when the
+    auditor cannot run here. Pure jaxpr inspection — no compile, so
+    annotating every candidate costs milliseconds."""
+    try:
+        from paddle_tpu.analysis import kernel_audit as ka
+        v = ka.audit_config(kind, geom, config)
+    except Exception:
+        return None
+    return "ok" if v["ok"] else "failed:" + ",".join(v["rules"])
 
 
 def devtime(f, args, tag, n=5):
@@ -208,6 +229,8 @@ def ragged_tiling_sweep(out=None, iters=3):
                 ragged_paged_attention, impl="pallas",
                 kv_tile_pages=tile))
 
+        ageom = dict(pages_per_slot=pps, page_size=ps, head_dim=Dh,
+                     dtype=str(jnp.dtype(dt)))
         cands, rows = [], []
         for tile in tiles:
             if tile > pps:
@@ -222,6 +245,8 @@ def ragged_tiling_sweep(out=None, iters=3):
                 "walk": "tiled" if tile else "oneshot",
                 "vmem_scratch_bytes": scratch,
                 "timing_honest": on_tpu,
+                "audit": _audit_verdict("ragged_paged_attention", ageom,
+                                        {"kv_tile_pages": tile}),
             }
             # the one-shot variant past the VMEM knee cannot even
             # compile on the chip — that IS the result (the row the
@@ -255,17 +280,23 @@ def ragged_tiling_sweep(out=None, iters=3):
                 row["autotune_winner"] = bool(i == win_row)
                 row["tiling_source"] = "explicit"
             # persist the winner under the EXACT geometry key the
-            # entry point's lookup uses, then report what a
+            # entry point's lookup uses — audit-gated: a measured
+            # winner failing KA001/KA002 is refused and emits an
+            # audit_failed row instead — then report what a
             # kv_tile_pages=None call now resolves to
-            geom = dict(pages_per_slot=pps, page_size=ps, head_dim=Dh,
-                        dtype=str(jnp.dtype(dt)))
+            winner_cfg = {"kv_tile_pages":
+                          rows[win_row]["kv_tile_pages"]}
             if at.store_dir():
-                at.record("ragged_paged_attention",
-                          {"kv_tile_pages":
-                           rows[win_row]["kv_tile_pages"]}, **geom)
-            win = at.lookup("ragged_paged_attention", **geom)
+                try:
+                    at.record("ragged_paged_attention", winner_cfg,
+                              audit=True, **ageom)
+                except at.AutotuneAuditError as e:
+                    rows.append({"bench": "ragged_kv_walk", **ageom,
+                                 **winner_cfg,
+                                 "audit_failed": str(e)[:200]})
+            win = at.lookup("ragged_paged_attention", **ageom)
             rows.append({"bench": "ragged_kv_walk", "resolution": True,
-                         **geom, **(win or {}),
+                         **ageom, **(win or {}),
                          "tiling_source": "swept" if win else "default"})
         results.extend(rows)
     for row in results:
@@ -312,9 +343,18 @@ def block_sweep(out=None, iters=3):
             r["tiling_source"] = "explicit"
             r["timing_honest"] = on_tpu
             r["autotune_winner"] = r is best
+            r["audit"] = _audit_verdict(kind, geom, winner_blocks(r))
         results.extend(cand_rows)
         if best is not None and persist:
-            at.record(kind, winner_blocks(best), **geom)
+            # audit-gated admission: fastest-but-unsound is refused
+            # (the flywheel would otherwise replay the violation on
+            # every future default call at this geometry)
+            try:
+                at.record(kind, winner_blocks(best), audit=True, **geom)
+            except at.AutotuneAuditError as e:
+                results.append({"bench": kind, **geom,
+                                **winner_blocks(best),
+                                "audit_failed": str(e)[:200]})
         win = at.lookup(kind, **geom)
         results.append({"bench": kind, "resolution": True, **geom,
                         **(win or {}),
